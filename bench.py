@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Flagship benchmark: GPT-2-small LM training step throughput on one TPU chip.
+
+Matches BASELINE.md config 2 ("GPT-2-small fine-tune, ZeRO-2, bf16") scaled to the
+single available chip.  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = achieved MFU / 0.35 (the driver's north-star MFU target for the
+training path, BASELINE.json).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12  # default: v5e
+
+
+def train_flops_per_step(n_params, n_layers, hidden, batch, seq) -> float:
+    """6N per token (fwd+bwd) + attention matmul flops 12*L*H*T per token."""
+    tokens = batch * seq
+    return 6.0 * n_params * tokens + 12.0 * n_layers * hidden * seq * tokens
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, GPTConfig
+
+    # batch 16 is the single-chip sweet spot: batch 32 OOMs on the fp32 logits
+    # (chunked cross-entropy will lift this — see ops/)
+    BATCH, SEQ = 16, 1024
+    cfg_model = GPTConfig.gpt2_small(vocab_size=50304, max_seq_len=SEQ,
+                                     dropout=0.0)
+    model = GPT(cfg_model)
+    config = {
+        "train_micro_batch_size_per_gpu": BATCH,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4,
+                                                  "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 50304, size=(BATCH, SEQ)).astype(np.int32)}
+    example = {"input_ids": np.zeros((BATCH, SEQ), np.int32)}
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config,
+                                               example_batch=example)
+
+    # warmup/compile (value fetch forces a real sync; block_until_ready is not
+    # reliable through the remote-TPU relay)
+    for _ in range(3):
+        m = engine.train_batch(batch)
+    jax.device_get(m.loss)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = engine.train_batch(batch)
+    jax.device_get(m.loss)  # step N depends on state N-1 ⇒ syncs the whole chain
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = BATCH * SEQ / dt
+    flops = train_flops_per_step(engine.num_parameters, cfg_model.num_layers,
+                                 cfg_model.hidden_size, BATCH, SEQ)
+    mfu = flops / dt / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "gpt2s_zero2_bf16_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
+                  "params_m": round(engine.num_parameters / 1e6, 1),
+                  "loss": float(m.loss)},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""deepspeed_tpu: a TPU-native distributed training + inference framework with the
+capability surface of DeepSpeed, rebuilt on JAX/XLA/Pallas/pjit.
+
+Top-level API parity (reference deepspeed/__init__.py):
+- ``initialize()``     (reference :69)  → build a training engine from (model, config)
+- ``init_inference()`` (reference :273) → build an inference engine  [milestone 7]
+- ``comm``             (reference deepspeed/comm) → mesh collectives
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
+from deepspeed_tpu.engine import DeepSpeedTPUEngine, StepMetrics, TrainState
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.version import __version__
+
+__all__ = [
+    "initialize",
+    "DeepSpeedTPUEngine",
+    "DeepSpeedTPUConfig",
+    "DeepSpeedDataLoader",
+    "RepeatingLoader",
+    "TrainState",
+    "StepMetrics",
+    "comm",
+    "__version__",
+]
+
+
+def initialize(model=None,
+               config=None,
+               example_batch=None,
+               training_data=None,
+               lr_scheduler: Optional[Callable[[int], float]] = None,
+               optimizer=None,
+               mesh=None,
+               collate_fn: Optional[Callable] = None,
+               dist_init_required: Optional[bool] = None,
+               args=None,
+               config_params=None,
+               **kwargs) -> Tuple[DeepSpeedTPUEngine, Any, Any, Any]:
+    """Build the training engine (reference deepspeed.initialize,
+    deepspeed/__init__.py:69; engine dispatch :166-208).
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)`` like the reference.
+    The optimizer slot returns the engine's optax transformation; the dataloader is
+    built when ``training_data`` is given.
+
+    model: flax linen Module whose ``__call__(batch)`` returns a scalar loss, or an
+    ``(init_fn, apply_fn)`` pair (see DeepSpeedTPUEngine docstring).
+    example_batch: a host pytree with microbatch-shaped leaves used to trace
+    ``model.init``; taken from ``training_data`` if omitted.
+    """
+    cfg = parse_config(config if config is not None else config_params)
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed()
+
+    dataloader = None
+    if example_batch is None and training_data is not None:
+        import itertools
+
+        import jax
+        import numpy as np
+        it = iter(training_data)
+        first = next(it)
+        if it is training_data:
+            # one-shot iterator/generator: don't lose the peeked example
+            training_data = itertools.chain([first], it)
+        example_batch = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[None, ...], first)
+
+    if example_batch is None:
+        raise ValueError("initialize() needs example_batch or training_data "
+                         "to trace model.init")
+
+    engine = DeepSpeedTPUEngine(model=model, config=cfg,
+                                example_batch=example_batch, mesh=mesh,
+                                lr_scheduler=lr_scheduler,
+                                client_optimizer=optimizer)
+
+    if training_data is not None:
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            micro_batch_size_per_gpu=int(cfg.train_micro_batch_size_per_gpu),
+            gradient_accumulation_steps=int(cfg.gradient_accumulation_steps),
+            dp_world_size=engine.dp_world_size,
+            collate_fn=collate_fn)
+
+    return engine, engine.optimizer, dataloader, engine.lr_schedule

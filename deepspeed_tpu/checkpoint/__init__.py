@@ -1,0 +1,74 @@
+"""Checkpointing.
+
+Reference parity (runtime/engine.py:2710-3554 save/load + checkpoint/ universal
+checkpointing): orbax async sharded checkpointing over the global jax.Array view.
+Key simplification the TPU design buys (SURVEY.md §5): the reference needs an
+offline universal-checkpoint pipeline (checkpoint/ds_to_universal.py) to retarget a
+(tp,pp,dp)-sharded checkpoint at a new topology; with named shardings, restore-time
+resharding is native — orbax restores into whatever sharding the new mesh asks for.
+
+Layout mirrors the reference's ``save_dir/tag/...`` + ``latest`` tag file
+(engine.py:3056 save_checkpoint, _get_ckpt_name):
+
+    save_dir/
+      latest                  # text file with the newest tag
+      <tag>/state/...         # orbax pytree (params, opt_state, step, loss_scale)
+      <tag>/client_state.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+LATEST_FILE = "latest"
+
+
+def _ckpt_path(save_dir: str, tag: str) -> str:
+    return os.path.join(os.path.abspath(save_dir), tag, "state")
+
+
+def save_train_state(save_dir: str, tag: str, state, client_state: dict = None
+                     ) -> str:
+    path = _ckpt_path(save_dir, tag)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
+            json.dump(client_state or {}, f)
+        # reference: 'latest' tag file (engine.py _save_checkpoint)
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(tag)
+    return path
+
+
+def latest_tag(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return f.read().strip()
+
+
+def restore_train_state(load_dir: str, tag: str, shardings, like_state
+                        ) -> Tuple[Any, dict]:
+    """Restore into the given shardings (resharding on load is free — this is the
+    universal-checkpoint capability, reference checkpoint/ds_to_universal.py)."""
+    path = _ckpt_path(load_dir, tag)
+    abstract = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        like_state, shardings)
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(path, abstract)
+    cs_path = os.path.join(load_dir, tag, "client_state.json")
+    client_state = {}
+    if os.path.exists(cs_path):
+        with open(cs_path) as f:
+            client_state = json.load(f)
+    return state, client_state

@@ -1,0 +1,50 @@
+"""Communication layer.
+
+TPU-native analog of ``deepspeed.comm`` (reference: deepspeed/comm/comm.py:222-604 —
+a torch.distributed-mirroring façade with per-op profiling via ``timed_op`` and
+``init_distributed``).
+
+On TPU there is no NCCL/Gloo/MPI backend zoo: collectives are XLA ops over the device
+mesh (ICI intra-slice, DCN inter-slice).  This module provides:
+
+- ``init_distributed()`` → ``jax.distributed.initialize`` (multi-host rendezvous;
+  replaces torch.distributed.init_process_group, reference comm/comm.py:604)
+- named collective wrappers (``all_reduce``, ``all_gather``, ``reduce_scatter``,
+  ``all_to_all``, ``permute``) usable inside ``shard_map``-decorated functions, each
+  instrumented through ``CommsLogger`` (reference utils/comms_logging.py:67) at trace
+  time — sizes/counts are static under jit, wall-time is profiled at the step level.
+"""
+
+from deepspeed_tpu.comm.collectives import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    get_rank,
+    get_world_size,
+    permute,
+    reduce_scatter,
+)
+from deepspeed_tpu.comm.comm import (
+    comms_logger,
+    get_comms_logger,
+    init_distributed,
+    is_initialized,
+)
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "all_to_all",
+    "permute",
+    "broadcast",
+    "barrier",
+    "get_rank",
+    "get_world_size",
+    "init_distributed",
+    "is_initialized",
+    "comms_logger",
+    "get_comms_logger",
+]

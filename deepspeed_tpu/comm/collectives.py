@@ -1,0 +1,130 @@
+"""Named collective wrappers for use inside ``shard_map``.
+
+Reference parity: the collective surface of ``deepspeed.comm``
+(deepspeed/comm/comm.py:222-604 — all_reduce, all_gather_into_tensor,
+reduce_scatter_tensor, all_to_all_single, send/recv, broadcast, barrier).
+
+On TPU these are XLA collectives over named mesh axes.  Point-to-point send/recv
+(used by the reference's pipeline engine, runtime/pipe/p2p.py) maps to
+``jax.lax.ppermute`` — a collective-permute that XLA lowers onto ICI neighbor links.
+
+All wrappers record trace-time metadata into the CommsLogger so a comms summary with
+op counts/volumes is available for any jitted step (reference: timed_op decorator,
+comm/comm.py:101).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm.comm import comms_logger
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _nbytes(x) -> int:
+    return int(x.size) * x.dtype.itemsize
+
+
+def _log(name: str, x, axis: AxisName):
+    comms_logger.record(name, _nbytes(x), str(axis))
+
+
+def get_world_size(axis: AxisName) -> int:
+    """Size of a mesh axis from inside shard_map (reference: dist.get_world_size)."""
+    return lax.axis_size(axis)
+
+
+def get_rank(axis: AxisName):
+    """Rank along a mesh axis from inside shard_map (reference: dist.get_rank)."""
+    return lax.axis_index(axis)
+
+
+def all_reduce(x: jax.Array, axis: AxisName, op: str = "sum") -> jax.Array:
+    """reference: deepspeed.comm.all_reduce (comm/comm.py:486)."""
+    _log("all_reduce", x, axis)
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x: jax.Array, axis: AxisName, *, tiled: bool = True,
+               gather_dim: int = 0) -> jax.Array:
+    """reference: deepspeed.comm.all_gather_into_tensor (comm/comm.py:308).
+
+    tiled=True concatenates along gather_dim (the flat-tensor allgather ZeRO uses);
+    tiled=False stacks a new leading axis.
+    """
+    _log("all_gather", x, axis)
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x: jax.Array, axis: AxisName, *, scatter_dim: int = 0,
+                   tiled: bool = True) -> jax.Array:
+    """reference: deepspeed.comm.reduce_scatter_tensor (comm/comm.py:332)."""
+    _log("reduce_scatter", x, axis)
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
+
+
+def all_to_all(x: jax.Array, axis: AxisName, *, split_dim: int,
+               concat_dim: int) -> jax.Array:
+    """reference: deepspeed.comm.all_to_all_single (comm/comm.py:388).
+
+    The workhorse of MoE dispatch (moe/sharded_moe.py:455 _AllToAll) and Ulysses
+    sequence parallelism (sequence/layer.py:15 single_all_to_all).
+    """
+    _log("all_to_all", x, axis)
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
+                          tiled=True)
+
+
+def permute(x: jax.Array, axis: AxisName, perm: Sequence[tuple]) -> jax.Array:
+    """Collective permute: (src, dst) pairs; the TPU-native p2p send/recv.
+
+    reference: runtime/pipe/p2p.py send/recv between adjacent pipeline stages —
+    here a single ppermute that XLA schedules on neighbor ICI links.
+    """
+    _log("ppermute", x, axis)
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def shift(x: jax.Array, axis: AxisName, offset: int = 1) -> jax.Array:
+    """Cyclic shift along a mesh axis (pipeline stage handoff / ring collectives)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return permute(x, axis, perm)
+
+
+def broadcast(x: jax.Array, axis: AxisName, root: int = 0) -> jax.Array:
+    """reference: deepspeed.comm.broadcast (comm/comm.py:222).
+
+    Implemented as select-root + psum (XLA lowers this to an efficient broadcast).
+    """
+    _log("broadcast", x, axis)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def barrier(axis: Optional[AxisName] = None) -> None:
+    """reference: deepspeed.comm.barrier (comm/comm.py:576).
+
+    Outside jit: block on all local device work.  Inside jit there is no barrier —
+    XLA's dataflow ordering makes it meaningless.
+    """
+    for d in jax.local_devices():
+        try:
+            d.synchronize_all_activity()  # newer jax
+        except AttributeError:  # pragma: no cover
+            pass
+    jax.effects_barrier()

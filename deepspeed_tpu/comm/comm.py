@@ -1,0 +1,147 @@
+"""Distributed init + comms logging.
+
+Reference parity:
+- ``init_distributed`` (deepspeed/comm/comm.py:604) with MPI/env rank discovery
+  (comm/comm.py:673 mpi_discovery) → here, ``jax.distributed.initialize`` plus
+  TPU-pod/GCE env autodetection (JAX does its own discovery on Cloud TPU).
+- ``CommsLogger`` (deepspeed/utils/comms_logging.py:67) with algo/bus bandwidth
+  calculation (calc_bw_log :34) and ``log_summary`` (comm/comm.py:422).
+
+Under jit, collective *timing* is not observable per-op (XLA fuses and overlaps them —
+that is the point), so the logger records trace-time op records (name, axis, bytes,
+count) and bandwidth is derived offline from the profiler; eager-mode calls are timed
+directly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_initialized = False
+_init_lock = threading.Lock()
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     **kwargs) -> None:
+    """Initialize the multi-host JAX runtime (no-op on single host).
+
+    Replaces torch.distributed.init_process_group rendezvous
+    (reference comm/comm.py:604 + comm/torch.py:99,140).  On Cloud TPU,
+    jax.distributed.initialize autodetects coordinator/rank from the TPU metadata
+    server; on CPU fleets the caller passes them explicitly (or sets
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    """
+    global _initialized
+    with _init_lock:
+        if _initialized:
+            return
+        multi_host = (
+            coordinator_address is not None
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or (num_processes or 0) > 1
+            or int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1
+        )
+        if multi_host:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+            logger.info(
+                "initialized jax distributed: process %d / %d",
+                jax.process_index(), jax.process_count())
+        _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+@dataclass
+class OpRecord:
+    count: int = 0
+    total_bytes: int = 0
+    total_time_s: float = 0.0  # eager-mode only
+    axes: set = field(default_factory=set)
+
+
+class CommsLogger:
+    """Per-op counts/bytes with bandwidth summary.
+
+    Mirrors reference utils/comms_logging.py:67 (CommsLogger) + calc_bw_log(:34).
+    Enabled via config ``comms_logger`` block or ``enable()``.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.records: Dict[str, OpRecord] = defaultdict(OpRecord)
+
+    def configure(self, enabled: bool = False, verbose: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+
+    def enable(self):
+        self.enabled = True
+
+    def record(self, name: str, nbytes: int, axis: str, time_s: float = 0.0):
+        if not self.enabled:
+            return
+        rec = self.records[name]
+        rec.count += 1
+        rec.total_bytes += int(nbytes)
+        rec.total_time_s += time_s
+        rec.axes.add(axis)
+        if self.verbose:
+            logger.info("comm op=%s axis=%s bytes=%d", name, axis, nbytes)
+
+    def log_summary(self) -> List[str]:
+        """Summary lines: op, count, total bytes, (eager) algo bandwidth."""
+        lines = []
+        for name, rec in sorted(self.records.items()):
+            bw = (rec.total_bytes / rec.total_time_s / 1e9) if rec.total_time_s else 0.0
+            lines.append(
+                f"{name:: <24} count={rec.count} bytes={rec.total_bytes} "
+                f"axes={sorted(rec.axes)} algo_bw={bw:.2f}GB/s")
+        for line in lines:
+            logger.info(line)
+        return lines
+
+    def reset(self):
+        self.records.clear()
+
+
+comms_logger = CommsLogger()
+
+
+def get_comms_logger() -> CommsLogger:
+    return comms_logger
+
+
+class timed_region:
+    """Context manager for timing eager (non-jit) comm ops; inert inside traces."""
+
+    def __init__(self, name: str, nbytes: int, axis: str):
+        self.name, self.nbytes, self.axis = name, nbytes, axis
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        comms_logger.record(self.name, self.nbytes, self.axis,
+                            time.perf_counter() - self.t0)
+        return False

@@ -1,0 +1,63 @@
+"""Config keys and defaults.
+
+TPU-native analog of the reference's ``deepspeed/runtime/constants.py`` (457 LoC of
+string keys + defaults). We keep the same JSON surface where it makes sense so a
+DeepSpeed user can bring their ds_config.json mostly unchanged.
+"""
+
+#############################################
+# Batch triad (reference: runtime/constants.py TRAIN_BATCH_SIZE et al.)
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+#############################################
+# Optimizer / scheduler
+#############################################
+OPTIMIZER = "optimizer"
+SCHEDULER = "scheduler"
+OPTIMIZER_TYPE_DEFAULT = "adamw"
+MAX_GRAD_NORM = "max_grad_norm"
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+
+#############################################
+# Precision (reference: fp16/bf16 blocks, runtime/config.py)
+#############################################
+FP16 = "fp16"
+BF16 = "bf16"
+INITIAL_LOSS_SCALE = "initial_scale_power"
+LOSS_SCALE_WINDOW = "loss_scale_window"
+MIN_LOSS_SCALE = "min_loss_scale"
+HYSTERESIS = "hysteresis"
+
+#############################################
+# ZeRO (reference: runtime/zero/config.py)
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+
+#############################################
+# Misc engine knobs
+#############################################
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+SEED = "seed"
+SEED_DEFAULT = 42
+
+# "auto" sentinel — resolved from model/runtime context like the reference's
+# HF-integration "auto" values (reference: runtime/config.py).
+AUTO = "auto"
+
+# Mesh axis names, fixed order (outermost to innermost / slowest to fastest
+# varying).  DCN-crossing axes first, ICI axes last, so collectives on tp/sp
+# ride ICI.  This replaces the reference's process-group zoo
+# (utils/groups.py, runtime/pipe/topology.py).
+MESH_AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+# Logical axis names used by models (flax partitioning metadata); mapped to
+# mesh axes by sharding rules in parallel/partition.py.
+LOGICAL_BATCH_AXES = ("dp", "fsdp")

@@ -1,0 +1,276 @@
+"""Decoder-only transformer LM (GPT-2 / Llama family) — the flagship model.
+
+This plays the role of the reference's model zoo entries (GPT-2/Llama policies in
+module_inject/containers/{gpt2,llama}.py and inference/v2/model_implementations/
+llama_v2) but as a TPU-first flax module:
+
+- every parameter carries logical sharding axes via ``nn.with_partitioning``
+  (mapped to mesh axes by parallel/partition.py — TP/FSDP/SP fall out of the
+  annotations instead of graph surgery)
+- pre-norm blocks, optional RoPE + RMSNorm (llama style) or learned positions +
+  LayerNorm (gpt2 style), gated (SwiGLU) or GELU MLP
+- causal attention via a single fused einsum path XLA maps onto the MXU;
+  flash-attention Pallas kernel is swapped in by ops/ when enabled
+- ``remat`` applies jax.checkpoint per block (reference:
+  runtime/activation_checkpointing/checkpointing.py)
+
+call contract: ``model.apply(params, batch, rngs={"dropout": k}) -> scalar loss``
+where batch = {"input_ids": [B, T] int32, optional "labels": [B, T],
+optional "loss_mask": [B, T]}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = object
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: Dtype = jnp.float32          # compute dtype (engine casts params)
+    param_dtype: Dtype = jnp.float32
+    use_rope: bool = False              # llama-style when True
+    use_rmsnorm: bool = False
+    gated_mlp: bool = False             # SwiGLU
+    num_kv_heads: Optional[int] = None  # GQA; defaults to num_heads
+    remat: bool = False
+    tie_embeddings: bool = True
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.hidden_size * self.mlp_ratio
+
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(num_layers=12, num_heads=12, head_dim=64, hidden_size=768, **kw)
+
+    @classmethod
+    def llama(cls, num_layers=8, hidden=512, heads=8, **kw):
+        return cls(num_layers=num_layers, hidden_size=hidden, num_heads=heads,
+                   head_dim=hidden // heads, use_rope=True, use_rmsnorm=True,
+                   gated_mlp=True, tie_embeddings=False, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq_len", 128)
+        return cls(num_layers=2, num_heads=4, head_dim=8, hidden_size=32,
+                   mlp_ratio=2, **kw)
+
+
+def _kernel_init():
+    return nn.initializers.normal(stddev=0.02)
+
+
+def _part(init, names):
+    return nn.with_partitioning(init, names)
+
+
+def rope(q, k, positions, head_dim, base=10000.0):
+    """Rotary position embedding (reference CUDA kernel:
+    csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu — on TPU a few
+    elementwise ops XLA fuses into the attention matmuls)."""
+    half = head_dim // 2
+    freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freq  # [B,T,half]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        s = sin[:, :, None, :].astype(x.dtype)
+        c = cos[:, :, None, :].astype(x.dtype)
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+    return rot(q), rot(k)
+
+
+class Norm(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        scale = self.param("scale", _part(nn.initializers.ones, ("embed",)),
+                           (c.hidden_size,), c.param_dtype)
+        if c.use_rmsnorm:
+            var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                           keepdims=True)
+            y = x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)
+            return y * scale.astype(x.dtype)
+        bias = self.param("bias", _part(nn.initializers.zeros, ("embed",)),
+                          (c.hidden_size,), c.param_dtype)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + 1e-5)
+        return y * scale.astype(x.dtype) + bias.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool):
+        c = self.cfg
+        B, T, H = x.shape
+        nh, nkv, hd = c.num_heads, c.kv_heads, c.head_dim
+
+        wq = self.param("wq", _part(_kernel_init(), ("embed", "heads", "kv")),
+                        (H, nh, hd), c.param_dtype)
+        wk = self.param("wk", _part(_kernel_init(), ("embed", "heads", "kv")),
+                        (H, nkv, hd), c.param_dtype)
+        wv = self.param("wv", _part(_kernel_init(), ("embed", "heads", "kv")),
+                        (H, nkv, hd), c.param_dtype)
+        wo = self.param("wo", _part(_kernel_init(), ("heads", "kv", "embed")),
+                        (nh, hd, H), c.param_dtype)
+
+        q = jnp.einsum("bth,hnd->btnd", x, wq.astype(x.dtype))
+        k = jnp.einsum("bth,hnd->btnd", x, wk.astype(x.dtype))
+        v = jnp.einsum("bth,hnd->btnd", x, wv.astype(x.dtype))
+
+        if c.use_rope:
+            q, k = rope(q, k, positions, hd)
+
+        if nkv != nh:  # GQA: repeat kv heads
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        scale = hd ** -0.5
+        logits = jnp.einsum("btnd,bsnd->bnts", q, k) * scale
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        logits = jnp.where(mask[None, None, :, :], logits,
+                           jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+        if c.dropout > 0 and not deterministic:
+            probs = nn.Dropout(rate=c.dropout)(probs, deterministic=False)
+        out = jnp.einsum("bnts,bsnd->btnd", probs, v)
+        return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype))
+
+
+class MLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        c = self.cfg
+        H, M = c.hidden_size, c.mlp_dim
+        wi = self.param("wi", _part(_kernel_init(), ("embed", "mlp")),
+                        (H, M), c.param_dtype)
+        wo = self.param("wo", _part(_kernel_init(), ("mlp", "embed")),
+                        (M, H), c.param_dtype)
+        h = x @ wi.astype(x.dtype)
+        if c.gated_mlp:
+            wg = self.param("wg", _part(_kernel_init(), ("embed", "mlp")),
+                            (H, M), c.param_dtype)
+            h = nn.silu(x @ wg.astype(x.dtype)) * h
+        else:
+            h = nn.gelu(h)
+        if c.dropout > 0 and not deterministic:
+            h = nn.Dropout(rate=c.dropout)(h, deterministic=False)
+        return h @ wo.astype(x.dtype)
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool):
+        x = x + Attention(self.cfg)(Norm(self.cfg)(x), positions, deterministic)
+        x = x + MLP(self.cfg)(Norm(self.cfg)(x), deterministic)
+        return x
+
+
+class GPTBackbone(nn.Module):
+    """Token ids → final hidden states (used by both the LM loss wrapper and,
+    later, the inference engine)."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        c = self.cfg
+        B, T = input_ids.shape
+        emb = self.param("wte", _part(_kernel_init(), ("vocab", "embed")),
+                         (c.vocab_size, c.hidden_size), c.param_dtype)
+        x = emb.astype(c.dtype)[input_ids]
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        if not c.use_rope:
+            pos_emb = self.param("wpe", _part(_kernel_init(), (None, "embed")),
+                                 (c.max_seq_len, c.hidden_size), c.param_dtype)
+            x = x + pos_emb.astype(c.dtype)[positions]
+        if c.dropout > 0 and not deterministic:
+            x = nn.Dropout(rate=c.dropout)(x, deterministic=False)
+
+        block_cls = Block
+        if c.remat:
+            block_cls = nn.remat(Block, static_argnums=(3,),
+                                 policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(c.num_layers):
+            x = block_cls(c, name=f"block_{i}")(x, positions, deterministic)
+        x = Norm(c, name="final_norm")(x)
+        return x, emb
+
+
+class GPT(nn.Module):
+    """LM-loss wrapper satisfying the engine's model contract."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = False):
+        c = self.cfg
+        input_ids = batch["input_ids"]
+        x, emb = GPTBackbone(c, name="backbone")(input_ids, deterministic)
+        if c.tie_embeddings:
+            logits = jnp.einsum("bth,vh->btv", x, emb.astype(x.dtype))
+        else:
+            head = self.param("lm_head", _part(_kernel_init(), ("embed", "vocab")),
+                              (c.hidden_size, c.vocab_size), c.param_dtype)
+            logits = x @ head.astype(x.dtype)
+
+        labels = batch.get("labels")
+        if labels is None:  # next-token LM
+            labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)))
+            mask = jnp.ones_like(labels, dtype=jnp.float32).at[:, -1].set(0.0)
+        else:
+            mask = batch.get("loss_mask",
+                             jnp.ones_like(labels, dtype=jnp.float32))
+            mask = mask.astype(jnp.float32) * (labels >= 0)
+            labels = jnp.maximum(labels, 0)
+
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def count_params(cfg: GPTConfig) -> int:
+    H, M, V = cfg.hidden_size, cfg.mlp_dim, cfg.vocab_size
+    per_layer = (cfg.num_heads * cfg.head_dim * H * 2          # wq, wo
+                 + cfg.kv_heads * cfg.head_dim * H * 2         # wk, wv
+                 + H * M * (3 if cfg.gated_mlp else 2)         # mlp
+                 + H * (2 if cfg.use_rmsnorm else 4))          # norms
+    total = per_layer * cfg.num_layers + V * H + H
+    if not cfg.use_rope:
+        total += cfg.max_seq_len * H
+    if not cfg.tie_embeddings:
+        total += V * H
+    return total

@@ -1,0 +1,12 @@
+from deepspeed_tpu.parallel.mesh import (MeshSpec, batch_pspec, batch_sharding,
+                                         build_mesh, replicated,
+                                         single_device_mesh)
+from deepspeed_tpu.parallel.partition import (infer_pspec, logical_to_mesh_pspec,
+                                              opt_state_shardings,
+                                              param_shardings)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "single_device_mesh", "batch_sharding",
+    "batch_pspec", "replicated", "param_shardings", "opt_state_shardings",
+    "infer_pspec", "logical_to_mesh_pspec",
+]

@@ -1,0 +1,135 @@
+"""LR schedules.
+
+Reference parity: runtime/lr_schedules.py (878 LoC) — WarmupLR, WarmupDecayLR,
+WarmupCosineLR, OneCycle, LRRangeTest, configured via the "scheduler" config block.
+Here each schedule is a pure ``step -> lr`` function (optax schedule), which the
+engine threads into the optimizer; the schedule itself carries no state.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict
+
+import optax
+
+Schedule = Callable[[int], float]
+
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+
+def _warmup(step, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type):
+    """Warmup ramp used by all Warmup* schedules (reference
+    lr_schedules.py WarmupLR._get_gamma)."""
+    import jax.numpy as jnp
+    frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+    if warmup_type == WARMUP_LOG_RATE:
+        # reference: gamma = log(step+1)/log(warmup_steps+1)
+        frac = jnp.log1p(step.astype(jnp.float32) if hasattr(step, "astype") else step)
+        frac = jnp.clip(frac / math.log(warmup_num_steps + 1), 0.0, 1.0)
+    return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = WARMUP_LOG_RATE, **_) -> Schedule:
+    """WarmupLR (reference lr_schedules.py): ramp to max then hold."""
+    def sched(step):
+        return _warmup(step, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                       warmup_type)
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = WARMUP_LOG_RATE, **_) -> Schedule:
+    """WarmupDecayLR: warmup then linear decay to 0 at total_num_steps."""
+    def sched(step):
+        import jax.numpy as jnp
+        w = _warmup(step, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, w, warmup_max_lr * decay)
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 0.001,
+                     warmup_type: str = WARMUP_LINEAR_RATE, **_) -> Schedule:
+    """WarmupCosineLR (reference lr_schedules.py WarmupCosineLR)."""
+    def sched(step):
+        import jax.numpy as jnp
+        w = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            step / max(warmup_num_steps, 1), 0.0, 1.0)
+        progress = jnp.clip(
+            (step - warmup_num_steps) / max(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (
+            1 + jnp.cos(math.pi * progress))
+        ratio = jnp.where(step < warmup_num_steps, w, cos)
+        return warmup_max_lr * ratio
+    return sched
+
+
+def one_cycle(cycle_min_lr: float = 1e-5, cycle_max_lr: float = 1e-3,
+              cycle_first_step_size: int = 1000,
+              cycle_second_step_size: int = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0, **_) -> Schedule:
+    """OneCycle (reference lr_schedules.py OneCycle), LR part only — momentum
+    cycling is handled by optax.inject_hyperparams if requested."""
+    second = cycle_second_step_size or cycle_first_step_size
+
+    def sched(step):
+        import jax.numpy as jnp
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (
+            step / max(cycle_first_step_size, 1))
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * (
+            (step - cycle_first_step_size) / max(second, 1))
+        end = cycle_first_step_size + second
+        decayed = cycle_min_lr
+        if decay_step_size > 0:
+            decayed = cycle_min_lr / (1 + (step - end) // decay_step_size
+                                      * decay_lr_rate)
+        lr = jnp.where(step < cycle_first_step_size, up,
+                       jnp.where(step < end, down, decayed))
+        return jnp.maximum(lr, 0.0)
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False, **_) -> Schedule:
+    """LRRangeTest (reference lr_schedules.py LRRangeTest)."""
+    def sched(step):
+        import jax.numpy as jnp
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1 + interval * lr_range_test_step_rate)
+    return sched
+
+
+_REGISTRY = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+}
+
+
+def build_schedule(name: str, params: Dict[str, Any]) -> Schedule:
+    """Build from a "scheduler" config block (reference runtime/config.py
+    get_scheduler_params → engine._configure_lr_scheduler)."""
+    key = name.lower().replace("_", "")
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown scheduler {name!r}; supported: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**params)
+
+
+def constant(lr: float) -> Schedule:
+    return optax.constant_schedule(lr)

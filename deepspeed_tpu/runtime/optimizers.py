@@ -1,0 +1,106 @@
+"""Optimizer factory.
+
+TPU-native replacement for the reference's fused/native optimizers:
+- FusedAdam (csrc/adam/fused_adam_frontend.cpp + multi_tensor_adam.cu, 617 LoC CUDA)
+- DeepSpeedCPUAdam (csrc/adam/cpu_adam.cpp, AVX)
+- FusedLamb (csrc/lamb/), FusedLion/CPULion (csrc/lion/), CPUAdagrad (csrc/adagrad/)
+- OnebitAdam / OnebitLamb / ZeroOneAdam (runtime/fp16/onebit/)
+
+On TPU the "fused multi-tensor" machinery is unnecessary: optax updates are
+elementwise chains that XLA fuses into a handful of kernels over each parameter
+buffer, and sharded (ZeRO) state means each chip only touches its shard.  What
+remains worth building natively is the *host offload* path (CPU Adam on the TPU VM,
+see csrc/ and runtime/zero/offload.py) — that mirrors cpu_adam.cpp.
+
+The 1-bit optimizers' error-feedback compression targets Ethernet-bandwidth
+clusters; over ICI it is counterproductive (SURVEY.md §7).  We expose the same
+optimizer names, implemented as their base optimizers plus optional DCN-tier
+gradient compression configured via ``gradient_compression`` (engine-level).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import optax
+
+# Names the reference accepts in the "optimizer" config block
+# (runtime/engine.py:1269 _configure_basic_optimizer; constants ADAM_OPTIMIZER etc.)
+_CANON = {
+    "adam": "adam",
+    "adamw": "adamw",
+    "fusedadam": "adamw",       # fused == XLA-fused here
+    "lamb": "lamb",
+    "fusedlamb": "lamb",
+    "onebitadam": "adam",       # see module docstring: compression handled at comm tier
+    "onebitlamb": "lamb",
+    "zerooneadam": "adam",
+    "lion": "lion",
+    "fusedlion": "lion",
+    "adagrad": "adagrad",
+    "sgd": "sgd",
+    "muon": "muon",
+}
+
+
+def supported_optimizers():
+    return sorted(set(_CANON))
+
+
+def _pop(params: Dict[str, Any], key: str, default):
+    return params.pop(key, default)
+
+
+def build_optimizer(name: str, params: Optional[Dict[str, Any]] = None,
+                    ) -> Tuple[optax.GradientTransformation, Dict[str, Any]]:
+    """Build an optax optimizer from a DeepSpeed-style optimizer config block.
+
+    Returns (transformation, resolved_params).  The learning rate may later be
+    overridden by an LR schedule via optax.inject_hyperparams-style wiring in the
+    engine (reference: lr_scheduler passed to deepspeed.initialize).
+    """
+    params = dict(params or {})
+    canon = _CANON.get(name.lower().replace("_", ""))
+    if canon is None:
+        raise ValueError(
+            f"unknown optimizer {name!r}; supported: {supported_optimizers()}")
+
+    lr = _pop(params, "lr", 1e-3)
+    weight_decay = _pop(params, "weight_decay", 0.0)
+    betas = tuple(_pop(params, "betas", (0.9, 0.999)))
+    eps = _pop(params, "eps", 1e-8)
+
+    if canon == "adam":
+        # torch Adam applies weight decay as L2 into the gradient
+        tx = optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    elif canon == "adamw":
+        tx = optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps,
+                         weight_decay=weight_decay)
+    elif canon == "lamb":
+        tx = optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps,
+                        weight_decay=weight_decay)
+    elif canon == "lion":
+        b1, b2 = (betas if len(betas) == 2 else (0.9, 0.99))
+        tx = optax.lion(lr, b1=b1, b2=b2, weight_decay=weight_decay)
+    elif canon == "adagrad":
+        tx = optax.adagrad(lr, eps=eps)
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    elif canon == "sgd":
+        momentum = _pop(params, "momentum", 0.0)
+        tx = optax.sgd(lr, momentum=momentum or None,
+                       nesterov=_pop(params, "nesterov", False))
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    elif canon == "muon":
+        try:
+            tx = optax.contrib.muon(lr)
+        except AttributeError as e:  # older optax
+            raise ValueError("muon requires optax with optax.contrib.muon") from e
+    else:  # pragma: no cover
+        raise AssertionError(canon)
+
+    resolved = dict(lr=lr, weight_decay=weight_decay, betas=betas, eps=eps, **params)
+    return tx, resolved

@@ -1,0 +1,105 @@
+"""Mixed precision: bf16/fp16 policies + dynamic loss scaling.
+
+Reference parity:
+- ``BF16_Optimizer`` (runtime/bf16_optimizer.py:34): bf16 params with fp32 master
+  copy, no loss scaling.  Here: fp32 master params live in the train state; the
+  jitted step casts to the compute dtype for fwd/bwd (casting is fused by XLA —
+  no separate "optimizer wrapper" object needed).
+- ``DynamicLossScaler`` / ``LossScaler`` (runtime/fp16/loss_scaler.py:91,67) and the
+  overflow check (``has_overflow_serial`` :141, CheckOverflow runtime/utils.py):
+  implemented *inside* the jitted train step as a functional state machine —
+  overflow ⇒ skip the update and halve the scale; ``scale_window`` clean steps ⇒
+  double it.  This is the fp16 path; bf16 uses the static unit scale.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config import FP16Config
+
+
+class LossScaleState(NamedTuple):
+    """Carried in TrainState; all fields are scalars so the state is trivially
+    replicated."""
+
+    scale: jnp.ndarray          # f32 current loss scale
+    growth_counter: jnp.ndarray  # i32 consecutive non-overflow steps
+    hysteresis: jnp.ndarray      # i32 remaining tolerated overflows before backoff
+    skipped: jnp.ndarray         # i32 total skipped steps (reporting parity:
+    #                              reference engine.skipped_steps)
+
+
+def init_loss_scale(cfg: FP16Config) -> LossScaleState:
+    if not cfg.enabled:
+        scale = 1.0
+    elif cfg.loss_scale > 0:  # static scale (reference LossScaler:67)
+        scale = cfg.loss_scale
+    else:  # dynamic (reference DynamicLossScaler:91)
+        scale = 2.0 ** cfg.initial_scale_power
+    return LossScaleState(
+        scale=jnp.float32(scale),
+        growth_counter=jnp.int32(0),
+        hysteresis=jnp.int32(cfg.hysteresis),
+        skipped=jnp.int32(0),
+    )
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """Global all-finite check over a grad pytree (reference: has_overflow_serial,
+    fp16/loss_scaler.py:141; the cross-rank allreduce of the overflow flag is implicit
+    here — the check runs on the global jax.Array view)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.bool_(True)
+    for leaf in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+    return finite
+
+
+def update_loss_scale(state: LossScaleState, finite: jnp.ndarray,
+                      cfg: FP16Config) -> LossScaleState:
+    """Functional DynamicLossScaler.update_scale (fp16/loss_scaler.py:116).
+
+    Static scale (loss_scale > 0) or fp16 disabled: state is frozen except the
+    skipped counter.
+    """
+    if not cfg.enabled or cfg.loss_scale > 0:
+        return state._replace(
+            skipped=state.skipped + jnp.where(finite, 0, 1).astype(jnp.int32))
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        hyst = s.hysteresis - 1
+        new_scale = jnp.where(
+            hyst <= 0,
+            jnp.maximum(s.scale / 2.0, cfg.min_loss_scale),
+            s.scale)
+        return LossScaleState(
+            scale=new_scale,
+            growth_counter=jnp.int32(0),
+            hysteresis=jnp.maximum(hyst, 1),
+            skipped=s.skipped + 1,
+        )
+
+    def on_clean(s: LossScaleState) -> LossScaleState:
+        counter = s.growth_counter + 1
+        grow = counter >= cfg.loss_scale_window
+        return LossScaleState(
+            scale=jnp.where(grow, s.scale * 2.0, s.scale),
+            growth_counter=jnp.where(grow, 0, counter).astype(jnp.int32),
+            hysteresis=jnp.int32(cfg.hysteresis),
+            skipped=s.skipped,
+        )
+
+    return jax.lax.cond(finite, on_clean, on_overflow, state)
+
+
+def cast_floating(tree, dtype):
+    """Cast floating leaves of a pytree to dtype (param cast for fwd/bwd)."""
+    def _cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(_cast, tree)

@@ -1,0 +1,62 @@
+"""ZeRO semantics: master-weight optimizer wrapper + stage documentation.
+
+Reference parity map (see parallel/partition.py for the sharding half):
+
+- fp32 master weights partitioned over DP
+  (stage_1_and_2.py single_partition_of_fp32_groups; stage3.py
+  _create_fp32_partitions:794) → ``with_master_weights`` below: the fp32 master
+  copy lives *inside the optax state*, so it inherits ZeRO state sharding
+  (sharded over fsdp at stage ≥ 1) while model params stay bf16/fp16.
+- grad reduce-scatter (stage_1_and_2.py:1361 reduce_ipg_grads; stage3.py:1249) →
+  XLA inserts psum-scatter when grads feed sharded state.
+- param all-gather (partition_parameters.py all_gather_coalesced) → XLA inserts
+  all-gather per consumer at stage 3; overlap via the latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MasterWeightsState(NamedTuple):
+    master: optax.Params  # fp32 copy, mirrors param tree → gets ZeRO state sharding
+    inner: optax.OptState
+
+
+def with_master_weights(inner: optax.GradientTransformation,
+                        ) -> optax.GradientTransformation:
+    """Wrap an optimizer to keep an fp32 master copy of low-precision params.
+
+    The returned update expects fp32 grads (cast upstream) and low-precision
+    ``params``; it computes the inner update against the fp32 master and emits a
+    delta that moves the low-precision params to ``cast(new_master)``.
+
+    Equivalent role: BF16_Optimizer (runtime/bf16_optimizer.py:34) and the fp32
+    flat partitions of ZeRO 1/2/3.
+    """
+
+    def init(params):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        return MasterWeightsState(master=master, inner=inner.init(master))
+
+    def update(grads, state, params=None, **kw):
+        f32_grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+        updates, new_inner = inner.update(f32_grads, state.inner, state.master, **kw)
+        new_master = optax.apply_updates(state.master, updates)
+        if params is None:
+            raise ValueError("with_master_weights requires params")
+        deltas = jax.tree_util.tree_map(
+            lambda m, p: (m.astype(p.dtype) - p).astype(p.dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else jnp.zeros_like(p),
+            new_master, params)
+        return deltas, MasterWeightsState(master=new_master, inner=new_inner)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,3 @@
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+__all__ = ["logger", "log_dist"]

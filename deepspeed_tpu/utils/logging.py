@@ -1,0 +1,45 @@
+"""Logging utilities (reference: deepspeed/utils/logging.py — logger + log_dist)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional, Sequence
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        lg.setLevel(os.environ.get("DS_TPU_LOG_LEVEL", level))
+        handler = logging.StreamHandler(stream=sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
+        lg.addHandler(handler)
+        lg.propagate = False
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # avoid importing jax at module import time for fast CLI startup
+    import jax
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Sequence[int]] = None,
+             level=logging.INFO) -> None:
+    """Log only on the given process ranks (reference: utils/logging.py log_dist).
+
+    ranks=None or [-1] logs on every process; JAX process index replaces the
+    torch.distributed rank.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")

@@ -1,0 +1,42 @@
+"""Test harness.
+
+Reference analog: tests/unit/common.py DistributedTest — the reference forks N
+torch.multiprocessing workers to simulate a cluster.  On JAX we instead run a
+*virtual 8-device CPU mesh* in-process (SPMD is compiled, not process-orchestrated),
+set up here before jax import.  Multi-process behavior is covered by the driver's
+``dryrun_multichip`` entry point.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) forces jax_platforms="axon,cpu" at
+# interpreter startup; backends are not yet initialized here, so win it back.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_lm_batch(rng, batch, seq, vocab):
+    """Synthetic memorization task batch."""
+    ids = rng.integers(0, vocab, size=(batch, seq), dtype=np.int64).astype(np.int32)
+    return {"input_ids": ids}

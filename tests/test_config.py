@@ -1,0 +1,70 @@
+"""Config system tests (reference analog: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
+
+
+def test_defaults():
+    cfg = parse_config(None)
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.fp16.enabled and not cfg.bf16.enabled
+
+
+def test_parse_dict_deepspeed_surface():
+    cfg = parse_config({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_max_lr": 3e-4, "warmup_num_steps": 10}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "overlap_comm": True},
+        "gradient_clipping": 1.0,
+    })
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.bf16.enabled
+    assert cfg.optimizer.params["lr"] == 3e-4
+
+
+def test_parse_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_micro_batch_size_per_gpu": 4,
+                             "fp16": {"enabled": True}}))
+    cfg = parse_config(str(p))
+    assert cfg.fp16.enabled
+
+
+def test_batch_triad_resolution():
+    cfg = parse_config({"train_batch_size": 32,
+                        "train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+    cfg = parse_config({"train_batch_size": 32,
+                        "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_size(dp_world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+    cfg = parse_config({"train_micro_batch_size_per_gpu": 2})
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.train_batch_size == 16
+    assert cfg.gradient_accumulation_steps == 1
+
+    cfg = parse_config({})
+    cfg.resolve_batch_size(dp_world_size=8)
+    assert cfg.train_batch_size == 8
+
+
+def test_batch_triad_inconsistent():
+    cfg = parse_config({"train_batch_size": 30,
+                        "train_micro_batch_size_per_gpu": 4})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_size(dp_world_size=4)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        parse_config({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
