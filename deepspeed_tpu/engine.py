@@ -109,6 +109,10 @@ class DeepSpeedTPUEngine:
         self.gas = int(config.gradient_accumulation_steps)
 
         # ---- model functions ----
+        # bind the engine's mesh into mesh-aware models (MoE ep route, Ulysses)
+        if (hasattr(model, "clone") and hasattr(model, "mesh")
+                and model.mesh is None):
+            model = model.clone(mesh=self.mesh)
         if isinstance(model, tuple):
             self._init_fn, self._apply_fn = model
         else:
@@ -124,6 +128,23 @@ class DeepSpeedTPUEngine:
             self.lr_schedule = lr_schedules.build_schedule(
                 config.scheduler.type, config.scheduler.params)
         self.optimizer, self._opt_params = self._build_tx(client_optimizer)
+
+        # normalize the example batch's leading dim to the global microbatch so
+        # init tracing and the jitted step see shardable shapes; only leaves
+        # sharing the example's batch dim are tiled (non-batch leaves pass through)
+        micro_global = (int(config.train_micro_batch_size_per_gpu)
+                        * self.dp_world_size)
+        leaves = jax.tree_util.tree_leaves(example_batch)
+        example_bs = np.asarray(leaves[0]).shape[0] if leaves else 0
+
+        def _tile(x):
+            x = np.asarray(x)
+            if (x.ndim == 0 or x.shape[0] != example_bs
+                    or x.shape[0] == micro_global):
+                return x
+            reps = -(-micro_global // x.shape[0])
+            return np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:micro_global]
+        example_batch = jax.tree_util.tree_map(_tile, example_batch)
 
         # ---- abstract shapes + shardings (zero.Init analog: params are created
         #      already sharded; reference partition_parameters.py:808) ----
@@ -329,13 +350,20 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ data
 
     def _shard_batch(self, batch, leading_gas: bool = False):
-        """Place a host batch onto the mesh, sharded over (dp, fsdp)."""
+        """Place a host batch onto the mesh: batch dim over (dp, fsdp); the
+        sequence dim (dim 1 of each microbatch) over sp when Ulysses sequence
+        parallelism is active."""
+        sp = "sp" if self.mesh.shape["sp"] > 1 else None
+
         def put(x):
             x = np.asarray(x)
             extra = x.ndim - 1 - (1 if leading_gas else 0)
-            spec = (P(None, ("dp", "fsdp"), *([None] * extra)) if leading_gas
-                    else P(("dp", "fsdp"), *([None] * extra)))
-            return jax.device_put(x, NamedSharding(self.mesh, spec))
+            dims = [("dp", "fsdp")] + [None] * extra
+            if sp and extra >= 1:
+                dims[1] = sp
+            if leading_gas:
+                dims = [None] + dims
+            return jax.device_put(x, NamedSharding(self.mesh, P(*dims)))
         return jax.tree_util.tree_map(put, batch)
 
     def _reshape_gas(self, batch):

@@ -49,6 +49,14 @@ class GPTConfig:
     num_kv_heads: Optional[int] = None  # GQA; defaults to num_heads
     remat: bool = False
     tie_embeddings: bool = True
+    # MoE (reference deepspeed.moe; Mixtral-style when num_experts > 0)
+    num_experts: int = 0
+    moe_k: int = 1
+    moe_every: int = 2                  # MoE replaces MLP every Nth block
+    moe_aux_coef: float = 0.01
+    moe_capacity_factor: float = 1.25
+    # parallelism (mesh passed separately to the GPT module attribute)
+    sequence_parallel: bool = False     # Ulysses attention over the sp axis
 
     @property
     def kv_heads(self) -> int:
@@ -123,8 +131,32 @@ class Norm(nn.Module):
         return y * scale.astype(x.dtype) + bias.astype(x.dtype)
 
 
+def causal_attend(q, k, v, probs_dropout=None):
+    """Plain causal softmax attention on [B, T, N, D] (the "local attention" in
+    reference sequence/layer.py terms).  Swappable for the Pallas flash kernel.
+
+    GQA k/v with fewer heads than q are expanded here, *after* any Ulysses
+    all-to-all, so sequence parallelism moves only the true KV volume.
+    """
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    T = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("btnd,bsnd->bnts", q, k) * scale
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    logits = jnp.where(mask[None, None, :, :], logits,
+                       jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if probs_dropout is not None:
+        probs = probs_dropout(probs)
+    return jnp.einsum("bnts,bsnd->btnd", probs, v)
+
+
 class Attention(nn.Module):
     cfg: GPTConfig
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, positions, deterministic: bool):
@@ -148,20 +180,21 @@ class Attention(nn.Module):
         if c.use_rope:
             q, k = rope(q, k, positions, hd)
 
-        if nkv != nh:  # GQA: repeat kv heads
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
-        scale = hd ** -0.5
-        logits = jnp.einsum("btnd,bsnd->bnts", q, k) * scale
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        logits = jnp.where(mask[None, None, :, :], logits,
-                           jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
-        if c.dropout > 0 and not deterministic:
-            probs = nn.Dropout(rate=c.dropout)(probs, deterministic=False)
-        out = jnp.einsum("bnts,bsnd->btnd", probs, v)
+        if (c.sequence_parallel and self.mesh is not None
+                and self.mesh.shape["sp"] > 1):
+            # Ulysses: seq-shard → head-shard swap around local attention.
+            # Dropout falls on the attention *output* here (rng plumbing inside
+            # shard_map isn't worth it); local path keeps standard prob-dropout.
+            from deepspeed_tpu.sequence import ulysses_attention
+            out = ulysses_attention(causal_attend, self.mesh, q, k, v)
+            if c.dropout > 0 and not deterministic:
+                out = nn.Dropout(rate=c.dropout)(out, deterministic=False)
+        else:
+            pdrop = None
+            if c.dropout > 0 and not deterministic:
+                pdrop = lambda p: nn.Dropout(rate=c.dropout)(  # noqa: E731
+                    p, deterministic=False)
+            out = causal_attend(q, k, v, probs_dropout=pdrop)
         return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype))
 
 
@@ -190,12 +223,29 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: GPTConfig
+    is_moe: bool = False
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, positions, deterministic: bool):
-        x = x + Attention(self.cfg)(Norm(self.cfg)(x), positions, deterministic)
-        x = x + MLP(self.cfg)(Norm(self.cfg)(x), deterministic)
-        return x
+        c = self.cfg
+        x = x + Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
+                                             deterministic)
+        if self.is_moe:
+            from deepspeed_tpu.moe import MoE
+            rng = (self.make_rng("dropout")
+                   if self.has_rng("dropout") else None)
+            moe_out, aux = MoE(hidden_size=c.hidden_size,
+                               num_experts=c.num_experts, k=c.moe_k,
+                               capacity_factor=c.moe_capacity_factor,
+                               mlp_ratio=c.mlp_ratio, mesh=self.mesh,
+                               param_dtype=c.param_dtype,
+                               name="moe")(Norm(c)(x), rng, deterministic)
+            x = x + moe_out
+        else:
+            aux = jnp.float32(0.0)
+            x = x + MLP(c)(Norm(c)(x), deterministic)
+        return x, aux
 
 
 class GPTBackbone(nn.Module):
@@ -203,6 +253,7 @@ class GPTBackbone(nn.Module):
     later, the inference engine)."""
 
     cfg: GPTConfig
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True):
@@ -223,22 +274,29 @@ class GPTBackbone(nn.Module):
         if c.remat:
             block_cls = nn.remat(Block, static_argnums=(3,),
                                  policy=jax.checkpoint_policies.nothing_saveable)
+        aux_total = jnp.float32(0.0)
         for i in range(c.num_layers):
-            x = block_cls(c, name=f"block_{i}")(x, positions, deterministic)
+            # reference examples put MoE on every other layer
+            is_moe = (c.num_experts > 0 and i % c.moe_every == c.moe_every - 1)
+            x, aux = block_cls(c, is_moe, self.mesh,
+                               name=f"block_{i}")(x, positions, deterministic)
+            aux_total = aux_total + aux
         x = Norm(c, name="final_norm")(x)
-        return x, emb
+        return x, emb, aux_total
 
 
 class GPT(nn.Module):
     """LM-loss wrapper satisfying the engine's model contract."""
 
     cfg: GPTConfig
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, batch, deterministic: bool = False):
         c = self.cfg
         input_ids = batch["input_ids"]
-        x, emb = GPTBackbone(c, name="backbone")(input_ids, deterministic)
+        x, emb, moe_aux = GPTBackbone(c, self.mesh,
+                                      name="backbone")(input_ids, deterministic)
         if c.tie_embeddings:
             logits = jnp.einsum("bth,vh->btv", x, emb.astype(x.dtype))
         else:
@@ -259,7 +317,10 @@ class GPT(nn.Module):
         logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if c.num_experts > 0:
+            loss = loss + c.moe_aux_coef * moe_aux
+        return loss
 
 
 def count_params(cfg: GPTConfig) -> int:

@@ -1,0 +1,4 @@
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import top1_gating, top2_gating, topk_gating
+
+__all__ = ["MoE", "top1_gating", "top2_gating", "topk_gating"]

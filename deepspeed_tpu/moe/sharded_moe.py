@@ -1,0 +1,96 @@
+"""Top-k gating for MoE.
+
+Reference parity: ``deepspeed/moe/sharded_moe.py`` — ``TopKGate`` (:372),
+``top1gating`` (:181), ``top2gating`` (:288): softmax router with capacity
+limits, optional jitter noise, load-balancing aux loss, GShard-style einsum
+dispatch/combine tensors.
+
+The einsum-dispatch formulation is *already* the TPU-native paradigm (it comes
+from GShard, which targeted TPU): everything is dense one-hot algebra that XLA
+maps onto the MXU — no scatter/gather kernels needed.
+
+Shapes: S tokens (per dispatch group), E experts, C capacity.
+Returns (aux_loss, combine [S,E,C] float, dispatch [S,E,C] bool).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int, k: int = 1) -> int:
+    """reference sharded_moe.py:_capacity — tokens-per-expert budget."""
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor * k))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n, dtype=jnp.float32):
+    return jax.nn.one_hot(idx, n, dtype=dtype)
+
+
+def topk_gating(logits: jax.Array, k: int, capacity_factor: float = 1.0,
+                min_capacity: int = 4, rng: Optional[jax.Array] = None,
+                noise_std: float = 0.0,
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Generic top-k gating (k=1 ≡ reference top1gating, k=2 ≡ top2gating).
+
+    Load-balancing aux loss = E * Σ_e mean(gate_e) * mean(assigned_e)
+    (reference sharded_moe.py:249) computed on the top-1 assignment.
+    """
+    S, E = logits.shape
+    C = _capacity(S, E, capacity_factor, min_capacity, k)
+    if rng is not None and noise_std > 0.0:
+        # reference: 'Jitter'/'RSample' noisy gate policy (sharded_moe.py:426)
+        logits = logits + jax.random.normal(rng, logits.shape,
+                                            logits.dtype) * noise_std
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [S, E]
+
+    remaining = gates
+    masks, gate_vals = [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)            # [S]
+        mask = _one_hot(idx, E)                         # [S, E]
+        masks.append(mask)
+        gate_vals.append(jnp.sum(gates * mask, axis=-1))  # [S]
+        remaining = remaining * (1.0 - mask)
+
+    # aux loss on the primary assignment
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    # normalize the k gate values (reference top2gating denominator)
+    denom = jnp.clip(sum(gate_vals), 1e-9, None)
+    gate_vals = [g / denom for g in gate_vals]
+
+    # positions within each expert queue, later choices stacked after earlier
+    combine = jnp.zeros((S, E, C), jnp.float32)
+    prior_counts = jnp.zeros((E,), jnp.float32)
+    for mask, gval in zip(masks, gate_vals):
+        loc = jnp.cumsum(mask, axis=0) - mask + prior_counts[None, :]  # [S, E]
+        pos = jnp.sum(loc * mask, axis=-1).astype(jnp.int32)           # [S]
+        keep = pos < C
+        gval = gval * keep
+        sc = _one_hot(pos, C)                                          # [S, C]
+        combine = combine + (gval[:, None] * mask)[..., None] * sc[:, None, :]
+        prior_counts = prior_counts + jnp.sum(mask, axis=0)
+
+    dispatch = combine > 0.0
+    return aux_loss, combine, dispatch
+
+
+def top1_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
+                noise_std=0.0):
+    """reference sharded_moe.py:181 top1gating."""
+    return topk_gating(logits, 1, capacity_factor, min_capacity, rng, noise_std)
+
+
+def top2_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
+                noise_std=0.0):
+    """reference sharded_moe.py:288 top2gating."""
+    return topk_gating(logits, 2, capacity_factor, min_capacity, rng, noise_std)

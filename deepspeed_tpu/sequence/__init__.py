@@ -1,0 +1,3 @@
+from deepspeed_tpu.sequence.ulysses import DistributedAttention, ulysses_attention
+
+__all__ = ["DistributedAttention", "ulysses_attention"]

@@ -1,0 +1,62 @@
+"""Ulysses sequence-parallelism tests (reference analog:
+tests/unit/sequence_parallelism — DistributedAttention correctness)."""
+
+import jax
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, GPTConfig
+from deepspeed_tpu.models.gpt import causal_attend
+from deepspeed_tpu.parallel.mesh import MeshSpec, build_mesh
+from deepspeed_tpu.sequence import DistributedAttention, ulysses_attention
+
+
+def test_ulysses_matches_local(devices):
+    """all-to-all head/seq swap must be numerically identical to local attention."""
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    B, T, N, D = 4, 32, 8, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, T, N, D))
+    k = jax.random.normal(k2, (B, T, N, D))
+    v = jax.random.normal(k3, (B, T, N, D))
+
+    ref = causal_attend(q, k, v)
+    with mesh:
+        out = jax.jit(
+            lambda q, k, v: ulysses_attention(causal_attend, mesh, q, k, v)
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_distributed_attention_wrapper(devices):
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    attn = DistributedAttention(causal_attend, mesh)
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 8))
+    with mesh:
+        out = jax.jit(attn)(q, q, q)
+    assert out.shape == q.shape
+
+
+def test_sp_gpt_trains(devices):
+    """GPT with Ulysses attention over sp=4 through the full engine."""
+    model = GPT(GPTConfig.tiny(vocab_size=64, max_seq_len=32,
+                               sequence_parallel=True))
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"dp": 1, "fsdp": 2, "sp": 4},
+        "steps_per_print": 0,
+    }
+    example = {"input_ids": np.zeros((4, 32), np.int32)}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg,
+                                               example_batch=example)
+    rng = np.random.default_rng(0)
+    pool = rng.integers(0, 64, size=(8, 32)).astype(np.int32)
+    losses = []
+    for _ in range(20):
+        idx = rng.integers(0, 8, size=(engine.train_batch_size,))
+        losses.append(float(engine.train_batch({"input_ids": pool[idx]}).loss))
+    assert losses[-1] < losses[0] * 0.8
