@@ -113,12 +113,20 @@ class DeepSpeedTPUEngine:
         if (hasattr(model, "clone") and hasattr(model, "mesh")
                 and model.mesh is None):
             model = model.clone(mesh=self.mesh)
+        # pipeline models consume all gas microbatches in one pipelined scan
+        # (reference: PipelineEngine.train_batch owns the microbatch loop)
+        self.gas_in_model = bool(getattr(model, "is_pipeline", False))
         if isinstance(model, tuple):
             self._init_fn, self._apply_fn = model
         else:
+            import flax.linen as fnn
             self._init_fn = lambda rng, batch: model.init(rng, batch)
-            self._apply_fn = lambda params, batch, rng: model.apply(
-                params, batch, rngs={"dropout": rng})
+            if isinstance(model, fnn.Module):
+                self._apply_fn = lambda params, batch, rng: model.apply(
+                    params, batch, rngs={"dropout": rng})
+            else:  # duck-typed (init/apply) object, e.g. PipeGPT
+                self._apply_fn = lambda params, batch, rng: model.apply(
+                    params, batch, rng)
         self.model = model
 
         # ---- optimizer + schedule (reference engine._configure_optimizer
@@ -314,6 +322,17 @@ class DeepSpeedTPUEngine:
     def _make_train_batch(self):
         gas = self.gas
 
+        if self.gas_in_model:
+            # pipeline path: the model's pipelined scan IS the microbatch loop;
+            # one grad computation over the whole [gas, micro, ...] batch
+            def train_batch_pipe(state: TrainState, batch):
+                grads, loss = self._grads_one_micro(state, batch, 0)
+                grads = self._unscale(grads, state.loss_scale.scale, 1)
+                new_state, metrics = self._apply_update(state, grads)
+                return new_state, metrics._replace(
+                    loss=loss.astype(jnp.float32))
+            return train_batch_pipe
+
         def train_batch(state: TrainState, batch):
             # batch leaves: [gas, micro_global, ...]
             def micro(carry, xs):
@@ -404,6 +423,12 @@ class DeepSpeedTPUEngine:
     def forward(self, batch):
         """Compatibility trio part 1 (reference engine.forward engine.py:1785):
         computes loss *and* grads for one microbatch, accumulating grads."""
+        if self.gas_in_model:
+            # parity: the reference PipelineEngine also only supports
+            # train_batch/eval_batch (pipe/engine.py:56 "only via train_batch")
+            raise RuntimeError(
+                "pipeline models only support train_batch(), not the "
+                "forward/backward/step trio")
         batch = self._shard_batch(batch)
         with self.mesh:
             grads, loss = self._jit_grad(self.state, batch,

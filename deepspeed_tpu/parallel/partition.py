@@ -50,6 +50,7 @@ DEFAULT_RULES = (
     ("seq", "sp"),
     ("expert", "ep"),
     ("layers", None),       # scan-over-layers leading axis stays unsharded
+    ("pp", "pp"),           # pipeline-stage-stacked leading axis (pipe/module.py)
 )
 
 

@@ -1,0 +1,4 @@
+from deepspeed_tpu.pipe.module import PipeGPT, gpt_params_to_pipe
+from deepspeed_tpu.pipe.schedule import pipeline_forward
+
+__all__ = ["PipeGPT", "gpt_params_to_pipe", "pipeline_forward"]

@@ -1,0 +1,243 @@
+"""Pipeline model container.
+
+Reference parity: ``runtime/pipe/module.py`` — ``PipelineModule`` (:86) holding a
+``LayerSpec`` list partitioned over stages (:370 _partition_layers), tied layers
+(``TiedLayerSpec`` :77), and ``runtime/pipe/topology.py`` grids.
+
+TPU-native: a pipelined model is the same flax block with its per-layer params
+*stacked* [S, L/S, ...] and the stage dim sharded over ``pp``
+(parallel/partition.py rule "pp"→pp).  Embedding + LM head are replicated over
+pp — the tied-embedding case (reference TiedLayerSpec + _exec_reduce_tied_grads)
+is then free: there is one logical embedding array, and XLA reduces its grads
+across everything that touched it.
+
+``PipeGPT`` presents the engine's ``(init_fn, apply_fn)`` contract with
+``is_pipeline = True``; the engine routes the whole [M, micro, ...] batch in and
+the model runs the pipelined scan (engine-side gradient accumulation is the
+pipeline's microbatching — reference PipelineEngine.train_batch semantics where
+gas ≡ micro_batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models.gpt import Block, GPTConfig, Norm
+from deepspeed_tpu.pipe.schedule import pipeline_forward
+
+
+def _box(value, names):
+    return nn.Partitioned(value, names=tuple(names))
+
+
+def _stack_layer_params(layer_params_list, num_stages):
+    """[per-layer param trees] → one tree with leaves [S, L/S, ...], boxed with
+    ('pp', None, *orig_names) so partition.py shards the stage dim over pp."""
+    L = len(layer_params_list)
+    Lps = L // num_stages
+
+    def stack(*leaves):
+        names = (getattr(leaves[0], "names", None) or
+                 (None,) * jnp.ndim(_unbox_one(leaves[0])))
+        vals = [_unbox_one(x) for x in leaves]
+        stacked = jnp.stack(vals).reshape((num_stages, Lps) + vals[0].shape)
+        return _box(stacked, ("pp", None) + tuple(names))
+
+    return jax.tree_util.tree_map(stack, *layer_params_list,
+                                  is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def _unbox_one(x):
+    return x.unbox() if isinstance(x, nn.Partitioned) else x
+
+
+class PipeGPT:
+    """GPT with pipeline-parallel blocks (engine model contract: (init, apply)).
+
+    reference: PipelineModule(layers=GPT blocks, num_stages=S,
+    partition_method='uniform') — uniform partitioning only; the reference's
+    'parameters'-balanced partitioning is unnecessary for homogeneous
+    transformer blocks.
+    """
+
+    is_pipeline = True
+    mesh = None  # engine binding hook (unused — global-view roll needs no mesh)
+
+    def __init__(self, cfg: GPTConfig, num_stages: int):
+        if cfg.num_layers % num_stages != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"num_stages {num_stages}")
+        if cfg.num_experts:
+            raise NotImplementedError("MoE inside the pipeline: use ep mesh "
+                                      "axis with the non-pipelined engine")
+        self.cfg = cfg
+        self.num_stages = num_stages
+        self._block = Block(cfg)
+
+    # ---- engine contract ----
+
+    def init(self, rng, batch):
+        c = self.cfg
+        ids = jnp.asarray(batch["input_ids"])
+        if ids.ndim == 3:
+            ids = ids[0]
+        B, T = ids.shape
+        k_embed, k_pos, k_blocks, k_head = jax.random.split(rng, 4)
+
+        init = nn.initializers.normal(stddev=0.02)
+        params = {
+            "embed": _box(init(k_embed, (c.vocab_size, c.hidden_size),
+                               c.param_dtype), ("vocab", "embed")),
+            "final_norm_scale": _box(jnp.ones((c.hidden_size,), c.param_dtype),
+                                     ("embed",)),
+        }
+        if not c.use_rmsnorm:
+            params["final_norm_bias"] = _box(
+                jnp.zeros((c.hidden_size,), c.param_dtype), ("embed",))
+        if not c.use_rope:
+            params["wpe"] = _box(init(k_pos, (c.max_seq_len, c.hidden_size),
+                                      c.param_dtype), (None, "embed"))
+        if not c.tie_embeddings:
+            params["head"] = _box(init(k_head, (c.hidden_size, c.vocab_size),
+                                       c.param_dtype), ("embed", "vocab"))
+
+        x = jnp.zeros((B, T, c.hidden_size), c.dtype)
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        layer_params = []
+        for i in range(c.num_layers):
+            v = self._block.init(jax.random.fold_in(k_blocks, i), x, positions,
+                                 True)
+            layer_params.append(v["params"])
+        params["blocks"] = _stack_layer_params(layer_params, self.num_stages)
+        return {"params": params}
+
+    def apply(self, variables, batch, rng=None):
+        """batch leaves [M, B, T] (pipelined) or [B, T] (M=1); optional
+        "labels"/"loss_mask" like the plain GPT contract.  Returns the
+        microbatch-mean LM loss (reference PipelineEngine.train_batch,
+        pipe/engine.py:573 _aggregate_total_loss)."""
+        c = self.cfg
+        p = variables["params"]
+
+        def _3d(x):
+            x = jnp.asarray(x)
+            return x[None] if x.ndim == 2 else x
+        ids = _3d(batch["input_ids"])
+        M, B, T = ids.shape
+        embed = _unbox_one(p["embed"]).astype(c.dtype)
+        x = embed[ids]  # [M, B, T, H]
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        if not c.use_rope:
+            x = x + _unbox_one(p["wpe"]).astype(c.dtype)[None, None, :T]
+
+        block = self._block
+        blocks_params = jax.tree_util.tree_map(_unbox_one, p["blocks"],
+                                               is_leaf=lambda x: isinstance(
+                                                   x, nn.Partitioned))
+        deterministic = c.dropout == 0.0 or rng is None
+        if not deterministic:
+            # per-stage dropout rngs ride along in the vmapped params; folded
+            # per layer inside the stage.  Note: within one pipelined step the
+            # dropout pattern is shared across microbatches (rng is not
+            # tick-dependent) — acceptable regularization-wise, documented here.
+            S = self.num_stages
+            stage_rngs = jax.random.split(rng, S)
+            carry_params = (blocks_params, stage_rngs)
+        else:
+            carry_params = (blocks_params, jnp.zeros((self.num_stages, 2),
+                                                     jnp.uint32))
+
+        def stage_fn(sp_and_rng, h):
+            sp, srng = sp_and_rng
+
+            def body(carry, lp):
+                h, i = carry
+                if deterministic:
+                    h, _ = block.apply({"params": lp}, h, positions, True)
+                else:
+                    h, _ = block.apply(
+                        {"params": lp}, h, positions, False,
+                        rngs={"dropout": jax.random.fold_in(srng, i)})
+                return (h, i + 1), None
+            (h, _), _ = lax.scan(body, (h, jnp.int32(0)), sp)
+            return h
+
+        if c.remat:
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        outs = pipeline_forward(stage_fn, carry_params, x)  # [M, B, T, H]
+
+        # labels/mask (same contract as models/gpt.py GPT.__call__)
+        if batch.get("labels") is not None:
+            labels = _3d(batch["labels"])
+            mask = batch.get("loss_mask")
+            mask = (_3d(mask).astype(jnp.float32) if mask is not None
+                    else jnp.ones_like(labels, jnp.float32))
+            mask = mask * (labels >= 0)
+            labels = jnp.maximum(labels, 0)
+        else:
+            labels = jnp.pad(ids[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
+            mask = jnp.ones_like(labels, jnp.float32).at[:, :, -1].set(0.0)
+
+        # final norm + head + loss per microbatch (scan keeps only one
+        # microbatch's fp32 logits live at a time)
+        scale = _unbox_one(p["final_norm_scale"]).astype(jnp.float32)
+        bias = (None if c.use_rmsnorm
+                else _unbox_one(p["final_norm_bias"]).astype(jnp.float32))
+        head = (embed.astype(jnp.float32).T if c.tie_embeddings
+                else _unbox_one(p["head"]).astype(jnp.float32))
+
+        def micro_loss(carry, xs):
+            h, lab, msk = xs
+            h = h.astype(jnp.float32)
+            if c.use_rmsnorm:
+                var = jnp.mean(jnp.square(h), axis=-1, keepdims=True)
+                h = h * jax.lax.rsqrt(var + 1e-6) * scale
+            else:
+                mean = jnp.mean(h, axis=-1, keepdims=True)
+                var = jnp.var(h, axis=-1, keepdims=True)
+                h = (h - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+            logits = h @ head
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+            s_nll, s_msk = carry
+            return (s_nll + jnp.sum(nll * msk), s_msk + jnp.sum(msk)), None
+
+        (sum_nll, sum_mask), _ = lax.scan(
+            micro_loss, (jnp.float32(0.0), jnp.float32(0.0)),
+            (outs, labels, mask))
+        return sum_nll / jnp.maximum(sum_mask, 1.0)
+
+
+def gpt_params_to_pipe(gpt_variables, cfg: GPTConfig, num_stages: int):
+    """Convert flax GPT params → PipeGPT params (layer-checkpoint reshape;
+    reference analog: pipe/module.py save_state_dict layer files + the
+    checkpoint/ds_to_universal reshape direction).  Used to move between the
+    plain and pipelined engines and in equivalence tests."""
+    if cfg.num_layers % num_stages != 0:
+        raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
+                         f"num_stages {num_stages}")
+    src = gpt_variables["params"]
+    bb = src["backbone"]
+    layer_params = [bb[f"block_{i}"] for i in range(cfg.num_layers)]
+
+    params = {
+        "embed": bb["wte"] if isinstance(bb["wte"], nn.Partitioned)
+        else _box(bb["wte"], ("vocab", "embed")),
+        "final_norm_scale": bb["final_norm"]["scale"],
+        "blocks": _stack_layer_params(layer_params, num_stages),
+    }
+    if "bias" in bb["final_norm"]:
+        params["final_norm_bias"] = bb["final_norm"]["bias"]
+    if "wpe" in bb:
+        params["wpe"] = bb["wpe"]
+    if "lm_head" in src:
+        params["head"] = src["lm_head"]
+    return {"params": params}
