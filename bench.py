@@ -39,14 +39,15 @@ def train_flops_per_step(n_params, n_layers, hidden, batch, seq) -> float:
 
 def main():
     import deepspeed_tpu
-    from deepspeed_tpu.models import GPT, GPTConfig
+    from deepspeed_tpu.models import GPT, GPTChunkedLoss, GPTConfig
 
-    # batch 16 is the single-chip sweet spot: batch 32 OOMs on the fp32 logits
-    # (chunked cross-entropy will lift this — see ops/)
-    BATCH, SEQ = 16, 1024
+    # chunked cross-entropy (ops/cross_entropy.py) keeps the fp32 logits out of
+    # HBM, so batch 32 fits; flash attention (ops/flash_attention.py) keeps the
+    # [T, T] scores out of HBM
+    BATCH, SEQ = 32, 1024
     cfg_model = GPTConfig.gpt2_small(vocab_size=50304, max_seq_len=SEQ,
-                                     dropout=0.0)
-    model = GPT(cfg_model)
+                                     dropout=0.0, loss_chunk=1024)
+    model = GPTChunkedLoss(cfg_model)
     config = {
         "train_micro_batch_size_per_gpu": BATCH,
         "gradient_accumulation_steps": 1,

@@ -1,3 +1,4 @@
-from deepspeed_tpu.models.gpt import GPT, GPTConfig
+from deepspeed_tpu.models.gpt import (GPT, GPTBackbone, GPTChunkedLoss,
+                                      GPTConfig)
 
-__all__ = ["GPT", "GPTConfig"]
+__all__ = ["GPT", "GPTBackbone", "GPTChunkedLoss", "GPTConfig"]

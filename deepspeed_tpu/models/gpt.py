@@ -57,6 +57,11 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     # parallelism (mesh passed separately to the GPT module attribute)
     sequence_parallel: bool = False     # Ulysses attention over the sp axis
+    # kernel selection (reference: replace_with_kernel_inject / DS_BUILD flags);
+    # None = registry auto (pallas flash on TPU, XLA elsewhere)
+    attn_impl: Optional[str] = None
+    # chunked unembed+CE (ops/cross_entropy.py); 0 = one-shot logits
+    loss_chunk: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -185,16 +190,21 @@ class Attention(nn.Module):
             # Ulysses: seq-shard → head-shard swap around local attention.
             # Dropout falls on the attention *output* here (rng plumbing inside
             # shard_map isn't worth it); local path keeps standard prob-dropout.
+            from deepspeed_tpu import ops
             from deepspeed_tpu.sequence import ulysses_attention
-            out = ulysses_attention(causal_attend, self.mesh, q, k, v)
+            local_attn = lambda q_, k_, v_: ops.causal_attention(  # noqa: E731
+                q_, k_, v_, impl=c.attn_impl)
+            out = ulysses_attention(local_attn, self.mesh, q, k, v)
             if c.dropout > 0 and not deterministic:
                 out = nn.Dropout(rate=c.dropout)(out, deterministic=False)
         else:
+            from deepspeed_tpu import ops
             pdrop = None
             if c.dropout > 0 and not deterministic:
                 pdrop = lambda p: nn.Dropout(rate=c.dropout)(  # noqa: E731
                     p, deterministic=False)
-            out = causal_attend(q, k, v, probs_dropout=pdrop)
+            out = ops.causal_attention(q, k, v, dropout_fn=pdrop,
+                                       impl=c.attn_impl)
         return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype))
 
 
@@ -285,11 +295,34 @@ class GPTBackbone(nn.Module):
         return x, emb, aux_total
 
 
+def shift_labels(batch, input_ids):
+    """(labels, mask) for next-token LM, honoring explicit labels/loss_mask and
+    the -100-style ignore convention (labels < 0)."""
+    labels = batch.get("labels")
+    if labels is None:  # next-token LM
+        labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)))
+        mask = jnp.ones_like(labels, dtype=jnp.float32).at[:, -1].set(0.0)
+    else:
+        mask = batch.get("loss_mask", jnp.ones_like(labels, dtype=jnp.float32))
+        mask = mask.astype(jnp.float32) * (labels >= 0)
+        labels = jnp.maximum(labels, 0)
+    return labels, mask
+
+
 class GPT(nn.Module):
-    """LM-loss wrapper satisfying the engine's model contract."""
+    """LM-loss wrapper satisfying the engine's model contract.
+
+    ``cfg.loss_chunk > 0`` computes the unembed+CE in rematerialized chunks
+    (ops/cross_entropy.py) so the fp32 [B, T, V] logits never hit HBM; 0 keeps
+    the one-shot logits path.
+    """
 
     cfg: GPTConfig
     mesh: Optional[object] = None
+
+    # subclass hook: chunk size actually used (0 = one-shot)
+    def _loss_chunk(self) -> int:
+        return self.cfg.loss_chunk
 
     @nn.compact
     def __call__(self, batch, deterministic: bool = False):
@@ -298,29 +331,27 @@ class GPT(nn.Module):
         x, emb, moe_aux = GPTBackbone(c, self.mesh,
                                       name="backbone")(input_ids, deterministic)
         if c.tie_embeddings:
-            logits = jnp.einsum("bth,vh->btv", x, emb.astype(x.dtype))
+            unembed = emb.astype(x.dtype).T                # [H, V]
         else:
-            head = self.param("lm_head", _part(_kernel_init(), ("embed", "vocab")),
-                              (c.hidden_size, c.vocab_size), c.param_dtype)
-            logits = x @ head.astype(x.dtype)
-
-        labels = batch.get("labels")
-        if labels is None:  # next-token LM
-            labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)))
-            mask = jnp.ones_like(labels, dtype=jnp.float32).at[:, -1].set(0.0)
-        else:
-            mask = batch.get("loss_mask",
-                             jnp.ones_like(labels, dtype=jnp.float32))
-            mask = mask.astype(jnp.float32) * (labels >= 0)
-            labels = jnp.maximum(labels, 0)
-
-        logits = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            unembed = self.param("lm_head",
+                                 _part(_kernel_init(), ("embed", "vocab")),
+                                 (c.hidden_size, c.vocab_size),
+                                 c.param_dtype).astype(x.dtype)
+        labels, mask = shift_labels(batch, input_ids)
+        from deepspeed_tpu.ops import lm_cross_entropy
+        loss = lm_cross_entropy(x, unembed, labels, mask,
+                                chunk_size=self._loss_chunk() or None)
         if c.num_experts > 0:
             loss = loss + c.moe_aux_coef * moe_aux
         return loss
+
+
+class GPTChunkedLoss(GPT):
+    """GPT that always chunks the unembed+CE (defaults to 512-token chunks when
+    ``cfg.loss_chunk`` is unset) — batch scales past the logits OOM wall."""
+
+    def _loss_chunk(self) -> int:
+        return self.cfg.loss_chunk or 512
 
 
 def count_params(cfg: GPTConfig) -> int:

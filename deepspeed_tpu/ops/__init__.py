@@ -1,0 +1,73 @@
+"""deepspeed_tpu.ops — kernel layer (reference: deepspeed/ops + csrc/ + op_builder/).
+
+Every op has an XLA reference implementation and, where it pays, a Pallas TPU
+kernel; selection goes through the registry (ops/registry.py, the op_builder
+analog).  Public surface:
+
+- ``causal_attention(q, k, v, ...)``      fused flash attention w/ fallback
+- ``flash_attention(...)``                direct Pallas kernel entry
+- ``lm_cross_entropy(...)``               chunked unembed + softmax CE
+- ``op_report()``                         ds_report-style compatibility matrix
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops import registry
+from deepspeed_tpu.ops.cross_entropy import lm_cross_entropy
+from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.registry import dispatch, list_ops, op_report, register_op
+
+
+def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
+                   interpret=None):
+    """Plain attention on [B, T, N, D] — numeric ground truth for the kernel."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    import jax
+    t, s = q.shape[1], k.shape[1]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("btnd,bsnd->bnts", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_fn is not None:
+        probs = dropout_fn(probs)
+    return jnp.einsum("bnts,bsnd->btnd", probs, v)
+
+
+def _attention_pallas(q, k, v, *, causal=True, scale=None, dropout_fn=None,
+                      interpret=None):
+    assert dropout_fn is None, "pallas path has no probs-dropout"
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           interpret=interpret)
+
+
+def _attention_supported(q, k, v, *, causal=True, scale=None, dropout_fn=None,
+                         interpret=None):
+    from deepspeed_tpu.ops.flash_attention import supported as flash_supported
+    return dropout_fn is None and flash_supported(q, k, v, causal=causal)
+
+
+register_op("causal_attention", xla=_attention_xla, pallas=_attention_pallas,
+            supported=_attention_supported)
+
+
+def causal_attention(q, k, v, *, causal: bool = True,
+                     scale: Optional[float] = None,
+                     dropout_fn: Optional[Callable] = None,
+                     impl: Optional[str] = None):
+    """Dispatching attention entry used by the model layer."""
+    return dispatch("causal_attention", q, k, v, causal=causal, scale=scale,
+                    dropout_fn=dropout_fn, impl=impl)
+
+
+__all__ = ["causal_attention", "flash_attention", "lm_cross_entropy",
+           "op_report", "register_op", "dispatch", "list_ops", "registry"]

@@ -1,0 +1,310 @@
+"""Flash attention — Pallas TPU kernel with XLA fallback.
+
+TPU-native replacement for the reference's fused attention kernels
+(training: csrc/transformer/*_kernels.cu strided-batch-gemm + softmax path;
+inference v1: csrc/transformer/inference/csrc/softmax.cu; the blocked flash in
+inference/v2/kernels/ragged_ops/blocked_flash is the ragged cousin, see
+inference/v2).  Online-softmax tiling keeps the [T, T] score matrix out of HBM:
+VMEM-resident (bq, bk) tiles stream through the MXU with running max/denominator
+rescaling, forward saves only the logsumexp row stats for the backward pass.
+
+Layout convention: public API is [B, T, N, D] (batch, seq, heads, head_dim) to
+match the model code; kernels run on [B, N, T, D].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30  # finite "minus infinity": avoids inf-inf NaNs in rescaling
+
+
+def _block_sizes(t: int, prefer: int = DEFAULT_BLOCK_Q):
+    for b in (prefer, 512, 256, 128, 64, 32, 16, 8):
+        if b <= t and t % b == 0:
+            return b
+    return None
+
+
+def supported(q, k, v, *, causal=True, scale=None, **_):
+    """Shape predicate for the pallas path (registry.OpSpec.supported)."""
+    if q.ndim != 4 or q.shape != v.shape[:2] + q.shape[2:]:
+        return False
+    t, d = q.shape[1], q.shape[3]
+    if k.shape[1] != t:  # cross/ragged attention -> fallback
+        return False
+    if q.shape[2] % k.shape[2] != 0:  # GQA group must divide
+        return False
+    return _block_sizes(t) is not None and d % 8 == 0
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                scale, causal, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    run = (iq + 1) * bq > ik * bk if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]                      # [bq, d]
+        k = k_ref[0, 0]                      # [bk, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)               # [bq, bk] fp32
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, causal, scale, interpret):
+    b, n, t, d = q.shape
+    bq = bk = _block_sizes(t)
+    grid = (b, n, t // bq, t // bk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h, iq, ik: (b_, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h, iq, ik: (b_, h, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
+            # row stats ride a [B, N, 1, T] layout: a (1, 1, 1, bq) block keeps
+            # the trailing dims TPU-tileable (second-to-last == array dim)
+            pl.BlockSpec((1, 1, 1, bq), lambda b_, h, iq, ik: (b_, h, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, n, 1, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    run = (iq + 1) * bq > ik * bk if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, 0][:, None]      # [bq, 1]
+        delta = delta_ref[0, 0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                 # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    run = (iq + 1) * bq > ik * bk if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, 0][:, None]
+        delta = delta_ref[0, 0, 0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                 # [bq, bk]
+        # dv += p^T @ do
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale        # [bq, bk]
+        # dk += ds^T @ q
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
+    b, n, t, d = q.shape
+    bq = bk = _block_sizes(t)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]                   # [b, n, 1, t]
+    qkv_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h, iq, ik: (b_, h, ik, 0))
+    row_spec = pl.BlockSpec((1, 1, 1, bq), lambda b_, h, iq, ik: (b_, h, 0, iq))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(b, n, t // bq, t // bk),
+        in_specs=[qkv_spec, kv_spec, kv_spec, qkv_spec, row_spec, row_spec],
+        out_specs=qkv_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # kv-major grid: q blocks innermost so dk/dv accumulate in VMEM scratch
+    q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h, ik, iq: (b_, h, iq, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, d), lambda b_, h, ik, iq: (b_, h, ik, 0))
+    row_spec2 = pl.BlockSpec((1, 1, 1, bq), lambda b_, h, ik, iq: (b_, h, 0, iq))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(b, n, t // bk, t // bq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------- custom_vjp plumbing
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, scale, interpret):
+    o, _ = _fwd(q, k, v, causal, scale, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, interpret):
+    o, lse = _fwd(q, k, v, causal, scale, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Flash attention over [B, T, N, D] inputs (returns same layout).
+
+    GQA (fewer kv heads) is handled by expanding k/v to the q head count before
+    the kernel; the sum-reduction of dk/dv over the group happens automatically
+    through the expansion's transpose.
+    """
+    if not supported(q, k, v, causal=causal):
+        raise ValueError(
+            "flash_attention: unsupported shapes "
+            f"q={q.shape} k={k.shape} v={v.shape}; requires [B, T, N, D] with "
+            "equal q/kv seq len, kv heads dividing q heads, seq len divisible "
+            "by a power-of-two block (>=8), and head_dim % 8 == 0 "
+            "(ops.causal_attention dispatches to the XLA path for these)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nq, nkv = q.shape[2], k.shape[2]
+    if nkv != nq:
+        rep = nq // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    o = _flash(qt, kt, vt, causal, float(scale), bool(interpret))
+    return jnp.transpose(o, (0, 2, 1, 3))

@@ -1,0 +1,96 @@
+"""Op registry — TPU-native analog of the reference's op_builder system.
+
+The reference selects between JIT-compiled CUDA ops and fallbacks via
+``OpBuilder.load()`` (reference op_builder/builder.py:108,491,510) and reports
+compatibility via ``ds_report`` (env_report.py:30).  On TPU the axis of choice is
+*Pallas kernel vs plain-XLA lowering* of the same math: every op registered here
+carries an ``xla`` reference implementation (always available, also the numeric
+ground truth in tests) and optionally a ``pallas`` fast path with a
+``supported(*args, **kw)`` predicate.
+
+Dispatch happens at trace time: the pallas path is taken when (a) it exists,
+(b) the default backend is TPU (or interpret mode is forced), (c) the shape/dtype
+predicate accepts, and (d) it isn't disabled via env ``DSTPU_DISABLE_PALLAS=1``
+or per-call ``impl="xla"`` — the analog of the reference's ``DS_BUILD_*`` flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    xla: Callable
+    pallas: Optional[Callable] = None
+    supported: Optional[Callable[..., bool]] = None  # shape/dtype predicate
+
+    def available_impls(self):
+        impls = ["xla"]
+        if self.pallas is not None:
+            impls.insert(0, "pallas")
+        return impls
+
+
+_REGISTRY: Dict[str, OpSpec] = {}
+
+
+def register_op(name: str, *, xla: Callable, pallas: Optional[Callable] = None,
+                supported: Optional[Callable[..., bool]] = None) -> OpSpec:
+    spec = OpSpec(name=name, xla=xla, pallas=pallas, supported=supported)
+    _REGISTRY[name] = spec
+    return spec
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("DSTPU_DISABLE_PALLAS", "0") != "1"
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend not initialized yet
+        return False
+
+
+def dispatch(name: str, *args, impl: Optional[str] = None, **kwargs) -> Any:
+    """Call op ``name``, choosing the best implementation.
+
+    ``impl`` forces "pallas" or "xla" (forcing pallas off-TPU runs the kernel in
+    interpret mode — used by the numeric unit tests).
+    """
+    spec = _REGISTRY[name]
+    if impl not in (None, "pallas", "xla"):
+        raise ValueError(f"unknown impl {impl!r} for op {name!r}; "
+                         f"expected 'pallas', 'xla', or None (auto)")
+    if impl == "xla" or spec.pallas is None:
+        return spec.xla(*args, **kwargs)
+    if impl == "pallas":
+        return spec.pallas(*args, **kwargs)
+    if (pallas_enabled() and _on_tpu()
+            and (spec.supported is None or spec.supported(*args, **kwargs))):
+        return spec.pallas(*args, **kwargs)
+    return spec.xla(*args, **kwargs)
+
+
+def op_report() -> str:
+    """``ds_report``-style op compatibility matrix (reference env_report.py)."""
+    lines = ["op name".ljust(28) + "impls".ljust(16) + "selected"]
+    on_tpu = _on_tpu()
+    for name, spec in sorted(_REGISTRY.items()):
+        sel = ("pallas" if spec.pallas is not None and pallas_enabled() and on_tpu
+               else "xla")
+        lines.append(name.ljust(28) + ",".join(spec.available_impls()).ljust(16)
+                     + sel)
+    return "\n".join(lines)
+
+
+def list_ops():
+    return dict(_REGISTRY)
