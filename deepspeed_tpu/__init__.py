@@ -19,6 +19,7 @@ from deepspeed_tpu.version import __version__
 
 __all__ = [
     "initialize",
+    "init_inference",
     "DeepSpeedTPUEngine",
     "DeepSpeedTPUConfig",
     "DeepSpeedDataLoader",
@@ -90,3 +91,33 @@ def initialize(model=None,
             collate_fn=collate_fn)
 
     return engine, engine.optimizer, dataloader, engine.lr_schedule
+
+
+def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
+    """Build an inference engine (reference deepspeed.init_inference,
+    deepspeed/__init__.py:273 → inference/engine.py:39).
+
+    model: GPT-family flax module or GPTConfig; ``params`` takes trained weights
+    (e.g. ``train_engine.state.params``).  kwargs merge into the config dict for
+    the reference's ``init_inference(model, tensor_parallel=.., dtype=..)``
+    calling style.
+    """
+    from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    if kwargs:
+        if config is None:
+            cfg_dict = {}
+        elif isinstance(config, dict):
+            cfg_dict = dict(config)
+        elif isinstance(config, str):
+            import json
+            with open(config) as f:
+                cfg_dict = json.load(f)
+        elif isinstance(config, DeepSpeedInferenceConfig):
+            cfg_dict = config.model_dump(by_alias=False)
+        else:
+            raise TypeError(f"config must be dict/path/config model, got "
+                            f"{type(config)!r}")
+        cfg_dict.update(kwargs)
+        config = cfg_dict
+    return InferenceEngine(model=model, config=config, params=params, mesh=mesh)

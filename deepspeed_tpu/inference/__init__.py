@@ -1,0 +1,13 @@
+"""deepspeed_tpu.inference — serving engines.
+
+v1: jitted decode with static KV cache + TP (engine.py; reference
+inference/engine.py).  v2: ragged continuous-batching engine with paged KV
+(v2/; reference inference/v2 "FastGen").
+"""
+
+from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                            parse_inference_config)
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+__all__ = ["InferenceEngine", "DeepSpeedInferenceConfig",
+           "parse_inference_config"]

@@ -1,0 +1,103 @@
+"""Inference config — analog of ``DeepSpeedInferenceConfig``
+(reference inference/config.py:304 LoC, pydantic).
+
+Kept keys with transferring semantics: ``dtype``, ``tensor_parallel`` (tp_size),
+``max_out_tokens``, ``checkpoint``, ``quant``.  Accepted-and-ignored for config
+compatibility: ``replace_with_kernel_inject`` (kernel selection is automatic via
+the op registry), ``min_out_tokens``, CUDA-graph/triton knobs (``jax.jit`` is
+the captured graph).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Literal, Optional, Union
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config import DeepSpeedConfigModel
+
+_DTYPE_ALIASES = {
+    "fp32": "float32", "float": "float32", "float32": "float32",
+    "fp16": "float16", "half": "float16", "float16": "float16",
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "int8": "int8",
+}
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """reference: inference/config.py DeepSpeedTPConfig."""
+
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    """Weight-quantized inference (ZeRO-Inference analog,
+    reference inference/quantization/)."""
+
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 128
+
+
+class GenerationConfig(DeepSpeedConfigModel):
+    """Sampling defaults for ``engine.generate`` (the reference delegates to HF
+    ``generate``; here generation is jitted in-engine)."""
+
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0           # 0 = off
+    top_p: float = 1.0       # 1.0 = off
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    max_out_tokens: int = 1024
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    generation: GenerationConfig = Field(default_factory=GenerationConfig)
+    checkpoint: Optional[Union[str, Dict[str, Any]]] = None
+    # accepted-for-parity, no-op on TPU: kernel selection is automatic (the op
+    # registry picks Pallas on TPU), jit is the captured graph, and decode is
+    # caller-driven so there is no min-token scheduling
+    replace_with_kernel_inject: bool = False
+    min_out_tokens: int = 1
+    enable_cuda_graph: bool = False
+    use_triton: bool = False
+
+    @model_validator(mode="before")
+    @classmethod
+    def _coerce(cls, values):
+        if isinstance(values, dict):
+            tp = values.get("tensor_parallel", values.get("tp"))
+            if isinstance(tp, int):  # accept tensor_parallel: N shorthand
+                values["tensor_parallel"] = {"tp_size": tp}
+            if "dtype" in values and values["dtype"] is not None:
+                key = str(values["dtype"]).replace("torch.", "").lower()
+                if key not in _DTYPE_ALIASES:
+                    raise ValueError(
+                        f"unsupported dtype {values['dtype']!r}; expected one "
+                        f"of {sorted(_DTYPE_ALIASES)}")
+                values["dtype"] = _DTYPE_ALIASES[key]
+        return values
+
+    @property
+    def jnp_dtype(self):
+        import jax.numpy as jnp
+        return {"float32": jnp.float32, "float16": jnp.float16,
+                "bfloat16": jnp.bfloat16, "int8": jnp.int8}[self.dtype]
+
+
+def parse_inference_config(config) -> DeepSpeedInferenceConfig:
+    if config is None:
+        return DeepSpeedInferenceConfig()
+    if isinstance(config, DeepSpeedInferenceConfig):
+        return config
+    if isinstance(config, str):
+        import json
+        with open(config) as f:
+            config = json.load(f)
+    return DeepSpeedInferenceConfig.model_validate(config)

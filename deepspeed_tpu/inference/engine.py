@@ -1,0 +1,220 @@
+"""Inference engine v1 — jitted prefill + incremental decode with a static KV
+cache and tensor-parallel sharding.
+
+Analog of the reference ``InferenceEngine`` (inference/engine.py:39): where the
+reference swaps HF layers for fused CUDA modules (``_apply_injection_policy``
+:408) and captures CUDA graphs (:524), here the whole generate loop is one jitted
+XLA program (prefill + ``lax.scan`` decode), TP comes from the model's logical
+sharding annotations mapped over the mesh ``tp`` axis (the AutoTP analog,
+module_inject/auto_tp.py:273 — declared, not graph-parsed), and per-layer
+``inference_all_reduce`` collectives are inserted by the SPMD partitioner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                            parse_inference_config)
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.parallel import partition
+from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _sample_token(logits, rng, *, do_sample, temperature, top_k, top_p):
+    """One sampling step over [B, V] fp32 logits (greedy / temp / top-k / top-p)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    # top-p (traced scalar; p=1.0 keeps everything — the cutoff lands on the
+    # smallest logit)
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with cumulative mass >= top_p
+    cutoff_idx = jnp.minimum(jnp.sum(cum < top_p, axis=-1, keepdims=True),
+                             logits.shape[-1] - 1)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """Wraps a model (GPT/GPTConfig family) for serving.
+
+    model: a flax module carrying ``.cfg`` (GPT, GPTChunkedLoss, GPTLogits) or a
+    bare ``GPTConfig``.  ``params`` takes a trained tree (e.g.
+    ``train_engine.state.params``); omitted → fresh init (testing).
+    """
+
+    def __init__(self, model, config: Optional[Any] = None, params=None,
+                 mesh=None, seed: int = 0):
+        from deepspeed_tpu.models.gpt import GPTConfig, GPTLogits
+
+        self.config: DeepSpeedInferenceConfig = parse_inference_config(config)
+        comm.init_distributed()
+
+        if mesh is None:
+            tp = self.config.tensor_parallel.tp_size
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=tp, dp=1, fsdp=1))
+        self.mesh = mesh
+
+        model_cfg = model if isinstance(model, GPTConfig) else model.cfg
+        # serving copy of the model config: engine dtype, no dropout
+        model_cfg = dataclasses.replace(model_cfg, dtype=self.config.jnp_dtype,
+                                        dropout=0.0)
+        self.model_config = model_cfg
+        self.module = GPTLogits(model_cfg, mesh)
+
+        dummy = jnp.zeros((1, min(8, model_cfg.max_seq_len)), jnp.int32)
+        init_fn = lambda rng: self.module.init(rng, dummy)  # noqa: E731
+        boxed = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        annotated = annotate_abstract(boxed["params"])
+        self.param_shardings = partition.param_shardings(
+            annotated, mesh, zero_stage=0)
+
+        if params is None:
+            params = unbox(init_fn(jax.random.PRNGKey(seed)))["params"]
+        params = unbox(params)
+        if isinstance(params, dict) and "params" in params:
+            params = params["params"]
+        dtype = self.config.jnp_dtype
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else
+            jnp.asarray(p), params)
+        with self.mesh:
+            self.params = jax.device_put(params, self.param_shardings)
+
+        self._jit_forward = jax.jit(
+            lambda p, ids: self.module.apply({"params": p}, ids),
+            in_shardings=(self.param_shardings, NamedSharding(mesh, P())))
+        self._gen_cache = {}
+        self.num_parameters = sum(
+            int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(self.params))
+        log_dist(f"inference engine ready: params="
+                 f"{self.num_parameters/1e6:.1f}M tp={mesh.shape['tp']} "
+                 f"dtype={self.config.dtype}", ranks=[0])
+
+    # ---- reference InferenceEngine.forward (inference/engine.py:584) ----
+    def forward(self, batch):
+        """Full-sequence logits (no cache): batch = {"input_ids": [B, T]} or a
+        raw [B, T] int array."""
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        with self.mesh:
+            return self._jit_forward(self.params, jnp.asarray(ids, jnp.int32))
+
+    __call__ = forward
+
+    # ---- generate (reference wraps HF generate; here jitted in-engine) ----
+    def _build_generate(self, max_new_tokens, do_sample, top_k, eos, pad):
+        module, cfg = self.module, self.model_config
+        S = cfg.max_seq_len
+
+        def gen(params, ids, attn_mask, rng, temperature, top_p):
+            B, L = ids.shape
+            sample = functools.partial(_sample_token, do_sample=do_sample,
+                                       temperature=temperature, top_k=top_k,
+                                       top_p=top_p)
+            positions = jnp.maximum(jnp.cumsum(attn_mask, axis=1) - 1, 0)
+            kv_valid = jnp.pad(attn_mask.astype(bool), ((0, 0), (0, S - L)))
+            # logical position of every cache slot (slot != position once the
+            # prompt is left-padded)
+            kv_pos = jnp.pad(positions, ((0, 0), (0, S - L)))
+            logits, vars_ = module.apply(
+                {"params": params}, ids, positions=positions, kv_mask=kv_valid,
+                kv_positions=kv_pos, use_cache=True, start_index=0,
+                mutable=["cache"])
+            cache = vars_["cache"]
+            rng, sub = jax.random.split(rng)
+            tok0 = sample(logits[:, -1], sub)
+            done0 = (tok0 == eos) if eos is not None else jnp.zeros(B, bool)
+            last_pos = positions[:, -1]
+
+            def step(carry, i):
+                cache, tok, kv_valid, kv_pos, pos, done, rng = carry
+                cur = L + i
+                kv_valid = jax.lax.dynamic_update_slice(
+                    kv_valid, jnp.ones((B, 1), bool), (0, cur))
+                pos = pos + 1
+                kv_pos = jax.lax.dynamic_update_slice(
+                    kv_pos, pos[:, None], (0, cur))
+                logits, vars_ = module.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    positions=pos[:, None], kv_mask=kv_valid,
+                    kv_positions=kv_pos, use_cache=True,
+                    start_index=cur, mutable=["cache"])
+                rng, sub = jax.random.split(rng)
+                nxt = sample(logits[:, -1], sub)
+                nxt = jnp.where(done, pad, nxt)
+                if eos is not None:
+                    done = done | (nxt == eos)
+                return (vars_["cache"], nxt, kv_valid, kv_pos, pos, done,
+                        rng), nxt
+
+            carry = (cache, tok0, kv_valid, kv_pos, last_pos, done0, rng)
+            _, toks = jax.lax.scan(step, carry,
+                                   jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        return jax.jit(gen, in_shardings=(
+            self.param_shardings, NamedSharding(self.mesh, P()),
+            NamedSharding(self.mesh, P()), NamedSharding(self.mesh, P()),
+            None, None))
+
+    def generate(self, input_ids, attention_mask=None, max_new_tokens: int = 32,
+                 do_sample: Optional[bool] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """Generate ``max_new_tokens`` continuations.
+
+        input_ids: [B, L] (LEFT-padded when lengths differ) with
+        ``attention_mask`` [B, L] marking real tokens (1) vs pads (0).
+        Returns np.ndarray [B, max_new_tokens]; positions after EOS hold
+        ``generation.pad_token_id``.
+        """
+        g = self.config.generation
+        do_sample = g.do_sample if do_sample is None else do_sample
+        temperature = g.temperature if temperature is None else temperature
+        top_k = g.top_k if top_k is None else top_k
+        top_p = g.top_p if top_p is None else top_p
+        eos = g.eos_token_id if eos_token_id is None else eos_token_id
+
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        B, L = ids.shape
+        if L + max_new_tokens > self.model_config.max_seq_len:
+            raise ValueError(
+                f"prompt {L} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_seq_len {self.model_config.max_seq_len}")
+        if max_new_tokens > self.config.max_out_tokens:
+            raise ValueError(f"max_new_tokens {max_new_tokens} exceeds config "
+                             f"max_out_tokens {self.config.max_out_tokens}")
+        mask = (jnp.ones((B, L), jnp.int32) if attention_mask is None
+                else jnp.asarray(np.asarray(attention_mask), jnp.int32))
+
+        key = (int(max_new_tokens), bool(do_sample), int(top_k),
+               eos if eos is None else int(eos), int(g.pad_token_id))
+        if key not in self._gen_cache:
+            self._gen_cache[key] = self._build_generate(
+                max_new_tokens, do_sample, top_k, eos, g.pad_token_id)
+        with self.mesh:
+            out = self._gen_cache[key](
+                self.params, ids, mask, jax.random.PRNGKey(seed),
+                jnp.float32(temperature), jnp.float32(top_p))
+        return np.asarray(out)

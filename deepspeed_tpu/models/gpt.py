@@ -136,27 +136,20 @@ class Norm(nn.Module):
         return y * scale.astype(x.dtype) + bias.astype(x.dtype)
 
 
+def attend_with_mask(q, k, v, mask):
+    """Attention with an explicit boolean mask [B, Tq, S] — the KV-cache /
+    padded-prefill path (reference: masked softmax in
+    csrc/transformer/inference/csrc/softmax.cu).  Delegates to the ops layer."""
+    from deepspeed_tpu import ops
+    return ops.causal_attention(q, k, v, causal=False, mask=mask)
+
+
 def causal_attend(q, k, v, probs_dropout=None):
     """Plain causal softmax attention on [B, T, N, D] (the "local attention" in
-    reference sequence/layer.py terms).  Swappable for the Pallas flash kernel.
-
-    GQA k/v with fewer heads than q are expanded here, *after* any Ulysses
-    all-to-all, so sequence parallelism moves only the true KV volume.
-    """
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    T = q.shape[1]
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("btnd,bsnd->bnts", q, k) * scale
-    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-    logits = jnp.where(mask[None, None, :, :], logits,
-                       jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    if probs_dropout is not None:
-        probs = probs_dropout(probs)
-    return jnp.einsum("bnts,bsnd->btnd", probs, v)
+    reference sequence/layer.py terms) — the XLA reference body lives in the ops
+    registry; this thin alias keeps the Ulysses local-attention signature."""
+    from deepspeed_tpu import ops
+    return ops.causal_attention(q, k, v, dropout_fn=probs_dropout, impl="xla")
 
 
 class Attention(nn.Module):
@@ -164,7 +157,9 @@ class Attention(nn.Module):
     mesh: Optional[object] = None
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool):
+    def __call__(self, x, positions, deterministic: bool,
+                 use_cache: bool = False, kv_mask=None, start_index=0,
+                 kv_positions=None):
         c = self.cfg
         B, T, H = x.shape
         nh, nkv, hd = c.num_heads, c.kv_heads, c.head_dim
@@ -184,6 +179,32 @@ class Attention(nn.Module):
 
         if c.use_rope:
             q, k = rope(q, k, positions, hd)
+
+        if use_cache:
+            # static KV cache in a flax "cache" collection (reference:
+            # inference_context.h KV workspace; flax decode-cache idiom).
+            S = c.max_seq_len
+            ck = self.variable("cache", "cached_key",
+                               jnp.zeros, (B, S, nkv, hd), x.dtype)
+            cv = self.variable("cache", "cached_value",
+                               jnp.zeros, (B, S, nkv, hd), x.dtype)
+            start = jnp.asarray(start_index, jnp.int32)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                    (0, start, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                    (0, start, 0, 0))
+            # causal over LOGICAL positions: with left-padded prompts the cache
+            # slot index differs from the token's position, so the engine passes
+            # per-slot kv_positions; default (no padding) slot == position.
+            if kv_positions is None:
+                kvpos = jnp.arange(S)[None, None, :]         # [1, 1, S]
+            else:
+                kvpos = kv_positions[:, None, :]             # [B, 1, S]
+            mask = kvpos <= positions[:, :, None]            # causal, absolute
+            if kv_mask is not None:
+                mask = mask & kv_mask[:, None, :].astype(bool)
+            out = attend_with_mask(q, ck.value, cv.value, mask)
+            return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype))
 
         if (c.sequence_parallel and self.mesh is not None
                 and self.mesh.shape["sp"] > 1):
@@ -237,10 +258,14 @@ class Block(nn.Module):
     mesh: Optional[object] = None
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool):
+    def __call__(self, x, positions, deterministic: bool,
+                 use_cache: bool = False, kv_mask=None, start_index=0,
+                 kv_positions=None):
         c = self.cfg
         x = x + Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
-                                             deterministic)
+                                             deterministic, use_cache,
+                                             kv_mask, start_index,
+                                             kv_positions)
         if self.is_moe:
             from deepspeed_tpu.moe import MoE
             rng = (self.make_rng("dropout")
@@ -266,13 +291,20 @@ class GPTBackbone(nn.Module):
     mesh: Optional[object] = None
 
     @nn.compact
-    def __call__(self, input_ids, deterministic: bool = True):
+    def __call__(self, input_ids, deterministic: bool = True,
+                 positions=None, use_cache: bool = False, kv_mask=None,
+                 start_index=0, kv_positions=None):
+        """positions: [B, T] absolute positions (default arange — the training
+        path); the inference engine passes per-row positions for left-padded
+        prompts and incremental decode.  kv_mask: [B, max_seq_len] validity of
+        cache slots.  start_index: scalar cache write offset."""
         c = self.cfg
         B, T = input_ids.shape
         emb = self.param("wte", _part(_kernel_init(), ("vocab", "embed")),
                          (c.vocab_size, c.hidden_size), c.param_dtype)
         x = emb.astype(c.dtype)[input_ids]
-        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         if not c.use_rope:
             pos_emb = self.param("wpe", _part(_kernel_init(), (None, "embed")),
                                  (c.max_seq_len, c.hidden_size), c.param_dtype)
@@ -281,15 +313,17 @@ class GPTBackbone(nn.Module):
             x = nn.Dropout(rate=c.dropout)(x, deterministic=False)
 
         block_cls = Block
-        if c.remat:
-            block_cls = nn.remat(Block, static_argnums=(3,),
+        if c.remat and not use_cache:
+            block_cls = nn.remat(Block, static_argnums=(3, 4),
                                  policy=jax.checkpoint_policies.nothing_saveable)
         aux_total = jnp.float32(0.0)
         for i in range(c.num_layers):
             # reference examples put MoE on every other layer
             is_moe = (c.num_experts > 0 and i % c.moe_every == c.moe_every - 1)
             x, aux = block_cls(c, is_moe, self.mesh,
-                               name=f"block_{i}")(x, positions, deterministic)
+                               name=f"block_{i}")(x, positions, deterministic,
+                                                  use_cache, kv_mask,
+                                                  start_index, kv_positions)
             aux_total = aux_total + aux
         x = Norm(c, name="final_norm")(x)
         return x, emb, aux_total
@@ -344,6 +378,34 @@ class GPT(nn.Module):
         if c.num_experts > 0:
             loss = loss + c.moe_aux_coef * moe_aux
         return loss
+
+
+class GPTLogits(nn.Module):
+    """Token ids → logits, with optional KV cache — the inference-engine view of
+    the same parameter tree as ``GPT`` (backbone + tied/untied unembed), so a
+    training checkpoint loads directly (reference: the injected inference module
+    reusing the HF layer weights, module_inject/replace_module.py:183)."""
+
+    cfg: GPTConfig
+    mesh: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, kv_mask=None,
+                 use_cache: bool = False, start_index=0, kv_positions=None,
+                 deterministic: bool = True):
+        c = self.cfg
+        x, emb, _ = GPTBackbone(c, self.mesh, name="backbone")(
+            input_ids, deterministic, positions=positions,
+            use_cache=use_cache, kv_mask=kv_mask, start_index=start_index,
+            kv_positions=kv_positions)
+        if c.tie_embeddings:
+            unembed = emb.astype(x.dtype).T
+        else:
+            unembed = self.param("lm_head",
+                                 _part(_kernel_init(), ("embed", "vocab")),
+                                 (c.hidden_size, c.vocab_size),
+                                 c.param_dtype).astype(x.dtype)
+        return (x @ unembed).astype(jnp.float32)
 
 
 class GPTChunkedLoss(GPT):

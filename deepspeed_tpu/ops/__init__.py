@@ -23,8 +23,14 @@ from deepspeed_tpu.ops.registry import dispatch, list_ops, op_report, register_o
 
 
 def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                   interpret=None):
-    """Plain attention on [B, T, N, D] — numeric ground truth for the kernel."""
+                   mask=None, interpret=None):
+    """Plain attention on [B, T, N, D] — numeric ground truth for the kernel.
+
+    The ONE XLA softmax-attention body in the codebase: causal tril masking, or
+    an explicit [B, Tq, S] boolean mask (the KV-cache / padded-prefill path;
+    all-False rows produce zeros, not NaN, so left-pad garbage never reaches
+    later layers' V inputs).
+    """
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
@@ -33,27 +39,42 @@ def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
     t, s = q.shape[1], k.shape[1]
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("btnd,bsnd->bnts", q, k) * scale
-    if causal:
-        mask = jnp.tril(jnp.ones((t, s), dtype=bool))
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    logits = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32) * scale
+    neg = jnp.finfo(jnp.float32).min
+    if mask is not None:
+        m = mask[:, None]                                # [B, 1, Tq, S]
+        logits = jnp.where(m, logits, neg)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(jnp.any(m, axis=-1, keepdims=True), probs, 0.0)
+    else:
+        if causal:
+            tri = jnp.tril(jnp.ones((t, s), dtype=bool))
+            logits = jnp.where(tri[None, None], logits, neg)
+        probs = jax.nn.softmax(logits, axis=-1)
+    probs = probs.astype(q.dtype)
     if dropout_fn is not None:
         probs = dropout_fn(probs)
     return jnp.einsum("bnts,bsnd->btnd", probs, v)
 
 
 def _attention_pallas(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                      interpret=None):
-    assert dropout_fn is None, "pallas path has no probs-dropout"
+                      mask=None, interpret=None):
+    if dropout_fn is not None:
+        raise ValueError(
+            "the pallas flash-attention kernel has no probs-dropout; use "
+            "impl='xla', dropout=0, or output dropout (Ulysses-branch style)")
+    if mask is not None:
+        raise ValueError("the pallas flash-attention kernel takes no explicit "
+                         "mask; use impl='xla' for the KV-cache/padded path")
     return flash_attention(q, k, v, causal=causal, scale=scale,
                            interpret=interpret)
 
 
 def _attention_supported(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                         interpret=None):
+                         mask=None, interpret=None):
     from deepspeed_tpu.ops.flash_attention import supported as flash_supported
-    return dropout_fn is None and flash_supported(q, k, v, causal=causal)
+    return (dropout_fn is None and mask is None
+            and flash_supported(q, k, v, causal=causal))
 
 
 register_op("causal_attention", xla=_attention_xla, pallas=_attention_pallas,
@@ -63,10 +84,11 @@ register_op("causal_attention", xla=_attention_xla, pallas=_attention_pallas,
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
                      dropout_fn: Optional[Callable] = None,
+                     mask=None,
                      impl: Optional[str] = None):
     """Dispatching attention entry used by the model layer."""
     return dispatch("causal_attention", q, k, v, causal=causal, scale=scale,
-                    dropout_fn=dropout_fn, impl=impl)
+                    dropout_fn=dropout_fn, mask=mask, impl=impl)
 
 
 __all__ = ["causal_attention", "flash_attention", "lm_cross_entropy",
