@@ -73,7 +73,8 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     def _coerce(cls, values):
         if isinstance(values, dict):
             tp = values.get("tensor_parallel", values.get("tp"))
-            if isinstance(tp, int):  # accept tensor_parallel: N shorthand
+            if isinstance(tp, int):  # accept tensor_parallel/tp: N shorthand
+                values.pop("tp", None)
                 values["tensor_parallel"] = {"tp_size": tp}
             if "dtype" in values and values["dtype"] is not None:
                 key = str(values["dtype"]).replace("torch.", "").lower()

@@ -1,0 +1,19 @@
+"""deepspeed_tpu.inference.v2 — ragged continuous-batching serving ("FastGen",
+reference inference/v2): paged KV cache + host-side block allocator/sequence
+manager (ragged.py), one static-shape jitted ragged forward (model.py), and the
+put/query/flush engine with a Dynamic SplitFuse generate driver (engine_v2.py).
+"""
+
+from deepspeed_tpu.inference.v2.engine_v2 import (DSStateManagerConfig,
+                                                  InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.model import PagedKVCache, ragged_forward
+from deepspeed_tpu.inference.v2.ragged import (BlockedAllocator,
+                                               DSStateManager, RaggedBatch,
+                                               SequenceDescriptor,
+                                               build_ragged_batch)
+
+__all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig",
+           "DSStateManagerConfig", "PagedKVCache", "ragged_forward",
+           "DSStateManager", "BlockedAllocator", "SequenceDescriptor",
+           "RaggedBatch", "build_ragged_batch"]

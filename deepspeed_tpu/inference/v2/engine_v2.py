@@ -1,0 +1,545 @@
+"""InferenceEngineV2 — ragged continuous-batching serving engine ("FastGen").
+
+Analog of the reference ``InferenceEngineV2`` (inference/v2/engine_v2.py:30):
+``put(uids, tokens)`` runs ONE forward over a ragged batch and returns one
+logit row per sequence (:107), ``query``/``can_schedule`` expose KV headroom
+(:158,:184), ``flush`` frees state (:242).  ``generate`` adds the continuous-
+batching driver with the Dynamic SplitFuse schedule (decodes first, prompt
+chunks fill the remaining token budget — the policy the reference ships in
+MII's ragged batching on top of this engine API).
+
+The forward is one jitted XLA program over static shapes (token budget ×
+sequence slots × blocks-per-seq); the paged KV cache is donated through each
+step so it updates in place on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from pydantic import Field
+
+from deepspeed_tpu.config import DeepSpeedConfigModel
+from deepspeed_tpu.inference.config import (GenerationConfig, _DTYPE_ALIASES)
+from deepspeed_tpu.inference.v2.model import (PagedKVCache,
+                                              ragged_decode_burst,
+                                              ragged_decode_forward,
+                                              ragged_forward)
+from deepspeed_tpu.inference.v2.ragged import (DSStateManager, RaggedBatch,
+                                               build_ragged_batch)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DSStateManagerConfig(DeepSpeedConfigModel):
+    """reference: inference/v2/ragged/manager_configs.py DSStateManagerConfig."""
+
+    max_tracked_sequences: int = 32
+    max_ragged_batch_size: int = 256        # token budget per forward
+    max_ragged_sequence_count: int = 32
+    kv_block_size: int = 64
+    num_kv_blocks: Optional[int] = None     # None = enough for all slots full
+    max_q_per_seq: int = 128                # prompt-chunk cap (SplitFuse)
+
+
+class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
+    """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig."""
+
+    dtype: str = "bfloat16"
+    state_manager: DSStateManagerConfig = Field(
+        default_factory=DSStateManagerConfig)
+    generation: GenerationConfig = Field(default_factory=GenerationConfig)
+
+    @classmethod
+    def parse(cls, config):
+        if config is None:
+            return cls()
+        if isinstance(config, cls):
+            return config
+        if isinstance(config, dict) and "dtype" in config:
+            key = str(config["dtype"]).replace("torch.", "").lower()
+            if key not in _DTYPE_ALIASES:
+                raise ValueError(f"unsupported dtype {config['dtype']!r}; "
+                                 f"expected one of {sorted(_DTYPE_ALIASES)}")
+            config = {**config, "dtype": _DTYPE_ALIASES[key]}
+        return cls.model_validate(config)
+
+    @property
+    def jnp_dtype(self):
+        return {"float32": jnp.float32, "float16": jnp.float16,
+                "bfloat16": jnp.bfloat16}[self.dtype]
+
+
+@dataclasses.dataclass
+class _Request:
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    generated: List[int] = dataclasses.field(default_factory=list)
+    next_token: Optional[int] = None      # sampled, waiting to be decoded
+    done: bool = False
+    # set while re-prefilling after preemption: the completion logits must NOT
+    # be sampled (the continuation token is already held in next_token)
+    resume: bool = False
+    # how many generated tokens have been folded into .prompt by preemptions
+    folded: int = 0
+
+
+class InferenceEngineV2:
+    """model: GPT-family module or GPTConfig; params: trained tree (optional —
+    fresh init for testing).  See reference engine_v2.py:30."""
+
+    def __init__(self, model, config=None, params=None, seed: int = 0):
+        from deepspeed_tpu.models.gpt import GPTConfig, GPTLogits
+        from deepspeed_tpu.parallel.metadata import unbox
+
+        self.config = RaggedInferenceEngineConfig.parse(config)
+        sm = self.config.state_manager
+        model_cfg = model if isinstance(model, GPTConfig) else model.cfg
+        model_cfg = dataclasses.replace(model_cfg, dtype=self.config.jnp_dtype,
+                                        dropout=0.0)
+        if model_cfg.num_experts:
+            raise NotImplementedError(
+                "v2 ragged serving of MoE models lands with the grouped-GEMM "
+                "kernel; use the v1 engine for MoE")
+        self.model_config = model_cfg
+
+        if params is None:
+            lm = GPTLogits(model_cfg)
+            params = unbox(lm.init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1, 8), jnp.int32)))["params"]
+        params = unbox(params)
+        if isinstance(params, dict) and "params" in params:
+            params = params["params"]
+        dt = self.config.jnp_dtype
+        self.params = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p).astype(dt)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+            else jnp.asarray(p), params)
+
+        blocks_per_seq = -(-model_cfg.max_seq_len // sm.kv_block_size)
+        num_blocks = (sm.num_kv_blocks if sm.num_kv_blocks
+                      else sm.max_tracked_sequences * blocks_per_seq)
+        self.state = DSStateManager(
+            max_tracked_sequences=sm.max_tracked_sequences,
+            num_blocks=num_blocks, block_size=sm.kv_block_size,
+            max_seq_len=model_cfg.max_seq_len)
+        self.cache = PagedKVCache.create(model_cfg, num_blocks,
+                                         sm.kv_block_size, dt)
+        # jitted step per (Qmax, KVblocks) bucket: a decode-only step runs a
+        # Q=1 program and short sequences gather few KV blocks — the static-
+        # shape analog of the reference's atom decomposition (atom_builder);
+        # buckets are powers of two so the compile cache stays small
+        self._steps: Dict[Any, Any] = {}
+        self._sampler_cache: Dict[Any, Any] = {}
+        self._block_size = sm.kv_block_size
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(self.params))
+        log_dist(f"v2 ragged engine ready: params={n_params/1e6:.1f}M "
+                 f"budget={sm.max_ragged_batch_size}tok "
+                 f"slots={sm.max_tracked_sequences} "
+                 f"kv_blocks={num_blocks}x{sm.kv_block_size}", ranks=[0])
+
+    # ------------------------------------------------ reference put() :107
+    def put(self, uids: Sequence[int], tokens_list: Sequence[np.ndarray],
+            ) -> np.ndarray:
+        """Append tokens to each uid's sequence, run ONE ragged forward, return
+        fp32 logits [len(uids), vocab] of each sequence's last token."""
+        logits = self._put_device(uids, tokens_list)
+        slots = [self.state.get(uid).slot for uid in uids]
+        return np.asarray(logits)[np.asarray(slots)]
+
+    def _put_device(self, uids, tokens_list):
+        """put() minus the host transfer: returns per-SLOT device logits
+        [S, vocab] so generate() can sample on device and ship only token ids
+        over the wire (the logits row is 200 KB; a token id is 4 bytes)."""
+        sm = self.config.state_manager
+        # validate BEFORE mutating any state (slots/blocks), so a rejected put
+        # leaves the manager clean
+        toks_np = [np.asarray(t, np.int32).reshape(-1) for t in tokens_list]
+        for uid, toks in zip(uids, toks_np):
+            if len(toks) > sm.max_q_per_seq:
+                raise ValueError(
+                    f"uid {uid}: {len(toks)} tokens exceeds max_q_per_seq="
+                    f"{sm.max_q_per_seq}; split the prompt (SplitFuse) or use "
+                    f"generate()")
+            seen = (self.state.get(uid).seen_tokens
+                    if self.state.get(uid) else 0)
+            if seen + len(toks) > self.model_config.max_seq_len:
+                raise ValueError(f"uid {uid} exceeds max_seq_len "
+                                 f"{self.model_config.max_seq_len}")
+        total = sum(len(t) for t in toks_np)
+        if total > sm.max_ragged_batch_size:
+            raise ValueError(f"batch of {total} tokens exceeds ragged budget "
+                             f"{sm.max_ragged_batch_size}; check query() first")
+        if len(uids) > sm.max_ragged_sequence_count:
+            raise ValueError(f"{len(uids)} sequences exceeds "
+                             f"max_ragged_sequence_count="
+                             f"{sm.max_ragged_sequence_count}")
+        new_uids = [u for u in uids if self.state.get(u) is None]
+        if len(new_uids) > self.state.free_sequence_slots:
+            raise RuntimeError(
+                f"{len(new_uids)} new sequences but only "
+                f"{self.state.free_sequence_slots} free slots; flush() first")
+        blocks_needed = sum(
+            (self.state.get(u).kv_blocks_needed(len(t), self.state.block_size)
+             if self.state.get(u) else -(-len(t) // self.state.block_size))
+            for u, t in zip(uids, toks_np))
+        if blocks_needed > self.state.allocator.free_blocks:
+            raise RuntimeError(
+                f"batch needs {blocks_needed} KV blocks but only "
+                f"{self.state.allocator.free_blocks} free; check query() first")
+        schedule = []
+        for uid, toks in zip(uids, toks_np):
+            seq = self.state.get(uid) or self.state.create(uid)
+            self.state.ensure_blocks(seq, len(toks))
+            schedule.append((seq, toks))
+        rb = build_ragged_batch(schedule, self.state,
+                                sm.max_ragged_batch_size, sm.max_q_per_seq)
+        logits = self._run(rb)
+        for seq, toks in schedule:
+            seq.seen_tokens += len(toks)
+        return logits
+
+    def _run(self, rb: RaggedBatch) -> "jax.Array":
+        # exactly TWO compiled programs: a decode-only step (Q=1, full-pool
+        # ownership-mask attention — the steady-state hot path, see
+        # ragged_decode_forward) and the mixed prefill step (Q=max_q_per_seq,
+        # per-slot page gathers); finer shape bucketing trades too much
+        # recompilation for the saved FLOPs
+        sm = self.config.state_manager
+        if int(rb.q_len.max()) <= 1:
+            return self._run_decode(rb)
+        key = ("mixed", sm.max_q_per_seq)
+        if key not in self._steps:
+            self._steps[key] = jax.jit(
+                functools.partial(ragged_forward, cfg=self.model_config,
+                                  block_size=self._block_size,
+                                  max_q_per_seq=sm.max_q_per_seq),
+                donate_argnums=(1,))
+        batch = {"tokens": rb.tokens, "token_slot": rb.token_slot,
+                 "token_pos": rb.token_pos,
+                 "token_dense_idx": rb.token_dense_idx,
+                 "block_table": rb.block_table, "kv_len": rb.kv_len}
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        logits, self.cache = self._steps[key](self.params, self.cache, batch)
+        return logits
+
+    def _run_decode(self, rb: RaggedBatch) -> "jax.Array":
+        S = self.state.max_tracked_sequences
+        NB = self.state.allocator.num_blocks
+        bs = self._block_size
+        tokens = np.zeros(S, np.int32)
+        active = np.zeros(S, bool)
+        token_pos = np.zeros(S, np.int32)
+        dest = np.zeros(S, np.int32)
+        owner_block = np.full(NB, -1, np.int32)
+        block_rank = np.zeros(NB, np.int32)
+        for seq in self.state.tracked.values():
+            bl = np.asarray(seq.blocks, np.int32)
+            owner_block[bl] = seq.slot
+            block_rank[bl] = np.arange(len(bl))
+        for i in range(rb.total_tokens):
+            sl = rb.token_slot[i]
+            tokens[sl] = rb.tokens[i]
+            active[sl] = True
+            pos = rb.token_pos[i]
+            token_pos[sl] = pos
+            dest[sl] = rb.block_table[sl, pos // bs] * bs + pos % bs
+        key = "decode"
+        if key not in self._steps:
+            self._steps[key] = jax.jit(
+                functools.partial(ragged_decode_forward,
+                                  cfg=self.model_config,
+                                  block_size=self._block_size),
+                donate_argnums=(1,))
+        batch = jax.tree_util.tree_map(jnp.asarray, {
+            "tokens": tokens, "active": active, "token_pos": token_pos,
+            "dest": dest, "owner_block": owner_block,
+            "block_rank": block_rank})
+        logits, self.cache = self._steps[key](self.params, self.cache, batch)
+        return logits
+
+    def _run_burst(self, reqs, steps: int, gen, rng) -> np.ndarray:
+        """Fused T-step decode over the running set: one device dispatch for
+        ``steps`` tokens per sequence (see model.ragged_decode_burst).  Blocks
+        for all T positions are pre-allocated; returns tokens [T, S]."""
+        S = self.state.max_tracked_sequences
+        NB = self.state.allocator.num_blocks
+        tokens0 = np.zeros(S, np.int32)
+        active = np.zeros(S, bool)
+        pos0 = np.zeros(S, np.int32)
+        block_table = np.zeros((S, self.state.max_blocks_per_seq), np.int32)
+        owner_block = np.full(NB, -1, np.int32)
+        block_rank = np.zeros(NB, np.int32)
+        for r in reqs:
+            seq = self.state.get(r.uid)
+            self.state.ensure_blocks(seq, steps)
+            sl = seq.slot
+            tokens0[sl] = r.next_token
+            active[sl] = True
+            pos0[sl] = seq.seen_tokens
+            bl = np.asarray(seq.blocks, np.int32)
+            block_table[sl, :len(bl)] = bl
+            owner_block[bl] = sl
+            block_rank[bl] = np.arange(len(bl))
+        key = ("burst", steps, gen.do_sample, gen.top_k)
+        if key not in self._steps:
+            from deepspeed_tpu.inference.engine import _sample_token
+            sample_fn = functools.partial(
+                _sample_token, do_sample=gen.do_sample, top_k=gen.top_k)
+            self._steps[key] = jax.jit(
+                functools.partial(ragged_decode_burst, cfg=self.model_config,
+                                  block_size=self._block_size, steps=steps,
+                                  sample_fn=sample_fn),
+                donate_argnums=(1,))
+        batch = jax.tree_util.tree_map(jnp.asarray, {
+            "tokens0": tokens0, "active": active, "pos0": pos0,
+            "block_table": block_table, "owner_block": owner_block,
+            "block_rank": block_rank})
+        toks, self.cache = self._steps[key](
+            self.params, self.cache, batch, rng,
+            jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+        for r in reqs:
+            self.state.get(r.uid).seen_tokens += steps
+        return np.asarray(toks)
+
+    # ----------------------------------------- reference query()/can_schedule
+    def query(self) -> Dict[str, int]:
+        """KV/slot headroom (reference engine_v2.query :158)."""
+        sm = self.config.state_manager
+        return {
+            "free_kv_blocks": self.state.allocator.free_blocks,
+            "free_sequence_slots": self.state.free_sequence_slots,
+            "token_budget": sm.max_ragged_batch_size,
+            "max_q_per_seq": sm.max_q_per_seq,
+            "kv_block_size": sm.kv_block_size,
+        }
+
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> bool:
+        """reference engine_v2.can_schedule :184."""
+        sm = self.config.state_manager
+        if sum(lengths) > sm.max_ragged_batch_size:
+            return False
+        if len(uids) > sm.max_ragged_sequence_count:
+            return False
+        blocks = slots = 0
+        for uid, n in zip(uids, lengths):
+            seq = self.state.get(uid)
+            if seq is None:
+                slots += 1
+                blocks += -(-n // self.state.block_size)
+            else:
+                blocks += seq.kv_blocks_needed(n, self.state.block_size)
+        return (blocks <= self.state.allocator.free_blocks
+                and slots <= self.state.free_sequence_slots)
+
+    def flush(self, uids: Sequence[int]) -> None:
+        """reference engine_v2.flush :242."""
+        for uid in uids:
+            self.state.flush(uid)
+
+    # ------------------------------- continuous batching (Dynamic SplitFuse)
+    def _sampler(self, do_sample: bool, top_k: int):
+        key = (do_sample, top_k)
+        if key not in self._sampler_cache:
+            from deepspeed_tpu.inference.engine import _sample_token
+            self._sampler_cache[key] = jax.jit(functools.partial(
+                _sample_token, do_sample=do_sample, top_k=top_k))
+        return self._sampler_cache[key]
+
+    def generate(self, prompts: Sequence[np.ndarray], max_new_tokens: int = 32,
+                 seed: int = 0, **gen_overrides) -> List[np.ndarray]:
+        """Serve a set of prompts to completion with continuous batching.
+
+        Dynamic SplitFuse (reference blogs/deepspeed-fastgen): every step first
+        schedules 1 token for each running decode, then fills the remaining
+        token budget with prompt chunks (long prompts split across steps);
+        new requests are admitted as slots/blocks free up.
+        """
+        gen = self.config.generation.model_copy(update=gen_overrides)
+        sm = self.config.state_manager
+        rng_key = jax.random.PRNGKey(seed)
+        sampler = self._sampler(gen.do_sample, gen.top_k)
+        waiting = [
+            _Request(uid=-(i + 1), prompt=np.asarray(p, np.int32).reshape(-1),
+                     max_new_tokens=max_new_tokens)
+            for i, p in enumerate(prompts)]
+        pool_blocks = self.state.allocator.num_blocks
+        for r in waiting:
+            if len(r.prompt) + max_new_tokens > self.model_config.max_seq_len:
+                raise ValueError(f"prompt {len(r.prompt)} + {max_new_tokens} "
+                                 f"exceeds max_seq_len")
+            need = -(-(len(r.prompt) + max_new_tokens) // self.state.block_size)
+            if need > pool_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks for its full context but "
+                    f"the pool holds {pool_blocks}; raise num_kv_blocks "
+                    f"(recompute-preemption cannot make a single sequence fit)")
+        running: List[_Request] = []
+        results: Dict[int, _Request] = {r.uid: r for r in waiting}
+
+        burst_sizes = (64, 32, 16, 8)
+        while waiting or running:
+            # ---- decode-burst fast path: every running sequence is in pure
+            # decode and nothing is waiting -> fuse T steps into one dispatch
+            if (not waiting and running
+                    and all(r.next_token is not None and not r.done
+                            for r in running)
+                    and all(not self.state.get(r.uid).in_flight
+                            for r in running)):
+                remaining = min(r.max_new_tokens - len(r.generated)
+                                for r in running)
+                cap = min(remaining,
+                          min(self.model_config.max_seq_len
+                              - self.state.get(r.uid).seen_tokens
+                              for r in running))
+                # shrink the burst until its block reservation fits the pool
+                T = next((b for b in burst_sizes if b <= cap), 0)
+                while T >= burst_sizes[-1]:
+                    need = sum(self.state.get(r.uid).kv_blocks_needed(
+                        T, self.state.block_size) for r in running)
+                    if need <= self.state.allocator.free_blocks:
+                        break
+                    T //= 2
+                if T >= burst_sizes[-1]:
+                    rng_key, sub = jax.random.split(rng_key)
+                    toks = self._run_burst(running, T, gen, sub)  # [T, S]
+                    for r in list(running):
+                        sl = self.state.get(r.uid).slot
+                        seq_toks = toks[:, sl].tolist()
+                        if gen.eos_token_id is not None and \
+                                gen.eos_token_id in seq_toks:
+                            cut = seq_toks.index(gen.eos_token_id)
+                            r.generated.extend(seq_toks[:cut + 1])
+                            r.done = True
+                        else:
+                            r.generated.extend(seq_toks)
+                            r.next_token = seq_toks[-1]
+                            if len(r.generated) >= r.max_new_tokens:
+                                r.done = True
+                        if r.done:
+                            r.next_token = None
+                            self.flush([r.uid])
+                            running.remove(r)
+                    continue
+
+            budget = sm.max_ragged_batch_size
+            sched_uids: List[int] = []
+            sched_toks: List[np.ndarray] = []
+            want_logits: List[_Request] = []
+
+            # 1) running decodes: one token each (decode-priority keeps
+            #    latency flat while prompts stream in)
+            for r in running:
+                seq = self.state.get(r.uid)
+                # a resumed request may hold next_token while its re-prefill is
+                # still chunked in (in_flight) — its decode must wait
+                if r.done or r.next_token is None or seq.in_flight:
+                    continue
+                if budget <= 0:
+                    break
+                # reserve the block NOW (allocator state advances with each
+                # reservation, so later checks see the true remaining pool);
+                # a decode that can't get a block defers to a later round
+                if (seq.kv_blocks_needed(1, self.state.block_size)
+                        > self.state.allocator.free_blocks):
+                    continue
+                self.state.ensure_blocks(seq, 1)
+                sched_uids.append(r.uid)
+                sched_toks.append(np.asarray([r.next_token], np.int32))
+                want_logits.append(r)
+                budget -= 1
+
+            # 2) prompt chunks fill the rest (running first, then admit new)
+            for r in list(running):
+                seq = self.state.get(r.uid)
+                if seq is None or not seq.in_flight or budget <= 0:
+                    continue
+                chunk = min(len(seq.pending), sm.max_q_per_seq, budget)
+                need = seq.kv_blocks_needed(chunk, self.state.block_size)
+                if need > self.state.allocator.free_blocks:
+                    continue
+                self.state.ensure_blocks(seq, chunk)
+                toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
+                sched_uids.append(r.uid)
+                sched_toks.append(toks)
+                if not seq.in_flight:       # prompt complete -> logits usable
+                    if r.resume:
+                        r.resume = False    # continuation token already held
+                    else:
+                        want_logits.append(r)
+                budget -= chunk
+
+            while waiting and budget > 0 and self.state.free_sequence_slots:
+                r = waiting[0]
+                chunk = min(len(r.prompt), sm.max_q_per_seq, budget)
+                if (-(-chunk // self.state.block_size)
+                        > self.state.allocator.free_blocks):
+                    break
+                waiting.pop(0)
+                seq = self.state.create(r.uid)
+                seq.pending = r.prompt
+                self.state.ensure_blocks(seq, chunk)
+                running.append(r)
+                toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
+                sched_uids.append(r.uid)
+                sched_toks.append(toks)
+                if not seq.in_flight:
+                    if r.resume:
+                        r.resume = False
+                    else:
+                        want_logits.append(r)
+                budget -= chunk
+
+            if not sched_uids:
+                # KV pool exhausted with everyone mid-generation: preempt the
+                # most recently admitted sequence by RECOMPUTE — free its
+                # blocks and re-queue it with its full context (the vLLM/
+                # FastGen recompute-preemption policy); its re-prefill logits
+                # are not re-sampled (resume flag)
+                if running:
+                    victim = running.pop()
+                    # fold generated-but-not-yet-refed tokens into the prompt
+                    # exactly once (folded tracks prior preemptions; the held
+                    # next_token is NOT folded — it replays as a decode)
+                    keep = len(victim.generated) - (
+                        1 if victim.next_token is not None else 0)
+                    new_ctx = victim.generated[victim.folded:keep]
+                    if new_ctx:
+                        victim.prompt = np.concatenate(
+                            [victim.prompt, np.asarray(new_ctx, np.int32)])
+                    victim.folded = keep
+                    victim.resume = victim.next_token is not None
+                    self.state.flush(victim.uid)
+                    waiting.insert(0, victim)
+                    continue
+                raise RuntimeError(
+                    "scheduler deadlock: the KV pool cannot fit even one "
+                    "sequence; raise num_kv_blocks")
+
+            logits_dev = self._put_device(sched_uids, sched_toks)
+            rng_key, sub = jax.random.split(rng_key)
+            slot_tokens = np.asarray(sampler(
+                logits_dev, sub, temperature=jnp.float32(gen.temperature),
+                top_p=jnp.float32(gen.top_p)))          # [S] — 4 bytes/slot
+            for r in want_logits:
+                tok = int(slot_tokens[self.state.get(r.uid).slot])
+                r.generated.append(tok)
+                r.next_token = tok
+                if (len(r.generated) >= r.max_new_tokens
+                        or (gen.eos_token_id is not None
+                            and tok == gen.eos_token_id)):
+                    r.done = True
+                    r.next_token = None
+                    self.flush([r.uid])
+                    running.remove(r)
+
+        return [np.asarray(results[-(i + 1)].generated, np.int32)
+                for i in range(len(prompts))]

@@ -1,0 +1,302 @@
+"""Ragged GPT forward over a paged KV cache — the v2 model implementation.
+
+Analog of the reference's ``DSTransformerBase`` layer-by-layer ragged forward
+(inference/v2/model_implementations/inference_transformer_base.py:617) plus the
+ragged kernel set (inference/v2/kernels/ragged_ops/): ``linear_blocked_kv_rotary``
+(qkv + rotary + paged-KV append) and ``blocked_flash`` (attention over blocked
+KV) become scatter-into-pages + a dense-per-slot masked attention in XLA;
+``logits_gather`` becomes a row gather before the unembed.
+
+Works directly on the GPT parameter tree (models/gpt.py naming: backbone/
+block_i/{Attention_0,MLP_0,Norm_0,Norm_1}, wte/wpe/final_norm) the way the
+reference's flat-parameter model implementations bypass the torch module
+(flat_model_helpers.py) — a training checkpoint serves without conversion.
+
+Every array shape is static: N token budget, S sequence slots, MB blocks/seq,
+Qmax new tokens per sequence per step.  Raggedness is carried by index arrays
+(see ragged.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt import GPTConfig, rope
+
+
+class PagedKVCache(NamedTuple):
+    """Per-layer paged KV arrays: [num_blocks, block_size, n_kv_heads, head_dim]
+    stacked on a leading layer axis (reference: KVCacheManager kv_cache.py)."""
+
+    k: jax.Array  # [L, num_blocks, bs, nkv, hd]
+    v: jax.Array
+
+    @classmethod
+    def create(cls, cfg: GPTConfig, num_blocks: int, block_size: int, dtype):
+        shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads,
+                 cfg.head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _norm(p, x, cfg):
+    if cfg.use_rmsnorm:
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)
+        return y * p["scale"].astype(x.dtype)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + 1e-5)
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _mlp(p, x, cfg):
+    h = x @ p["wi"].astype(x.dtype)
+    if cfg.gated_mlp:
+        h = jax.nn.silu(x @ p["wg"].astype(x.dtype)) * h
+    else:
+        h = jax.nn.gelu(h)
+    return h @ p["wo"].astype(x.dtype)
+
+
+def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
+                   block_size: int, max_q_per_seq: int):
+    """One ragged step.
+
+    params: unboxed GPT param tree (the "params" subtree).
+    batch: dict of device arrays mirroring ragged.RaggedBatch fields.
+    Returns (logits [S, vocab] — per-slot last-token logits, updated cache).
+    """
+    bb = params["backbone"]
+    dtype = cfg.dtype
+    tokens = batch["tokens"]               # [N]
+    token_slot = batch["token_slot"]       # [N] (-1 pad)
+    token_pos = batch["token_pos"]         # [N]
+    dense_idx = batch["token_dense_idx"]   # [N]
+    block_table = batch["block_table"]     # [S, MB]
+    kv_len = batch["kv_len"]               # [S]
+
+    N = tokens.shape[0]
+    S, MB = block_table.shape
+    Q = max_q_per_seq
+    valid = token_slot >= 0                # [N]
+
+    # ---- embed (reference ragged_ops/embed) ----
+    x = bb["wte"].astype(dtype)[tokens]
+    if not cfg.use_rope:
+        x = x + bb["wpe"].astype(dtype)[token_pos]
+
+    # scatter destinations in the flattened page pool; pad tokens get an
+    # out-of-range index so mode="drop" discards them (never index-clamp pads
+    # to slot 0 — duplicate scatter indices would corrupt real rows)
+    blk_idx = token_pos // block_size                        # [N]
+    page = block_table[jnp.clip(token_slot, 0), blk_idx]     # [N]
+    dest = page * block_size + token_pos % block_size        # [N]
+    big = jnp.iinfo(jnp.int32).max
+    dest = jnp.where(valid, dest, big)
+    scat_slot = jnp.where(valid, token_slot, S)              # S = out of range
+    kvpos = jnp.arange(MB * block_size)[None, :]             # [1, Kmax]
+
+    # flat [L * num_blocks * bs, nkv, hd] views updated IN PLACE through the
+    # donated cache buffer — never rebuild the whole pool (a jnp.stack of
+    # per-layer copies costs a full cache rewrite per step)
+    L = cfg.num_layers
+    pool = cache.k.shape[1] * cache.k.shape[2]          # num_blocks * bs
+    flat_k_all = cache.k.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    flat_v_all = cache.v.reshape(-1, cfg.kv_heads, cfg.head_dim)
+
+    for li in range(cfg.num_layers):
+        blk = bb[f"block_{li}"]
+        ap, np_, mp = blk["Attention_0"], blk["Norm_0"], blk["MLP_0"]
+        h = _norm(np_, x, cfg)
+        q = jnp.einsum("nh,hkd->nkd", h, ap["wq"].astype(dtype))
+        k = jnp.einsum("nh,hkd->nkd", h, ap["wk"].astype(dtype))
+        v = jnp.einsum("nh,hkd->nkd", h, ap["wv"].astype(dtype))
+        if cfg.use_rope:
+            # rope() takes [B, T, n, d] + positions [B, T]
+            q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim)
+            q, k = q[0], k[0]
+
+        # ---- paged KV append (reference linear_blocked_kv_rotary) ----
+        dest_li = jnp.where(valid, li * pool + dest, big)
+        flat_k_all = flat_k_all.at[dest_li].set(
+            k.astype(flat_k_all.dtype), mode="drop")
+        flat_v_all = flat_v_all.at[dest_li].set(
+            v.astype(flat_v_all.dtype), mode="drop")
+
+        # ---- blocked attention (reference blocked_flash), dense-per-slot ----
+        q_dense = jnp.zeros((S, Q) + q.shape[1:], q.dtype).at[
+            scat_slot, dense_idx].set(q, mode="drop")
+        qpos_dense = jnp.zeros((S, Q), jnp.int32).at[
+            scat_slot, dense_idx].set(token_pos, mode="drop")
+        # gather this slot's pages: [S, MB, bs, nkv, hd] -> [S, Kmax, nkv, hd]
+        pages4 = flat_k_all.reshape(-1, block_size, cfg.kv_heads, cfg.head_dim)
+        k_pages = pages4[li * (pool // block_size) + block_table].reshape(
+            S, MB * block_size, cfg.kv_heads, cfg.head_dim)
+        pages4v = flat_v_all.reshape(-1, block_size, cfg.kv_heads,
+                                     cfg.head_dim)
+        v_pages = pages4v[li * (pool // block_size) + block_table].reshape(
+            S, MB * block_size, cfg.kv_heads, cfg.head_dim)
+        # causal over logical positions + kv-length bound; gathered slot j has
+        # logical position j because blocks are appended in order
+        mask = (kvpos[:, None, :] <= qpos_dense[:, :, None]) & \
+               (kvpos[:, None, :] < kv_len[:, None, None])   # [S, Q, Kmax]
+        from deepspeed_tpu import ops
+        o_dense = ops.causal_attention(q_dense.astype(dtype),
+                                       k_pages.astype(dtype),
+                                       v_pages.astype(dtype),
+                                       causal=False, mask=mask)
+        o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
+        o = jnp.where(valid[:, None, None], o, 0)
+        x = x + jnp.einsum("nkd,kdh->nh", o, ap["wo"].astype(dtype))
+
+        # ---- MLP ----
+        x = x + _mlp(mp, _norm(blk["Norm_1"], x, cfg), cfg)
+
+    x = _norm(bb["final_norm"], x, cfg)
+
+    # ---- logits gather (reference ragged_ops/logits_gather): the LAST token
+    # of each slot's q rows carries the next-token distribution ----
+    last_flat = jnp.zeros((S,), jnp.int32).at[scat_slot].max(
+        jnp.arange(N, dtype=jnp.int32), mode="drop")
+    rows = x[last_flat]                                      # [S, H]
+    if cfg.tie_embeddings:
+        unembed = bb["wte"].astype(dtype).T
+    else:
+        unembed = params["lm_head"].astype(dtype)
+    logits = (rows @ unembed).astype(jnp.float32)            # [S, V]
+    return logits, PagedKVCache(k=flat_k_all.reshape(cache.k.shape),
+                                v=flat_v_all.reshape(cache.v.shape))
+
+
+def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
+                 dest, owner_block, block_rank, cfg: GPTConfig,
+                 block_size: int):
+    """One decode micro-step over the flattened KV pool: writes each active
+    slot's kv at ``dest`` and attends over the whole pool with an ownership
+    mask.  Shared by the single-step and burst programs."""
+    bb = params["backbone"]
+    dtype = cfg.dtype
+    S = tokens.shape[0]
+    NB = owner_block.shape[0]
+    pool = NB * block_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    g = nh // nkv
+
+    x = bb["wte"].astype(dtype)[tokens]                       # [S, H]
+    if not cfg.use_rope:
+        x = x + bb["wpe"].astype(dtype)[token_pos]
+
+    j = jnp.arange(pool)
+    owner = owner_block[j // block_size]                      # [pool]
+    kvpos = block_rank[j // block_size] * block_size + j % block_size
+    mask = (owner[None, :] == jnp.arange(S)[:, None]) & \
+           (kvpos[None, :] <= token_pos[:, None]) & active[:, None]  # [S,pool]
+
+    big = jnp.iinfo(jnp.int32).max
+    dest = jnp.where(active, dest, big)
+
+    for li in range(cfg.num_layers):
+        blk = bb[f"block_{li}"]
+        ap = blk["Attention_0"]
+        h = _norm(blk["Norm_0"], x, cfg)
+        q = jnp.einsum("sh,hkd->skd", h, ap["wq"].astype(dtype))
+        k = jnp.einsum("sh,hkd->skd", h, ap["wk"].astype(dtype))
+        v = jnp.einsum("sh,hkd->skd", h, ap["wv"].astype(dtype))
+        if cfg.use_rope:
+            q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd)
+            q, k = q[:, 0], k[:, 0]
+
+        dest_li = jnp.where(active, li * pool + dest, big)
+        flat_k_all = flat_k_all.at[dest_li].set(
+            k.astype(flat_k_all.dtype), mode="drop")
+        flat_v_all = flat_v_all.at[dest_li].set(
+            v.astype(flat_v_all.dtype), mode="drop")
+
+        k_pool = jax.lax.dynamic_slice_in_dim(flat_k_all, li * pool, pool)
+        v_pool = jax.lax.dynamic_slice_in_dim(flat_v_all, li * pool, pool)
+        qg = q.reshape(S, nkv, g, hd)
+        s_log = jnp.einsum("sngd,pnd->sngp", qg, k_pool,
+                           preferred_element_type=jnp.float32)
+        s_log = s_log * (hd ** -0.5)
+        m = mask[:, None, None, :]
+        s_log = jnp.where(m, s_log, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(s_log, axis=-1)
+        probs = jnp.where(m.any(-1, keepdims=True), probs, 0.0)
+        o = jnp.einsum("sngp,pnd->sngd", probs.astype(dtype), v_pool)
+        o = o.reshape(S, nh, hd)
+        x = x + jnp.einsum("skd,kdh->sh", o, ap["wo"].astype(dtype))
+        x = x + _mlp(blk["MLP_0"], _norm(blk["Norm_1"], x, cfg), cfg)
+
+    x = _norm(bb["final_norm"], x, cfg)
+    if cfg.tie_embeddings:
+        unembed = bb["wte"].astype(dtype).T
+    else:
+        unembed = params["lm_head"].astype(dtype)
+    logits = (x @ unembed).astype(jnp.float32)                # [S, V]
+    return logits, flat_k_all, flat_v_all
+
+
+def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
+                        temperature, top_p,
+                        cfg: GPTConfig, *, block_size: int, steps: int,
+                        sample_fn):
+    """T decode steps fused into one device program (``lax``-unrolled scan):
+    each step samples on device and feeds the token to the next step, so a
+    burst costs ONE dispatch instead of T× (transfer + step + sample + fetch) —
+    the decisive win when the host↔device link has per-call latency.
+
+    batch: tokens0 [S] (first-step tokens), active [S], pos0 [S],
+    block_table [S, MB], owner_block [NB], block_rank [NB] — blocks for
+    positions pos0..pos0+T-1 must be pre-allocated.
+    Returns (tokens [T, S], cache).
+    """
+    S = batch["tokens0"].shape[0]
+    flat_k = cache.k.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    flat_v = cache.v.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    bt = batch["block_table"]
+    active = batch["active"]
+
+    def step(carry, _):
+        flat_k, flat_v, tokens, pos, rng = carry
+        dest = bt[jnp.arange(S), pos // block_size] * block_size + \
+            pos % block_size
+        logits, flat_k, flat_v = _decode_core(
+            params, flat_k, flat_v, tokens, active, pos,
+            dest, batch["owner_block"], batch["block_rank"], cfg, block_size)
+        rng, sub = jax.random.split(rng)
+        nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
+        return (flat_k, flat_v, nxt, pos + 1, rng), nxt
+
+    carry = (flat_k, flat_v, batch["tokens0"], batch["pos0"], rng)
+    (flat_k, flat_v, *_), toks = jax.lax.scan(step, carry, None, length=steps)
+    return toks, PagedKVCache(k=flat_k.reshape(cache.k.shape),
+                              v=flat_v.reshape(cache.v.shape))
+
+
+def ragged_decode_forward(params, cache: PagedKVCache, batch,
+                          cfg: GPTConfig, *, block_size: int):
+    """Decode-only step: one token per active slot, attention over the WHOLE
+    contiguous KV pool with an ownership mask instead of per-slot page gathers.
+
+    Gathering [S, max_kv] pages moves the same bytes as streaming the full pool
+    when slots are near capacity, but as a scattered gather; this path reads the
+    pool once per layer at full HBM bandwidth — the XLA-fallback stand-in for
+    the reference's blocked_flash decode kernel (inference/v2/kernels/
+    ragged_ops/blocked_flash).
+
+    batch: tokens [S], active [S] bool, token_pos [S] (position being written),
+    dest [S] flat pool write index, owner_block [NB] int32 (block -> owning
+    slot, -1 free), block_rank [NB] (block's index within its sequence).
+    """
+    flat_k = cache.k.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    flat_v = cache.v.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    logits, flat_k, flat_v = _decode_core(
+        params, flat_k, flat_v, batch["tokens"], batch["active"],
+        batch["token_pos"], batch["dest"], batch["owner_block"],
+        batch["block_rank"], cfg, block_size)
+    return logits, PagedKVCache(k=flat_k.reshape(cache.k.shape),
+                                v=flat_v.reshape(cache.v.shape))

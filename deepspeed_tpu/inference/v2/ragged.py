@@ -1,0 +1,182 @@
+"""Host-side ragged-batching state — paged KV allocator, sequence descriptors,
+ragged batch construction.
+
+TPU-native analog of the reference's ragged device state
+(inference/v2/ragged/): ``BlockedAllocator`` (blocked_allocator.py),
+``DSSequenceDescriptor`` (sequence_descriptor.py:280), ``DSStateManager``
+(ragged_manager.py:206), ``KVCacheManager`` (kv_cache.py:208) and
+``RaggedBatchWrapper`` (ragged_wrapper.py:292).  The reference keeps this
+metadata in pinned host buffers copied to the GPU each step
+(csrc fast_host_buffer.cu); on TPU the same arrays are plain numpy staged
+through the jitted step's donated inputs.
+
+Every shape the device sees is STATIC (token budget, max sequences, max blocks
+per sequence) — raggedness lives entirely in index/mask arrays, which is what
+keeps one compiled XLA program serving every batch composition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over a fixed pool of KV blocks
+    (reference inference/v2/ragged/blocked_allocator.py)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: requested {n} blocks, "
+                f"{len(self._free)} free of {self.num_blocks}")
+        out = self._free[:n]
+        del self._free[:n]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        self._free.extend(blocks)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Tracks one in-flight sequence (reference
+    inference/v2/ragged/sequence_descriptor.py DSSequenceDescriptor)."""
+
+    uid: int
+    slot: int                                  # dense slot in the batch arrays
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0                       # tokens already in the KV cache
+    pending: np.ndarray = dataclasses.field(   # prompt tokens not yet scheduled
+        default_factory=lambda: np.zeros(0, np.int32))
+
+    @property
+    def in_flight(self) -> bool:
+        return self.pending.size > 0
+
+    def kv_blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.seen_tokens + new_tokens
+        need = -(-total // block_size)
+        return max(0, need - len(self.blocks))
+
+
+@dataclasses.dataclass(frozen=True)
+class RaggedBatch:
+    """One scheduled forward step: flat token arrays + per-slot tables
+    (reference ragged_wrapper.py RaggedBatchWrapper)."""
+
+    tokens: np.ndarray          # [N] int32, pad 0
+    token_slot: np.ndarray      # [N] int32, slot of each token, pad -1
+    token_pos: np.ndarray       # [N] int32 logical position, pad 0
+    token_dense_idx: np.ndarray  # [N] int32 index within the slot's q rows
+    block_table: np.ndarray     # [S, MB] int32, pad 0
+    kv_len: np.ndarray          # [S] int32 kv length AFTER this step
+    q_len: np.ndarray           # [S] int32 new tokens this step
+    logits_slots: List[int]     # slots whose last-token logits are meaningful
+    slot_uid: Dict[int, int]    # slot -> uid for this step
+    total_tokens: int
+
+
+class DSStateManager:
+    """Sequence tracking + KV block accounting (reference
+    inference/v2/ragged/ragged_manager.py DSStateManager + kv_cache.py
+    KVCacheManager)."""
+
+    def __init__(self, max_tracked_sequences: int, num_blocks: int,
+                 block_size: int, max_seq_len: int):
+        self.max_tracked_sequences = int(max_tracked_sequences)
+        self.block_size = int(block_size)
+        self.max_seq_len = int(max_seq_len)
+        self.max_blocks_per_seq = -(-self.max_seq_len // self.block_size)
+        self.allocator = BlockedAllocator(num_blocks)
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+        self._free_slots = list(range(self.max_tracked_sequences))
+
+    # ---- reference DSStateManager.get_or_create_sequence ----
+    def get(self, uid: int) -> Optional[SequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def create(self, uid: int) -> SequenceDescriptor:
+        if uid in self._seqs:
+            raise ValueError(f"sequence uid {uid} already tracked")
+        if not self._free_slots:
+            raise RuntimeError(
+                f"sequence capacity exhausted "
+                f"({self.max_tracked_sequences} tracked)")
+        seq = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(0))
+        self._seqs[uid] = seq
+        return seq
+
+    def flush(self, uid: int) -> None:
+        """Release a sequence's blocks + slot (reference engine_v2.flush :242)."""
+        seq = self._seqs.pop(uid)
+        self.allocator.free(seq.blocks)
+        self._free_slots.insert(0, seq.slot)
+
+    def ensure_blocks(self, seq: SequenceDescriptor, new_tokens: int) -> None:
+        need = seq.kv_blocks_needed(new_tokens, self.block_size)
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need))
+
+    @property
+    def tracked(self) -> Dict[int, SequenceDescriptor]:
+        return self._seqs
+
+    @property
+    def free_sequence_slots(self) -> int:
+        return len(self._free_slots)
+
+
+def build_ragged_batch(schedule, state: DSStateManager, token_budget: int,
+                       max_q_per_seq: int) -> RaggedBatch:
+    """Pack (seq, tokens) pairs into the static device arrays.
+
+    schedule: list of (SequenceDescriptor, np.ndarray tokens) — tokens are
+    appended to the sequence's KV at positions [seen, seen+len).
+    """
+    S = state.max_tracked_sequences
+    MB = state.max_blocks_per_seq
+    N = token_budget
+    tokens = np.zeros(N, np.int32)
+    token_slot = np.full(N, -1, np.int32)
+    token_pos = np.zeros(N, np.int32)
+    token_dense = np.zeros(N, np.int32)
+    block_table = np.zeros((S, MB), np.int32)
+    kv_len = np.zeros(S, np.int32)
+    q_len = np.zeros(S, np.int32)
+    logits_slots: List[int] = []
+    slot_uid: Dict[int, int] = {}
+
+    cursor = 0
+    for seq, toks in schedule:
+        n = len(toks)
+        assert n <= max_q_per_seq, (n, max_q_per_seq)
+        assert cursor + n <= N, "token budget exceeded by schedule"
+        sl = seq.slot
+        tokens[cursor:cursor + n] = toks
+        token_slot[cursor:cursor + n] = sl
+        token_pos[cursor:cursor + n] = np.arange(seq.seen_tokens,
+                                                 seq.seen_tokens + n)
+        token_dense[cursor:cursor + n] = np.arange(n)
+        bt = np.asarray(seq.blocks, np.int32)
+        block_table[sl, :len(bt)] = bt
+        kv_len[sl] = seq.seen_tokens + n
+        q_len[sl] = n
+        logits_slots.append(sl)
+        slot_uid[sl] = seq.uid
+        cursor += n
+    return RaggedBatch(tokens=tokens, token_slot=token_slot,
+                       token_pos=token_pos, token_dense_idx=token_dense,
+                       block_table=block_table, kv_len=kv_len, q_len=q_len,
+                       logits_slots=logits_slots, slot_uid=slot_uid,
+                       total_tokens=cursor)
