@@ -36,6 +36,9 @@ def _sample_token(logits, rng, *, do_sample, temperature, top_k, top_p):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k:
+        # top_k >= vocab is the common "disabled" idiom — clamp instead of
+        # letting lax.top_k fail at trace time with an opaque XLA error
+        top_k = min(int(top_k), logits.shape[-1])
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     # top-p (traced scalar; p=1.0 keeps everything — the cutoff lands on the
@@ -114,8 +117,17 @@ class InferenceEngine:
         """Full-sequence logits (no cache): batch = {"input_ids": [B, T]} or a
         raw [B, T] int array."""
         ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        ids = jnp.asarray(ids, jnp.int32)
+        if (not self.model_config.use_rope
+                and ids.shape[-1] > self.model_config.max_seq_len):
+            # without this, the wpe gather index would be silently clamped by
+            # XLA (wrong logits, no error); rope models are length-agnostic in
+            # forward() so long-context scoring stays allowed there
+            raise ValueError(
+                f"input length {ids.shape[-1]} exceeds max_seq_len "
+                f"{self.model_config.max_seq_len}")
         with self.mesh:
-            return self._jit_forward(self.params, jnp.asarray(ids, jnp.int32))
+            return self._jit_forward(self.params, ids)
 
     __call__ = forward
 

@@ -161,6 +161,11 @@ class InferenceEngineV2:
         sm = self.config.state_manager
         # validate BEFORE mutating any state (slots/blocks), so a rejected put
         # leaves the manager clean
+        if len(set(uids)) != len(uids):
+            # a duplicated uid in one batch would make both chunks compute
+            # token_pos from the same stale seen_tokens and scatter into the
+            # same KV slots, silently corrupting the sequence
+            raise ValueError(f"duplicate uids in one put(): {list(uids)}")
         toks_np = [np.asarray(t, np.int32).reshape(-1) for t in tokens_list]
         for uid, toks in zip(uids, toks_np):
             if len(toks) > sm.max_q_per_seq:

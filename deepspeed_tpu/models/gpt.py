@@ -89,6 +89,52 @@ class GPTConfig:
                    mlp_ratio=2, **kw)
 
 
+def _gather_table(table, mesh, vocab_axis="tp"):
+    """Constrain a [rows, embed] lookup table's embed dim to replicated right
+    before a gather.
+
+    Under ZeRO-3 the table is fsdp-sharded on the embed dim; a direct gather
+    would produce embed-sharded activations that SPMD can only reshard to the
+    batch-sharded layout by replicate-then-repartition ("Involuntary full
+    rematerialization").  Un-sharding just the embed dim makes XLA emit one
+    clean all-gather (ZeRO-3's gather-then-use).  The vocab dim KEEPS its tp
+    sharding (Megatron-style vocab-parallel embedding: masked local gather +
+    activation all-reduce), so tp>1 serving never materializes the full table."""
+    if mesh is None:
+        return table
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec0 = None
+    if (vocab_axis and mesh.shape.get(vocab_axis, 1) > 1
+            and table.shape[0] % mesh.shape[vocab_axis] == 0):
+        spec0 = vocab_axis
+    return jax.lax.with_sharding_constraint(
+        table, NamedSharding(mesh, P(spec0, None)))
+
+
+def _pin_activations(x, mesh, seq_parallel: bool):
+    """Constrain [B, T, ...] activations to (dp/fsdp-batch, sp-seq) sharding.
+
+    Applied right after the embedding gather: without it XLA's SPMD partitioner
+    may resolve the gather of an fsdp-sharded table by replicating the result
+    and repartitioning ("Involuntary full rematerialization") — a full
+    allgather of the activations on exactly the fsdp/sp meshes this framework
+    targets.  Axes that don't divide the dim are skipped (e.g. T=1 decode)."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    baxes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    bsize = 1
+    for a in baxes:
+        bsize *= mesh.shape[a]
+    spec = [None] * x.ndim
+    if baxes and x.shape[0] % bsize == 0:
+        spec[0] = baxes if len(baxes) > 1 else baxes[0]
+    sp = mesh.shape.get("sp", 1)
+    if seq_parallel and sp > 1 and x.ndim > 1 and x.shape[1] % sp == 0:
+        spec[1] = "sp"
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
 def _kernel_init():
     return nn.initializers.normal(stddev=0.02)
 
@@ -302,13 +348,16 @@ class GPTBackbone(nn.Module):
         B, T = input_ids.shape
         emb = self.param("wte", _part(_kernel_init(), ("vocab", "embed")),
                          (c.vocab_size, c.hidden_size), c.param_dtype)
-        x = emb.astype(c.dtype)[input_ids]
+        x = _gather_table(emb.astype(c.dtype), self.mesh)[input_ids]
+        x = _pin_activations(x, self.mesh, c.sequence_parallel)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         if not c.use_rope:
             pos_emb = self.param("wpe", _part(_kernel_init(), (None, "embed")),
                                  (c.max_seq_len, c.hidden_size), c.param_dtype)
-            x = x + pos_emb.astype(c.dtype)[positions]
+            x = x + _gather_table(pos_emb.astype(c.dtype), self.mesh,
+                                  vocab_axis=None)[positions]
+            x = _pin_activations(x, self.mesh, c.sequence_parallel)
         if c.dropout > 0 and not deterministic:
             x = nn.Dropout(rate=c.dropout)(x, deterministic=False)
 

@@ -94,6 +94,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
 def _fwd(q, k, v, causal, scale, interpret):
     b, n, t, d = q.shape
+    group = n // k.shape[1]   # GQA: kv head = q head // group (no expansion)
     bq = bk = _block_sizes(t)
     grid = (b, n, t // bq, t // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -103,8 +104,10 @@ def _fwd(q, k, v, causal, scale, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h, iq, ik: (b_, h, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h, iq, ik: (b_, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, iq, ik: (b_, h // group, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h, iq, ik: (b_, h // group, ik, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
@@ -170,11 +173,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk):
-    ik, iq = pl.program_id(2), pl.program_id(3)
-    nq = pl.num_programs(3)
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk, nqb):
+    # grid dim 3 fuses (q-head-in-group, q-block): dk/dv for one KV head sum
+    # over every q head in its GQA group as well as every q block, so the
+    # whole fused loop accumulates into one VMEM scratch
+    ik, j = pl.program_id(2), pl.program_id(3)
+    nj = pl.num_programs(3)
+    iq = j % nqb
 
-    @pl.when(iq == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
@@ -208,7 +215,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(iq == nq - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
@@ -216,11 +223,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
     b, n, t, d = q.shape
+    nkv = k.shape[1]
+    group = n // nkv
     bq = bk = _block_sizes(t)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)[:, :, None, :]                   # [b, n, 1, t]
     qkv_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0))
-    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h, iq, ik: (b_, h, ik, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda b_, h, iq, ik: (b_, h // group, ik, 0))
     row_spec = pl.BlockSpec((1, 1, 1, bq), lambda b_, h, iq, ik: (b_, h, 0, iq))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
@@ -235,13 +245,20 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # kv-major grid: q blocks innermost so dk/dv accumulate in VMEM scratch
-    q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h, ik, iq: (b_, h, iq, 0))
-    kv_spec2 = pl.BlockSpec((1, 1, bk, d), lambda b_, h, ik, iq: (b_, h, ik, 0))
-    row_spec2 = pl.BlockSpec((1, 1, 1, bq), lambda b_, h, ik, iq: (b_, h, 0, iq))
+    # kv-major grid over KV heads: (q-head-in-group, q-block) fused innermost so
+    # dk/dv accumulate the whole GQA group in VMEM scratch
+    nqb = t // bq
+    q_spec2 = pl.BlockSpec(
+        (1, 1, bq, d),
+        lambda b_, h, ik, j: (b_, h * group + j // nqb, j % nqb, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, d), lambda b_, h, ik, j: (b_, h, ik, 0))
+    row_spec2 = pl.BlockSpec(
+        (1, 1, 1, bq),
+        lambda b_, h, ik, j: (b_, h * group + j // nqb, 0, j % nqb))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
-        grid=(b, n, t // bk, t // bq),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          nqb=nqb),
+        grid=(b, nkv, t // bk, group * nqb),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -283,9 +300,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     interpret: Optional[bool] = None):
     """Flash attention over [B, T, N, D] inputs (returns same layout).
 
-    GQA (fewer kv heads) is handled by expanding k/v to the q head count before
-    the kernel; the sum-reduction of dk/dv over the group happens automatically
-    through the expansion's transpose.
+    GQA (fewer kv heads) is consumed natively: the kernels index the kv head as
+    ``q_head // group`` so K/V are never expanded in HBM (the reference
+    blocked_flash consumes grouped KV the same way), and dk/dv accumulate the
+    whole group inside the kv-major backward kernel.
     """
     if not supported(q, k, v, causal=causal):
         raise ValueError(
@@ -298,11 +316,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
         interpret = jax.default_backend() != "tpu"
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    nq, nkv = q.shape[2], k.shape[2]
-    if nkv != nq:
-        rep = nq // nkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
