@@ -1,52 +1,9 @@
-"""Config keys and defaults.
+"""Framework-wide constants.
 
-TPU-native analog of the reference's ``deepspeed/runtime/constants.py`` (457 LoC of
-string keys + defaults). We keep the same JSON surface where it makes sense so a
-DeepSpeed user can bring their ds_config.json mostly unchanged.
+The reference keeps 457 LoC of JSON string keys in runtime/constants.py because
+its config parser reads raw dicts; here pydantic field names ARE the JSON
+surface (config.py), so only the genuinely shared constants live here.
 """
-
-#############################################
-# Batch triad (reference: runtime/constants.py TRAIN_BATCH_SIZE et al.)
-#############################################
-TRAIN_BATCH_SIZE = "train_batch_size"
-TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
-GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
-
-#############################################
-# Optimizer / scheduler
-#############################################
-OPTIMIZER = "optimizer"
-SCHEDULER = "scheduler"
-OPTIMIZER_TYPE_DEFAULT = "adamw"
-MAX_GRAD_NORM = "max_grad_norm"
-GRADIENT_CLIPPING = "gradient_clipping"
-GRADIENT_CLIPPING_DEFAULT = 0.0
-
-#############################################
-# Precision (reference: fp16/bf16 blocks, runtime/config.py)
-#############################################
-FP16 = "fp16"
-BF16 = "bf16"
-INITIAL_LOSS_SCALE = "initial_scale_power"
-LOSS_SCALE_WINDOW = "loss_scale_window"
-MIN_LOSS_SCALE = "min_loss_scale"
-HYSTERESIS = "hysteresis"
-
-#############################################
-# ZeRO (reference: runtime/zero/config.py)
-#############################################
-ZERO_OPTIMIZATION = "zero_optimization"
-
-#############################################
-# Misc engine knobs
-#############################################
-STEPS_PER_PRINT = "steps_per_print"
-STEPS_PER_PRINT_DEFAULT = 10
-WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
-PRESCALE_GRADIENTS = "prescale_gradients"
-GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
-SEED = "seed"
-SEED_DEFAULT = 42
 
 # "auto" sentinel — resolved from model/runtime context like the reference's
 # HF-integration "auto" values (reference: runtime/config.py).

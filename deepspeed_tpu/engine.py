@@ -31,7 +31,9 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm
-from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
+from deepspeed_tpu.config import (DeepSpeedTPUConfig, parse_config,
+                                  warn_inert_config)
+from deepspeed_tpu.monitor import MonitorMaster
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.parallel import partition
 from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
@@ -39,6 +41,9 @@ from deepspeed_tpu.runtime import lr_schedules, optimizers, zero
 from deepspeed_tpu.runtime.precision import (LossScaleState, grads_finite,
                                              init_loss_scale, update_loss_scale)
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (DATA_TIMER, TRAIN_BATCH_TIMER,
+                                       SynchronizedWallClockTimer,
+                                       ThroughputTimer)
 
 
 class TrainState(NamedTuple):
@@ -81,6 +86,7 @@ class DeepSpeedTPUEngine:
         comm.init_distributed()
         comm.comms_logger.configure(config.comms_logger.enabled,
                                     config.comms_logger.verbose)
+        warn_inert_config(config)
 
         # ---- mesh (replaces reference groups.initialize / mpu) ----
         if mesh is None:
@@ -187,8 +193,9 @@ class DeepSpeedTPUEngine:
         # ---- build + jit the step functions ----
         self._jit_init = jax.jit(
             self._make_init(), out_shardings=self._as_shardings_tuple())
+        self._train_batch_fn = self._make_train_batch()
         self._jit_train_batch = jax.jit(
-            self._make_train_batch(),
+            self._train_batch_fn,
             donate_argnums=(0,),
             out_shardings=(self._as_shardings_tuple(), None))
         self._jit_grad = jax.jit(self._make_grad_fn())
@@ -206,6 +213,18 @@ class DeepSpeedTPUEngine:
         self.global_steps = 0
         self._last_metrics: Optional[StepMetrics] = None
         self._step_times = []
+
+        # ---- observability (reference: MonitorMaster engine.py:1000,
+        #      EngineTimers :145, flops profiler hook :1797) ----
+        self.monitor = MonitorMaster(config)
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(warmup_steps=1)
+        self.wall_clock_breakdown = bool(config.wall_clock_breakdown)
+        self._flops_profiled = False
+        self._last_batch = None
+        if config.dump_state:
+            log_dist("config state:\n" + config.model_dump_json(indent=2),
+                     ranks=[0])
 
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(annotated))
@@ -403,21 +422,39 @@ class DeepSpeedTPUEngine:
         for the non-pipelined engine.
         """
         t0 = time.perf_counter()
-        first = np.asarray(jax.tree_util.tree_leaves(batch)[0])
-        if first.shape[0] != self.gas:
-            if first.shape[0] != self.config.train_batch_size:
+        self.tput_timer.start()
+        first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
+        if first_shape[0] != self.gas:
+            if first_shape[0] != self.config.train_batch_size:
                 raise ValueError(
-                    f"train_batch leading dim {first.shape[0]} is neither "
+                    f"train_batch leading dim {first_shape[0]} is neither "
                     f"gas={self.gas} nor train_batch_size="
                     f"{self.config.train_batch_size}")
             batch = self._reshape_gas(batch)
+        lead_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
+        # [gas, micro_global, T, ...] → tokens per optimizer step
+        tokens = (int(np.prod(lead_shape[:3])) if len(lead_shape) >= 3 else 0)
+        self.timers(DATA_TIMER).start()
         batch = self._shard_batch(batch, leading_gas=True)
+        self.timers(DATA_TIMER).stop()
+        fp = self.config.flops_profiler
+        profile_pending = (fp.enabled and not self._flops_profiled
+                           and self.global_steps + 1 >= fp.profile_step)
+        if profile_pending:
+            self._last_batch = batch  # traced by the flops profiler, then freed
+        self.timers(TRAIN_BATCH_TIMER).start()
         with self.mesh:
             self.state, metrics = self._jit_train_batch(self.state, batch)
+        if self.wall_clock_breakdown or profile_pending:
+            # synchronize so the timer covers device execution, not just
+            # dispatch (axon: fetching a value is the only reliable sync)
+            jax.device_get(metrics.loss)
+        self.timers(TRAIN_BATCH_TIMER).stop()
         self.global_steps += 1
         self._last_metrics = metrics
         self._step_times.append(time.perf_counter() - t0)
-        self._maybe_print(metrics)
+        self.tput_timer.stop(int(self.config.train_batch_size), tokens)
+        self._post_step_reporting(metrics)
         return metrics
 
     def forward(self, batch):
@@ -466,7 +503,7 @@ class DeepSpeedTPUEngine:
         self._micro_steps = 0
         self.global_steps += 1
         self._last_metrics = metrics
-        self._maybe_print(metrics)
+        self._post_step_reporting(metrics)
         return metrics
 
     # ------------------------------------------------------------------ info
@@ -504,6 +541,85 @@ class DeepSpeedTPUEngine:
                 f"lr={self.get_lr()[0]:.3e} "
                 f"grad_norm={float(metrics.grad_norm):.3f} "
                 f"loss_scale={float(metrics.loss_scale):.0f}", ranks=[0])
+
+    def _post_step_reporting(self, metrics: StepMetrics):
+        """Console print + monitor fan-out + timer log + flops profile, at
+        their configured cadences (reference engine.py:2264 _write_monitor,
+        :1797 flops profiler hook, :145 EngineTimers)."""
+        self._maybe_print(metrics)
+        spp = self.config.steps_per_print
+        at_cadence = spp and self.global_steps % spp == 0
+        # monitors write even when console printing is off (steps_per_print=0
+        # means every step, matching the reference's monitor-independent
+        # cadence; costs one device sync per write)
+        monitor_cadence = at_cadence or (not spp and self.monitor.enabled)
+        if self.monitor.enabled and monitor_cadence:
+            # x-axis is samples seen, matching the reference's
+            # Train/Samples/* convention (engine.py:2272)
+            samples = self.global_steps * int(self.config.train_batch_size)
+            events = [
+                ("Train/Samples/train_loss", float(metrics.loss), samples),
+                ("Train/Samples/lr", self.get_lr()[0], samples),
+                ("Train/Samples/grad_norm", float(metrics.grad_norm), samples),
+                ("Train/Samples/loss_scale", float(metrics.loss_scale),
+                 samples),
+            ]
+            if self.tput_timer.avg_samples_per_sec:
+                events.append(("Train/Samples/throughput_samples_per_sec",
+                               self.tput_timer.avg_samples_per_sec, samples))
+            if self.tput_timer.avg_tokens_per_sec:
+                events.append(("Train/Samples/throughput_tokens_per_sec",
+                               self.tput_timer.avg_tokens_per_sec, samples))
+            self.monitor.write_events(events)
+        if self.wall_clock_breakdown and at_cadence:
+            self.timers.log([DATA_TIMER, TRAIN_BATCH_TIMER], normalizer=spp)
+        fp = self.config.flops_profiler
+        if (fp.enabled and not self._flops_profiled
+                and self.global_steps >= fp.profile_step):
+            self._flops_profiled = True
+            self._print_flops_profile()
+        if self.config.memory_breakdown and self.global_steps == 1:
+            self._print_memory_breakdown()
+
+    def _print_flops_profile(self):
+        from deepspeed_tpu.profiling import FlopsProfiler
+        if self._last_batch is None:
+            logger.warning(
+                "flops profiler: no traced batch available — the profiler "
+                "supports the train_batch() API only, not the "
+                "forward/backward/step trio")
+            return
+        fp = self.config.flops_profiler
+        prof = FlopsProfiler(fp)
+        try:
+            prof.count(self._train_batch_fn, self.state, self._last_batch)
+        except Exception as e:  # profiling must never kill training
+            logger.warning(f"flops profiler failed to trace the step: {e!r}")
+            return
+        finally:
+            self._last_batch = None  # free the pinned device batch
+        # _step_times[-1] was synchronized (profile_pending forced a fetch)
+        prof.latency = self._step_times[-1] if self._step_times else 0.0
+        prof.print_model_profile(params=self.state.params,
+                                 module_depth=fp.module_depth,
+                                 top_modules=fp.top_modules,
+                                 detailed=fp.detailed,
+                                 output_file=fp.output_file)
+
+    def _print_memory_breakdown(self):
+        """reference: see_memory_usage / memory_breakdown config."""
+        lines = []
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if stats:
+                used = stats.get("bytes_in_use", 0) / 2**30
+                limit = stats.get("bytes_limit", 0) / 2**30
+                peak = stats.get("peak_bytes_in_use", 0) / 2**30
+                lines.append(f"  {d}: in_use={used:.2f}GiB "
+                             f"peak={peak:.2f}GiB limit={limit:.2f}GiB")
+        if lines:
+            log_dist("device memory breakdown:\n" + "\n".join(lines),
+                     ranks=[0])
 
     # ------------------------------------------------------------------ ckpt
 

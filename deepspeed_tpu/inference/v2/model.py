@@ -42,15 +42,10 @@ class PagedKVCache(NamedTuple):
 
 
 def _norm(p, x, cfg):
+    from deepspeed_tpu.ops import layer_norm, rms_norm
     if cfg.use_rmsnorm:
-        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
-                       keepdims=True)
-        y = x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)
-        return y * p["scale"].astype(x.dtype)
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + 1e-5)
-    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+        return rms_norm(x, p["scale"])
+    return layer_norm(x, p["scale"], p["bias"])
 
 
 def _mlp(p, x, cfg):

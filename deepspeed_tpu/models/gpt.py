@@ -166,20 +166,15 @@ class Norm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from deepspeed_tpu.ops import layer_norm, rms_norm
         c = self.cfg
         scale = self.param("scale", _part(nn.initializers.ones, ("embed",)),
                            (c.hidden_size,), c.param_dtype)
         if c.use_rmsnorm:
-            var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
-                           keepdims=True)
-            y = x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)
-            return y * scale.astype(x.dtype)
+            return rms_norm(x, scale)
         bias = self.param("bias", _part(nn.initializers.zeros, ("embed",)),
                           (c.hidden_size,), c.param_dtype)
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mean) * jax.lax.rsqrt(var + 1e-5)
-        return y * scale.astype(x.dtype) + bias.astype(x.dtype)
+        return layer_norm(x, scale, bias)
 
 
 def attend_with_mask(q, k, v, mask):

@@ -1,0 +1,4 @@
+from deepspeed_tpu.monitor.monitor import (MonitorMaster, TensorBoardMonitor,
+                                           WandbMonitor, csvMonitor)
+
+__all__ = ["MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "csvMonitor"]

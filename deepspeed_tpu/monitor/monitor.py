@@ -1,0 +1,156 @@
+"""Experiment monitors — TensorBoard / CSV / W&B fan-out.
+
+Analog of the reference monitor subsystem (monitor/monitor.py:30 MonitorMaster,
+monitor/{tensorboard,csv_monitor,wandb}.py): the engine emits scalar events as
+``(name, value, step)`` tuples and ``MonitorMaster`` fans them out to every
+enabled writer on process rank 0 (multi-host: exactly one process writes).
+
+Differences from the reference: rank filtering uses ``jax.process_index()``
+instead of torch.distributed; TensorBoard rides torch's bundled SummaryWriter
+(tensorboardX as fallback); a missing backend package degrades to a loud
+warning instead of an ImportError so a shared ds_config doesn't kill training
+on machines without wandb.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]  # (name, scalar value, global step)
+
+
+def _is_rank0() -> bool:
+    import jax
+    return jax.process_index() == 0
+
+
+class Monitor:
+    """Writer interface (reference monitor/monitor.py:13)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """reference monitor/tensorboard.py (SummaryWriter.add_scalar per event)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError:  # pragma: no cover
+                from tensorboardX import SummaryWriter
+        except ImportError:  # pragma: no cover
+            logger.warning(
+                "tensorboard monitor enabled but no SummaryWriter backend "
+                "(torch.utils.tensorboard / tensorboardX) is importable — "
+                "tensorboard events will be dropped")
+            self.enabled = False
+            return
+        log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+        os.makedirs(log_dir, exist_ok=True)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class csvMonitor(Monitor):
+    """reference monitor/csv_monitor.py — one csv file per event name."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.log_dir = None
+        self._seen = set()
+        if not self.enabled:
+            return
+        self.log_dir = os.path.join(config.output_path or "./csv_monitor",
+                                    config.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            fname = os.path.join(
+                self.log_dir, name.replace("/", "_").replace(" ", "_") + ".csv")
+            header = name.split("/")[-1]
+            new = fname not in self._seen and not os.path.exists(fname)
+            self._seen.add(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", header])
+                w.writerow([int(step), float(value)])
+
+
+class WandbMonitor(Monitor):
+    """reference monitor/wandb.py."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if not self.enabled:
+            return
+        try:
+            import wandb
+        except ImportError:
+            logger.warning(
+                "wandb monitor enabled but the wandb package is not installed "
+                "— wandb events will be dropped")
+            self.enabled = False
+            return
+        self._wandb = wandb
+        wandb.init(project=config.project, group=config.group,
+                   entity=config.team)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self._wandb is None:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: float(value)}, step=int(step))
+
+
+class MonitorMaster(Monitor):
+    """Fan-out writer (reference monitor/monitor.py:30): rank 0 only."""
+
+    def __init__(self, config):
+        # config is the top-level DeepSpeedTPUConfig (carries .tensorboard,
+        # .csv_monitor, .wandb sub-blocks)
+        self.tb_monitor = None
+        self.csv_monitor = None
+        self.wandb_monitor = None
+        self.enabled = (config.tensorboard.enabled or config.csv_monitor.enabled
+                        or config.wandb.enabled)
+        if not _is_rank0():
+            self.enabled = False
+            return
+        if config.tensorboard.enabled:
+            self.tb_monitor = TensorBoardMonitor(config.tensorboard)
+        if config.csv_monitor.enabled:
+            self.csv_monitor = csvMonitor(config.csv_monitor)
+        if config.wandb.enabled:
+            self.wandb_monitor = WandbMonitor(config.wandb)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor):
+            if m is not None:
+                m.write_events(event_list)

@@ -17,8 +17,9 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops import registry
-from deepspeed_tpu.ops.cross_entropy import lm_cross_entropy
+from deepspeed_tpu.ops.cross_entropy import lm_cross_entropy, masked_nll_sum
 from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.norms import layer_norm, rms_norm
 from deepspeed_tpu.ops.registry import dispatch, list_ops, op_report, register_op
 
 
@@ -92,4 +93,5 @@ def causal_attention(q, k, v, *, causal: bool = True,
 
 
 __all__ = ["causal_attention", "flash_attention", "lm_cross_entropy",
+           "masked_nll_sum", "rms_norm", "layer_norm",
            "op_report", "register_op", "dispatch", "list_ops", "registry"]

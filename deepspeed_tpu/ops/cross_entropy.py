@@ -27,6 +27,16 @@ def _chunk_loss(x, w, labels, mask):
     return jnp.sum((lse - ll) * mask)
 
 
+def masked_nll_sum(x, unembed, labels, mask):
+    """Sum of masked token NLLs of ``x @ unembed`` (no mean) — the shared loss
+    body for callers that aggregate their own denominator across microbatches
+    (pipe/module.py's per-microbatch scan).  x: [..., H]; labels/mask: [...]."""
+    h = x.shape[-1]
+    return _chunk_loss(x.reshape(-1, h), unembed,
+                       labels.reshape(-1).astype(jnp.int32),
+                       mask.reshape(-1).astype(jnp.float32))
+
+
 def lm_cross_entropy(x, unembed, labels, mask,
                      chunk_size: Optional[int] = 512):
     """Mean masked cross entropy of ``x @ unembed`` against ``labels``.

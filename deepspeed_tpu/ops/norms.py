@@ -1,0 +1,36 @@
+"""Normalization ops — the ONE RMSNorm/LayerNorm body in the codebase.
+
+Reference analog: csrc/transformer/inference/csrc/{layer_norm,rms_norm}.cu.
+On TPU these are bandwidth-trivial elementwise chains XLA fuses into the
+surrounding matmuls; the reason to centralize them is numeric discipline, not
+speed: round-1 review found three drifting copies (models/gpt.py,
+pipe/module.py, inference/v2/model.py) with different dtype behavior.
+
+Canonical discipline: statistics in fp32, normalized output cast back to the
+input dtype, scale/bias applied in the input dtype.  Callers that want a full
+fp32 norm (the pipeline's final-norm+loss) pass fp32 inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+RMS_EPS = 1e-6
+LN_EPS = 1e-5
+
+
+def rms_norm(x, scale, eps: float = RMS_EPS):
+    """RMSNorm: x * rsqrt(mean(x^2) + eps) * scale, fp32 statistics."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps).astype(x.dtype)
+    return y * scale.astype(x.dtype)
+
+
+def layer_norm(x, scale, bias, eps: float = LN_EPS):
+    """LayerNorm with fp32 statistics, output in x.dtype."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return y * scale.astype(x.dtype) + bias.astype(x.dtype)

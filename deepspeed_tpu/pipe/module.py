@@ -195,20 +195,17 @@ class PipeGPT:
                 else _unbox_one(p["head"]).astype(jnp.float32))
 
         def micro_loss(carry, xs):
+            from deepspeed_tpu.ops import (layer_norm, masked_nll_sum,
+                                           rms_norm)
             h, lab, msk = xs
-            h = h.astype(jnp.float32)
+            h = h.astype(jnp.float32)   # final norm + loss in full fp32
             if c.use_rmsnorm:
-                var = jnp.mean(jnp.square(h), axis=-1, keepdims=True)
-                h = h * jax.lax.rsqrt(var + 1e-6) * scale
+                h = rms_norm(h, scale)
             else:
-                mean = jnp.mean(h, axis=-1, keepdims=True)
-                var = jnp.var(h, axis=-1, keepdims=True)
-                h = (h - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
-            logits = h @ head
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+                h = layer_norm(h, scale, bias)
             s_nll, s_msk = carry
-            return (s_nll + jnp.sum(nll * msk), s_msk + jnp.sum(msk)), None
+            return (s_nll + masked_nll_sum(h, head, lab, msk),
+                    s_msk + jnp.sum(msk)), None
 
         (sum_nll, sum_mask), _ = lax.scan(
             micro_loss, (jnp.float32(0.0), jnp.float32(0.0)),

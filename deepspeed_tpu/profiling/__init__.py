@@ -1,0 +1,4 @@
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    jaxpr_flops_by_module)
+
+__all__ = ["FlopsProfiler", "jaxpr_flops_by_module"]

@@ -1,0 +1,165 @@
+"""Monitor fan-out, flops profiler, timers, and inert-config warnings —
+the analog of the reference's tests/unit/monitor/ + profiling tests, plus the
+round-1 requirement that accepted-but-unimplemented config must scream."""
+
+import csv
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.config import parse_config, warn_inert_config
+from deepspeed_tpu.models import GPT, GPTConfig
+
+VOCAB, SEQ = 64, 16
+
+
+def _data(n, bs, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    for _ in range(n):
+        yield {"input_ids": pool[rng.integers(0, 8, size=(bs,))]}
+
+
+def _engine(extra_cfg, tmp_path, steps=3):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"dp": -1},
+        "steps_per_print": 1,
+        **extra_cfg,
+    }
+    example = {"input_ids": np.zeros((1, SEQ), np.int32)}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ)),
+        config=cfg, example_batch=example)
+    for batch in _data(steps, engine.train_batch_size):
+        engine.train_batch(batch)
+    return engine
+
+
+class TestMonitor:
+    def test_csv_monitor_writes_scalars(self, tmp_path):
+        out = str(tmp_path / "csv")
+        engine = _engine(
+            {"csv_monitor": {"enabled": True, "output_path": out,
+                             "job_name": "job"}}, tmp_path)
+        files = {os.path.basename(p) for p in glob.glob(out + "/job/*.csv")}
+        assert "Train_Samples_train_loss.csv" in files
+        assert "Train_Samples_lr.csv" in files
+        with open(os.path.join(out, "job", "Train_Samples_train_loss.csv")) as f:
+            rows = list(csv.reader(f))
+        assert rows[0] == ["step", "train_loss"]
+        assert len(rows) == 4  # header + 3 steps (steps_per_print=1)
+        assert float(rows[1][1]) > 0
+
+    def test_tensorboard_monitor(self, tmp_path):
+        pytest.importorskip("torch.utils.tensorboard")
+        out = str(tmp_path / "tb")
+        _engine({"tensorboard": {"enabled": True, "output_path": out,
+                                 "job_name": "job"}}, tmp_path)
+        events = glob.glob(out + "/job/events.out.tfevents.*")
+        assert events and os.path.getsize(events[0]) > 0
+
+    def test_monitor_disabled_writes_nothing(self, tmp_path):
+        engine = _engine({}, tmp_path)
+        assert not engine.monitor.enabled
+
+
+class TestFlopsProfiler:
+    def test_jaxpr_count_matches_analytic(self):
+        """A bare matmul chain: the jaxpr walk must count exactly 2*M*N*K."""
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.profiling import jaxpr_flops_by_module
+
+        def f(a, b, c):
+            return (a @ b) @ c
+
+        a = jnp.zeros((4, 8)); b = jnp.zeros((8, 16)); c = jnp.zeros((16, 2))
+        flops = sum(jaxpr_flops_by_module(f, a, b, c).values())
+        assert flops == 2 * 4 * 8 * 16 + 2 * 4 * 16 * 2
+
+    def test_scan_bodies_scaled_by_trip_count(self):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.profiling import jaxpr_flops_by_module
+
+        def f(x):
+            def body(h, _):
+                return h @ h, None
+            out, _ = jax.lax.scan(body, x, None, length=5)
+            return out
+
+        x = jnp.zeros((8, 8))
+        flops = sum(jaxpr_flops_by_module(f, x).values())
+        assert flops == 5 * 2 * 8 * 8 * 8
+
+    def test_engine_prints_profile(self, tmp_path, capsys):
+        out_file = str(tmp_path / "profile.txt")
+        _engine({"flops_profiler": {"enabled": True, "profile_step": 2,
+                                    "output_file": out_file}}, tmp_path)
+        text = open(out_file).read()
+        assert "Flops Profiler" in text
+        assert "flops per step (jaxpr)" in text
+        # per-module tree must attribute flops to flax module scopes
+        assert "block_0" in text or "backbone" in text
+
+    def test_profile_flops_scale_with_model(self, tmp_path):
+        """Doubling layers must roughly double counted step flops."""
+        from deepspeed_tpu.profiling import FlopsProfiler
+        import jax
+
+        def build(n_layers):
+            model = GPT(GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ,
+                                  num_layers=n_layers, num_heads=4, head_dim=8,
+                                  hidden_size=32, mlp_ratio=2))
+            batch = {"input_ids": np.zeros((2, SEQ), np.int32)}
+            params = model.init(jax.random.PRNGKey(0), batch)
+            fn = lambda p, b: model.apply(p, b, rngs={"dropout": jax.random.PRNGKey(0)})  # noqa: E731
+            return FlopsProfiler().count(fn, params, batch).flops
+
+        f2, f4 = build(2), build(4)
+        assert 1.7 < f4 / f2 < 2.3
+
+
+class TestTimersAndBreakdown:
+    def test_wall_clock_breakdown_records(self, tmp_path):
+        from deepspeed_tpu.utils.timer import TRAIN_BATCH_TIMER
+        engine = _engine({"wall_clock_breakdown": True}, tmp_path)
+        t = engine.timers(TRAIN_BATCH_TIMER)
+        # records were consumed by the cadence log (steps_per_print=1) — the
+        # timer must exist and have timed at least one step overall
+        assert engine.tput_timer.avg_samples_per_sec > 0
+
+    def test_throughput_timer_counts_tokens(self, tmp_path):
+        engine = _engine({}, tmp_path, steps=3)
+        # warmup_steps=1 → 2 counted steps × tbs × SEQ tokens
+        expected = 2 * engine.train_batch_size * SEQ
+        assert engine.tput_timer.total_tokens == expected
+
+
+class TestInertConfigWarnings:
+    def test_unimplemented_keys_warn(self, caplog):
+        cfg = parse_config({
+            "zero_optimization": {
+                "stage": 3,
+                "offload_param": {"device": "nvme"},
+                "zero_quantized_weights": True,
+            },
+            "gradient_compression": {"enabled": True},
+        })
+        inert = warn_inert_config(cfg)
+        joined = " ".join(inert)
+        assert "offload_param" in joined
+        assert "zero_quantized_weights" in joined
+        assert "gradient_compression" in joined
+
+    def test_clean_config_does_not_warn(self):
+        cfg = parse_config({"zero_optimization": {"stage": 2},
+                            "bf16": {"enabled": True}})
+        assert warn_inert_config(cfg) == []

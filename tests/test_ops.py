@@ -42,6 +42,19 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    atol=2e-5, rtol=1e-4)
 
+    def test_gqa_backward_matches_xla(self, qkv):
+        """dk/dv of the fused (q-head-in-group, q-block) kernel grid must sum
+        contributions over the whole GQA group."""
+        q, k, v = qkv
+        k, v = k[:, :, :2], v[:, :, :2]      # 4 q heads over 2 kv heads
+        gr = jax.grad(lambda *a: jnp.sum(
+            ops.causal_attention(*a, impl="xla") ** 2), argnums=(0, 1, 2))
+        gf = jax.grad(lambda *a: jnp.sum(
+            ops.flash_attention(*a, interpret=True) ** 2), argnums=(0, 1, 2))
+        for a, b in zip(gr(q, k, v), gf(q, k, v)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3)
+
     def test_noncausal(self, qkv):
         q, k, v = qkv
         ref = ops.causal_attention(q, k, v, causal=False, impl="xla")
