@@ -292,9 +292,6 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
     from deepspeed_tpu.utils.logging import logger
     inert = []
     z = cfg.zero_optimization
-    if z.offload_optimizer.device != "none":
-        inert.append("zero_optimization.offload_optimizer (host-offloaded "
-                     "optimizer states)")
     if z.offload_param.device != "none":
         inert.append("zero_optimization.offload_param (param offload to "
                      "cpu/nvme)")

@@ -1,0 +1,115 @@
+// Host-side fused Adam(W) for ZeRO-Offload.
+//
+// TPU-native analog of the reference DeepSpeedCPUAdam
+// (csrc/adam/cpu_adam.cpp + cpu_adam_impl.cpp, AVX2/AVX512 via
+// csrc/includes/simd.h): the optimizer state for offloaded parameters lives in
+// host memory and this kernel applies the update there, emitting the new
+// low-precision (bf16) weights that stream back to the device.
+//
+// Differences from the reference: vectorization comes from the compiler
+// (-O3 -march=native auto-vectorizes the fp32 loop; no hand-rolled intrinsic
+// tiers), threading is a plain std::thread range split, and the bf16
+// round-to-nearest-even conversion is fused into the same pass so the weights
+// are touched exactly once.
+//
+// Math matches optax.adamw / optax.adam exactly (same op order, fp32):
+//   g      = grad * grad_scale                  (loss-scale/accum/clip folded)
+//   m      = b1*m + (1-b1)*g
+//   v      = b2*v + (1-b2)*g*g
+//   mhat   = m / bias_c1;  vhat = v / bias_c2   (bias_cK = 1 - bK^step)
+//   adamw:  w -= lr * (mhat / (sqrt(vhat) + eps) + wd * w)
+//   adam:   g += wd * w before the moment update (L2-into-grad, torch style)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint16_t float_to_bf16_rne(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, sizeof(bits));
+  if ((bits & 0x7fffffffu) > 0x7f800000u) {  // NaN: quiet, keep payload bit
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  }
+  uint32_t lsb = (bits >> 16) & 1u;
+  bits += 0x7fffu + lsb;  // round to nearest even
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+struct AdamArgs {
+  float* w;
+  const float* g;
+  float* m;
+  float* v;
+  float lr, beta1, beta2, eps, weight_decay;
+  int adamw_mode;
+  float bias_c1, bias_c2, grad_scale;
+  uint16_t* w_bf16;  // nullable: also emit bf16 weights
+};
+
+void adam_range(const AdamArgs& a, int64_t lo, int64_t hi) {
+  const float b1 = a.beta1, b2 = a.beta2;
+  const float one_m_b1 = 1.0f - b1, one_m_b2 = 1.0f - b2;
+  const float inv_c1 = 1.0f / a.bias_c1, inv_c2 = 1.0f / a.bias_c2;
+  for (int64_t i = lo; i < hi; ++i) {
+    float grad = a.g[i] * a.grad_scale;
+    float w = a.w[i];
+    if (!a.adamw_mode && a.weight_decay != 0.0f) grad += a.weight_decay * w;
+    float m = b1 * a.m[i] + one_m_b1 * grad;
+    float v = b2 * a.v[i] + one_m_b2 * grad * grad;
+    a.m[i] = m;
+    a.v[i] = v;
+    float mhat = m * inv_c1;
+    float vhat = v * inv_c2;
+    float update = mhat / (std::sqrt(vhat) + a.eps);
+    if (a.adamw_mode && a.weight_decay != 0.0f) update += a.weight_decay * w;
+    w -= a.lr * update;
+    a.w[i] = w;
+    if (a.w_bf16 != nullptr) a.w_bf16[i] = float_to_bf16_rne(w);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Single fused pass over one flat fp32 buffer (threads split the range).
+void ds_adam_update(float* w, const float* g, float* m, float* v, int64_t n,
+                    float lr, float beta1, float beta2, float eps,
+                    float weight_decay, int adamw_mode, float bias_c1,
+                    float bias_c2, float grad_scale, uint16_t* w_bf16,
+                    int nthreads) {
+  AdamArgs args{w,     g,          m,       v,       lr,
+                beta1, beta2,      eps,     weight_decay, adamw_mode,
+                bias_c1, bias_c2,  grad_scale, w_bf16};
+  if (nthreads <= 1 || n < (1 << 16)) {
+    adam_range(args, 0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([args, lo, hi] { adam_range(args, lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// fp32 -> bf16 (round-to-nearest-even) bulk convert, for param streaming.
+void ds_f32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = float_to_bf16_rne(src[i]);
+}
+
+// Sum of squares (for host-side global grad-norm before clipping).
+double ds_sumsq(const float* x, int64_t n) {
+  double acc = 0.0;
+  for (int64_t i = 0; i < n; ++i) acc += (double)x[i] * (double)x[i];
+  return acc;
+}
+
+}  // extern "C"

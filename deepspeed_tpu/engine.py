@@ -109,9 +109,16 @@ class DeepSpeedTPUEngine:
 
         self.zero_stage = config.zero_optimization.stage
         self.compute_dtype = config.compute_dtype
+        # ZeRO-Offload: optimizer state + fp32 masters live on the HOST
+        # (runtime/offload.py); the device holds only compute-dtype params and
+        # runs a grads-only program each step
+        off = config.zero_optimization.offload_optimizer
+        self.offloading = off.device != "none"
         # master-weight mode iff low-precision params (reference: BF16_Optimizer /
-        # fp16 fused optimizer wrap client optimizer the same way)
-        self.use_master_weights = config.bf16.enabled or config.fp16.enabled
+        # fp16 fused optimizer wrap client optimizer the same way); under
+        # offload the fp32 master lives host-side instead of in the opt state
+        self.use_master_weights = ((config.bf16.enabled or config.fp16.enabled)
+                                   and not self.offloading)
         self.gas = int(config.gradient_accumulation_steps)
 
         # ---- model functions ----
@@ -141,7 +148,23 @@ class DeepSpeedTPUEngine:
         if self.lr_schedule is None and config.scheduler is not None:
             self.lr_schedule = lr_schedules.build_schedule(
                 config.scheduler.type, config.scheduler.params)
-        self.optimizer, self._opt_params = self._build_tx(client_optimizer)
+        if self.offloading:
+            from deepspeed_tpu.runtime.offload import OffloadAdam
+            if client_optimizer is not None:
+                raise ValueError(
+                    "ZeRO-Offload builds its own host Adam (the reference "
+                    "likewise swaps client optimizers for DeepSpeedCPUAdam); "
+                    "drop the client optimizer or offload")
+            self.offload_opt = OffloadAdam(
+                config.optimizer.type, config.optimizer.params,
+                device=off.device, nvme_path=off.nvme_path)
+            # API contract: initialize() returns the swapped-in host optimizer
+            # (reference returns DeepSpeedCPUAdam on the offload path)
+            self.optimizer = self.offload_opt
+            self._opt_params = dict(config.optimizer.params)
+        else:
+            self.offload_opt = None
+            self.optimizer, self._opt_params = self._build_tx(client_optimizer)
 
         # normalize the example batch's leading dim to the global microbatch so
         # init tracing and the jitted step see shardable shapes; only leaves
@@ -173,9 +196,14 @@ class DeepSpeedTPUEngine:
             abstract_params = jax.tree_util.tree_map(
                 lambda l: jax.ShapeDtypeStruct(l.shape, self.compute_dtype)
                 if jnp.issubdtype(l.dtype, jnp.floating) else l, abstract_params)
-        abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
-        self.opt_shardings = partition.opt_state_shardings(
-            abstract_opt, annotated, mesh, self.zero_stage)
+        if self.offloading:
+            # optimizer state lives host-side; nothing on device
+            abstract_opt = ()
+            self.opt_shardings = ()
+        else:
+            abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
+            self.opt_shardings = partition.opt_state_shardings(
+                abstract_opt, annotated, mesh, self.zero_stage)
 
         self.state_shardings = TrainState(
             step=NamedSharding(mesh, P()),
@@ -193,18 +221,33 @@ class DeepSpeedTPUEngine:
         # ---- build + jit the step functions ----
         self._jit_init = jax.jit(
             self._make_init(), out_shardings=self._as_shardings_tuple())
-        self._train_batch_fn = self._make_train_batch()
-        self._jit_train_batch = jax.jit(
-            self._train_batch_fn,
-            donate_argnums=(0,),
-            out_shardings=(self._as_shardings_tuple(), None))
         self._jit_grad = jax.jit(self._make_grad_fn())
-        self._jit_apply = jax.jit(
-            self._make_apply_fn(), donate_argnums=(0,),
-            out_shardings=(self._as_shardings_tuple(), None))
+        if self.offloading:
+            # device runs grads-only; optimizer step is host-side
+            self._grads_batch_fn = self._make_grads_batch()
+            self._train_batch_fn = self._grads_batch_fn  # flops profiler trace
+            self._jit_grads_batch = jax.jit(
+                self._grads_batch_fn,
+                out_shardings=(self.grad_shardings, None, None))
+            self._jit_train_batch = None
+            self._jit_apply = None
+            self._jit_gnorm = jax.jit(optax.global_norm)
+        else:
+            self._train_batch_fn = self._make_train_batch()
+            self._jit_train_batch = jax.jit(
+                self._train_batch_fn,
+                donate_argnums=(0,),
+                out_shardings=(self._as_shardings_tuple(), None))
+            self._jit_apply = jax.jit(
+                self._make_apply_fn(), donate_argnums=(0,),
+                out_shardings=(self._as_shardings_tuple(), None))
 
         with self.mesh:
             self.state = self._jit_init(rng, example_batch)
+        if self.offloading:
+            # stream the initial params to host: fp32 masters + moments are
+            # built there (zero.Init-at-construction analog for the host tier)
+            self.offload_opt.initialize(jax.device_get(self.state.params))
 
         # forward/backward/step compatibility buffers
         self._accum_grads = None
@@ -262,15 +305,16 @@ class DeepSpeedTPUEngine:
 
     def _make_init(self):
         compute_dtype = self.compute_dtype
-        use_master = self.use_master_weights
+        cast_at_init = self.use_master_weights or self.offloading
         fp16_cfg = self.config.fp16
-        init_fn, tx = self._init_fn, self.optimizer
+        init_fn = self._init_fn
+        tx = None if self.offloading else self.optimizer
 
         def init(rng, batch):
             params = unbox(init_fn(rng, batch))
-            if use_master:
+            if cast_at_init:
                 params = _cast_params(params, compute_dtype)
-            opt_state = tx.init(params)
+            opt_state = tx.init(params) if tx is not None else ()
             return TrainState(
                 step=jnp.int32(0),
                 params=params,
@@ -338,9 +382,23 @@ class DeepSpeedTPUEngine:
         )
         return new_state, metrics
 
-    def _make_train_batch(self):
-        gas = self.gas
+    def _accumulate_grads(self, state: TrainState, batch):
+        """Scan over gas microbatches accumulating fp32 grads — the ONE
+        accumulation loop, shared by the fused train step and the offload
+        grads program.  Returns (acc_grads, per-micro losses)."""
+        def micro(carry, xs):
+            idx, mb = xs
+            grads, loss = self._grads_one_micro(state, mb, idx)
+            acc = jax.tree_util.tree_map(jnp.add, carry, grads)
+            acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
+            return acc, loss
 
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        zeros = jax.lax.with_sharding_constraint(zeros, self.grad_shardings)
+        return jax.lax.scan(micro, zeros, (jnp.arange(self.gas), batch))
+
+    def _make_train_batch(self):
         if self.gas_in_model:
             # pipeline path: the model's pipelined scan IS the microbatch loop;
             # one grad computation over the whole [gas, micro, ...] batch
@@ -354,23 +412,76 @@ class DeepSpeedTPUEngine:
 
         def train_batch(state: TrainState, batch):
             # batch leaves: [gas, micro_global, ...]
-            def micro(carry, xs):
-                idx, mb = xs
-                grads, loss = self._grads_one_micro(state, mb, idx)
-                acc = jax.tree_util.tree_map(jnp.add, carry, grads)
-                acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
-                return acc, loss
-
-            zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            zeros = jax.lax.with_sharding_constraint(zeros, self.grad_shardings)
-            idxs = jnp.arange(gas)
-            acc, losses = jax.lax.scan(micro, zeros, (idxs, batch))
-            grads = self._unscale(acc, state.loss_scale.scale, gas)
+            acc, losses = self._accumulate_grads(state, batch)
+            grads = self._unscale(acc, state.loss_scale.scale, self.gas)
             new_state, metrics = self._apply_update(state, grads)
             metrics = metrics._replace(loss=jnp.mean(losses).astype(jnp.float32))
             return new_state, metrics
         return train_batch
+
+    def _make_grads_batch(self):
+        """Offload-mode device program: accumulated scaled fp32 grads + mean
+        loss + grad norm (of the scaled sum).  No optimizer state touched —
+        that's the host's job (runtime/offload.py)."""
+        if self.gas_in_model:
+            def grads_pipe(state: TrainState, batch):
+                grads, loss = self._grads_one_micro(state, batch, 0)
+                return grads, loss.astype(jnp.float32), optax.global_norm(grads)
+            return grads_pipe
+
+        def grads_batch(state: TrainState, batch):
+            acc, losses = self._accumulate_grads(state, batch)
+            return (acc, jnp.mean(losses).astype(jnp.float32),
+                    optax.global_norm(acc))
+        return grads_batch
+
+    def _train_batch_offload(self, batch):
+        grads, loss, gnorm = self._jit_grads_batch(self.state, batch)
+        n_micro = 1 if self.gas_in_model else self.gas
+        return self._host_step(grads, loss, gnorm, n_micro)
+
+    def _host_step(self, grads_dev, loss_dev, gnorm_dev, n_micro
+                   ) -> StepMetrics:
+        """The offloaded optimizer step: fetch grads, host Adam on the fp32
+        masters (cpu/nvme tier), stream compute-dtype params back.  Loss-scale
+        bookkeeping runs in plain Python (reference: _take_model_step +
+        DeepSpeedCPUAdam.step on the offload path)."""
+        from deepspeed_tpu.runtime.precision import update_loss_scale_host
+        gnorm_scaled = float(jax.device_get(gnorm_dev))
+        state = self.state
+        scale = float(state.loss_scale.scale)
+        denom = scale * n_micro
+        finite = bool(np.isfinite(gnorm_scaled))
+        raw_norm = gnorm_scaled / denom
+        if finite:
+            grads_np = jax.device_get(grads_dev)
+            clip = float(self.config.gradient_clipping or 0.0)
+            coef = 1.0
+            if clip > 0.0 and raw_norm > clip:
+                coef = clip / (raw_norm + 1e-6)
+            # optax schedules see the update count (0-based), matching the
+            # device path's optax scheduling
+            lr = (float(self.lr_schedule(self.offload_opt.step_count))
+                  if self.lr_schedule is not None
+                  else float(self._opt_params.get("lr", 1e-3)))
+            new_params_np = self.offload_opt.update(
+                grads_np, lr=lr, grad_scale=coef / denom)
+            with self.mesh:
+                new_params = jax.device_put(new_params_np,
+                                            self.param_shardings)
+            new_step = jnp.int32(int(state.step) + 1)
+        else:
+            new_params, new_step = state.params, state.step
+        new_ls = update_loss_scale_host(state.loss_scale, finite,
+                                        self.config.fp16)
+        self.state = TrainState(step=new_step, params=new_params,
+                                opt_state=(), loss_scale=new_ls,
+                                rng=state.rng)
+        return StepMetrics(
+            loss=jnp.float32(float(jax.device_get(loss_dev))),
+            grad_norm=jnp.float32(raw_norm),
+            loss_scale=new_ls.scale,
+            skipped_steps=new_ls.skipped)
 
     def _make_grad_fn(self):
         def grad_fn(state: TrainState, batch, idx):
@@ -444,7 +555,10 @@ class DeepSpeedTPUEngine:
             self._last_batch = batch  # traced by the flops profiler, then freed
         self.timers(TRAIN_BATCH_TIMER).start()
         with self.mesh:
-            self.state, metrics = self._jit_train_batch(self.state, batch)
+            if self.offloading:
+                metrics = self._train_batch_offload(batch)
+            else:
+                self.state, metrics = self._jit_train_batch(self.state, batch)
         if self.wall_clock_breakdown or profile_pending:
             # synchronize so the timer covers device execution, not just
             # dispatch (axon: fetching a value is the only reliable sync)
@@ -493,11 +607,18 @@ class DeepSpeedTPUEngine:
         if not self.is_gradient_accumulation_boundary():
             return None
         assert self._accum_grads is not None, "call forward() before step()"
-        with self.mesh:
-            self.state, metrics = self._jit_apply(
-                self.state, self._accum_grads, jnp.float32(self.gas))
-        metrics = metrics._replace(
-            loss=jnp.float32(np.mean([float(l) for l in self._micro_losses])))
+        mean_loss = jnp.float32(np.mean([float(l)
+                                         for l in self._micro_losses]))
+        if self.offloading:
+            with self.mesh:
+                gnorm = self._jit_gnorm(self._accum_grads)
+            metrics = self._host_step(self._accum_grads, mean_loss, gnorm,
+                                      self.gas)
+        else:
+            with self.mesh:
+                self.state, metrics = self._jit_apply(
+                    self.state, self._accum_grads, jnp.float32(self.gas))
+            metrics = metrics._replace(loss=mean_loss)
         self._accum_grads = None
         self._micro_losses = []
         self._micro_steps = 0
@@ -632,6 +753,12 @@ class DeepSpeedTPUEngine:
         save_train_state(save_dir, tag, self.state,
                          client_state=dict(client_state or {},
                                            global_steps=self.global_steps))
+        if self.offloading and jax.process_index() == 0:
+            # host-resident masters/moments ride alongside the orbax tree
+            # (reference: _save_zero_checkpoint per-rank optimizer shards)
+            import os
+            np.savez(os.path.join(save_dir, tag, "offload_state.npz"),
+                     **self.offload_opt.state_dict())
         return tag
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
@@ -645,4 +772,13 @@ class DeepSpeedTPUEngine:
         self.state, client_state = restore_train_state(
             load_dir, tag, self.state_shardings, self.state)
         self.global_steps = int(client_state.get("global_steps", 0))
+        if self.offloading:
+            import os
+            p = os.path.join(load_dir, tag, "offload_state.npz")
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"offload checkpoint missing {p}; this checkpoint was "
+                    f"saved without offload_optimizer")
+            with np.load(p) as sd:
+                self.offload_opt.load_state_dict(dict(sd))
         return tag, client_state

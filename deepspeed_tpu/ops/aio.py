@@ -1,0 +1,83 @@
+"""ctypes binding for the threaded NVMe I/O op (csrc/aio.cpp).
+
+Reference: deepspeed/ops/aio (AsyncIOBuilder) wrapping
+csrc/aio/py_lib/deepspeed_py_aio_handle.cpp.  ``AIOFile`` is the handle;
+reads/writes release the GIL inside the C call, so wrapping them in a
+ThreadPoolExecutor future gives the reference's async swap semantics
+(async_swapper.py AsyncTensorSwapper) with plain Python plumbing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        from deepspeed_tpu.ops.builder import load_op
+        lib = load_op("aio")
+        lib.ds_aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int]
+        lib.ds_aio_open.restype = ctypes.c_int
+        lib.ds_aio_close.argtypes = [ctypes.c_int]
+        for f in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+            f.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_int64,
+                          ctypes.c_int64, ctypes.c_int]
+            f.restype = ctypes.c_int64
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class AIOFile:
+    """One file-backed tensor store (reference: swap file per tensor group,
+    partitioned_param_swapper.py)."""
+
+    def __init__(self, path: str, size_bytes: int, threads: int = 4,
+                 o_direct: bool = False):
+        self.path = path
+        self.threads = threads
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        fd = _load().ds_aio_open(path.encode(), size_bytes, int(o_direct))
+        if fd < 0:
+            raise OSError(-fd, f"ds_aio_open({path}) failed")
+        self.fd = fd
+
+    def pread(self, buf: np.ndarray, offset: int = 0) -> None:
+        n = buf.nbytes
+        got = _load().ds_aio_pread(self.fd, buf.ctypes.data_as(ctypes.c_void_p),
+                                   n, offset, self.threads)
+        if got != n:
+            raise OSError(f"short read {got}/{n} from {self.path}")
+
+    def pwrite(self, buf: np.ndarray, offset: int = 0) -> None:
+        n = buf.nbytes
+        put = _load().ds_aio_pwrite(self.fd,
+                                    buf.ctypes.data_as(ctypes.c_void_p),
+                                    n, offset, self.threads)
+        if put != n:
+            raise OSError(f"short write {put}/{n} to {self.path}")
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            _load().ds_aio_close(self.fd)
+            self.fd = -1
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,115 @@
+"""DeepSpeedCPUAdam analog — ctypes binding over the native host Adam kernel.
+
+Reference: deepspeed/ops/adam/cpu_adam.py (DeepSpeedCPUAdam) wrapping
+csrc/adam/cpu_adam.cpp.  The binding operates on flat fp32 numpy buffers
+in place and can emit bf16 weights in the same pass (the stream-back copy for
+the device).  Falls back to a numpy implementation with identical op order if
+the native build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_lib = None
+_native_failed = False
+
+
+def _load():
+    global _lib, _native_failed
+    if _lib is not None or _native_failed:
+        return _lib
+    try:
+        from deepspeed_tpu.ops.builder import load_op
+        lib = load_op("cpu_adam")
+        lib.ds_adam_update.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_void_p, ctypes.c_int]
+        lib.ds_adam_update.restype = None
+        lib.ds_sumsq.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                 ctypes.c_int64]
+        lib.ds_sumsq.restype = ctypes.c_double
+        _lib = lib
+    except Exception as e:  # noqa: BLE001
+        logger.warning(f"native cpu_adam unavailable ({e}); "
+                       "using the numpy fallback")
+        _native_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def adam_update(w: np.ndarray, g: np.ndarray, m: np.ndarray, v: np.ndarray, *,
+                lr: float, beta1: float = 0.9, beta2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                adamw_mode: bool = True, step: int = 1,
+                grad_scale: float = 1.0,
+                w_bf16: Optional[np.ndarray] = None,
+                threads: Optional[int] = None) -> None:
+    """In-place fused Adam(W) on flat fp32 buffers; optionally emits bf16
+    weights into ``w_bf16`` (a uint16 view array of the same length)."""
+    assert w.dtype == np.float32 and g.dtype == np.float32
+    assert m.dtype == np.float32 and v.dtype == np.float32
+    n = w.size
+    bias_c1 = 1.0 - beta1 ** step
+    bias_c2 = 1.0 - beta2 ** step
+    lib = _load()
+    if threads is None:
+        threads = min(8, os.cpu_count() or 1)
+    if lib is not None and all(a.flags["C_CONTIGUOUS"] for a in (w, g, m, v)):
+        out_ptr = (w_bf16.ctypes.data_as(ctypes.c_void_p)
+                   if w_bf16 is not None else None)
+        lib.ds_adam_update(_f32p(w), _f32p(g), _f32p(m), _f32p(v),
+                           n, lr, beta1, beta2, eps, weight_decay,
+                           int(adamw_mode), bias_c1, bias_c2, grad_scale,
+                           out_ptr, threads)
+        return
+    # ---- numpy fallback: identical op order ----
+    grad = g * np.float32(grad_scale)
+    if not adamw_mode and weight_decay:
+        grad = grad + np.float32(weight_decay) * w
+    m *= np.float32(beta1)
+    m += np.float32(1 - beta1) * grad
+    v *= np.float32(beta2)
+    v += np.float32(1 - beta2) * grad * grad
+    mhat = m / np.float32(bias_c1)
+    vhat = v / np.float32(bias_c2)
+    update = mhat / (np.sqrt(vhat) + np.float32(eps))
+    if adamw_mode and weight_decay:
+        update = update + np.float32(weight_decay) * w
+    w -= np.float32(lr) * update
+    if w_bf16 is not None:
+        _f32_to_bf16_np(w, w_bf16)
+
+
+def _f32_to_bf16_np(src: np.ndarray, dst_u16: np.ndarray) -> None:
+    """Round-to-nearest-even fp32 -> bf16 bit pattern (numpy fallback)."""
+    bits = src.view(np.uint32)
+    lsb = (bits >> 16) & 1
+    rounded = bits + np.uint32(0x7FFF) + lsb
+    out = (rounded >> 16).astype(np.uint16)
+    nan = (bits & 0x7FFFFFFF) > 0x7F800000
+    out[nan] = ((bits[nan] >> 16) | 0x0040).astype(np.uint16)
+    dst_u16[...] = out
+
+
+def sumsq(x: np.ndarray) -> float:
+    lib = _load()
+    if lib is not None and x.dtype == np.float32 and x.flags["C_CONTIGUOUS"]:
+        return float(lib.ds_sumsq(_f32p(x), x.size))
+    return float(np.sum(np.square(x.astype(np.float64))))

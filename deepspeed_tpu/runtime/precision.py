@@ -96,6 +96,35 @@ def update_loss_scale(state: LossScaleState, finite: jnp.ndarray,
     return jax.lax.cond(finite, on_clean, on_overflow, state)
 
 
+def update_loss_scale_host(state: LossScaleState, finite: bool,
+                           cfg: FP16Config) -> LossScaleState:
+    """Pure-host mirror of ``update_loss_scale`` for the ZeRO-Offload path,
+    where the optimizer step happens outside jit and dispatching the tiny
+    state machine to the device would cost a round trip per step."""
+    scale = float(state.scale)
+    counter = int(state.growth_counter)
+    hyst = int(state.hysteresis)
+    skipped = int(state.skipped)
+    if not cfg.enabled or cfg.loss_scale > 0:
+        return LossScaleState(jnp.float32(scale), jnp.int32(counter),
+                              jnp.int32(hyst),
+                              jnp.int32(skipped + (0 if finite else 1)))
+    if finite:
+        counter += 1
+        if counter >= cfg.loss_scale_window:
+            scale, counter = scale * 2.0, 0
+        hyst = cfg.hysteresis
+    else:
+        hyst -= 1
+        if hyst <= 0:
+            scale = max(scale / 2.0, cfg.min_loss_scale)
+        hyst = max(hyst, 1)
+        counter = 0
+        skipped += 1
+    return LossScaleState(jnp.float32(scale), jnp.int32(counter),
+                          jnp.int32(hyst), jnp.int32(skipped))
+
+
 def cast_floating(tree, dtype):
     """Cast floating leaves of a pytree to dtype (param cast for fwd/bwd)."""
     def _cast(x):

@@ -1,0 +1,208 @@
+"""ZeRO-Offload tier tests — host Adam numerics vs the in-device optimizer,
+NVMe moment swapping, checkpoint roundtrip, and the no-device-state guarantee.
+Reference analog: tests/unit/runtime/zero/test_zero.py offload parametrization
++ tests/unit/ops/adam/test_cpu_adam.py."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, GPTConfig
+
+VOCAB, SEQ = 64, 16
+
+
+def _data(n, bs, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    return [{"input_ids": pool[rng.integers(0, 8, size=(bs,))]}
+            for _ in range(n)]
+
+
+def _build(offload_device=None, nvme_path=None, precision="bf16", gas=1,
+           mesh_kw=None, optimizer=None, clip=0.0):
+    zero = {"stage": 2}
+    if offload_device:
+        zero["offload_optimizer"] = {"device": offload_device,
+                                     **({"nvme_path": nvme_path}
+                                        if nvme_path else {})}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": optimizer or {"type": "adamw",
+                                   "params": {"lr": 1e-2,
+                                              "weight_decay": 0.01}},
+        "zero_optimization": zero,
+        "mesh": mesh_kw or {"dp": -1},
+        "steps_per_print": 0,
+    }
+    if clip:
+        cfg["gradient_clipping"] = clip
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif precision == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+    example = {"input_ids": np.zeros((1, SEQ), np.int32)}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, example_batch=example)
+    return engine
+
+
+def _run(engine, data):
+    return [float(engine.train_batch(b).loss) for b in data]
+
+
+class TestCPUAdamKernel:
+    def test_matches_optax_adamw_over_steps(self):
+        import optax
+        from deepspeed_tpu.ops import cpu_adam
+        rng = np.random.default_rng(0)
+        n = 4097
+        w0 = rng.standard_normal(n).astype(np.float32)
+        tx = optax.adamw(3e-3, weight_decay=0.01)
+        p = {"w": np.asarray(w0)}
+        st = tx.init(p)
+        w = w0.copy()
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        for step in range(1, 6):
+            g = rng.standard_normal(n).astype(np.float32)
+            up, st = tx.update({"w": g}, st, p)
+            p = optax.apply_updates(p, up)
+            cpu_adam.adam_update(w, g, m, v, lr=3e-3, weight_decay=0.01,
+                                 step=step)
+        np.testing.assert_allclose(w, np.asarray(p["w"]), atol=2e-6, rtol=1e-5)
+
+    def test_grad_scale_folded(self):
+        from deepspeed_tpu.ops import cpu_adam
+        rng = np.random.default_rng(1)
+        n = 1000
+        g = rng.standard_normal(n).astype(np.float32)
+        w1 = np.ones(n, np.float32); m1 = np.zeros(n, np.float32)
+        v1 = np.zeros(n, np.float32)
+        w2 = np.ones(n, np.float32); m2 = np.zeros(n, np.float32)
+        v2 = np.zeros(n, np.float32)
+        cpu_adam.adam_update(w1, g, m1, v1, lr=1e-3, grad_scale=0.5, step=1)
+        cpu_adam.adam_update(w2, g * 0.5, m2, v2, lr=1e-3, step=1)
+        np.testing.assert_allclose(w1, w2, atol=1e-7)
+
+
+class TestOffloadEngine:
+    def test_numerics_match_no_offload(self):
+        """cpu-offloaded training must track the on-device optimizer run."""
+        base = _build(offload_device=None)
+        off = _build(offload_device="cpu")
+        data = _data(8, base.train_batch_size)
+        l_base = _run(base, data)
+        l_off = _run(off, data)
+        np.testing.assert_allclose(l_off, l_base, rtol=2e-2, atol=2e-2)
+        # final params close (bf16 params; masters fp32 both sides)
+        pb = jax.device_get(base.state.params)
+        po = jax.device_get(off.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(pb),
+                        jax.tree_util.tree_leaves(po)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=3e-2, rtol=3e-2)
+
+    def test_fp32_offload_bitwise_master_path(self):
+        """In fp32 (no casting noise) the offloaded run must match the device
+        run to fp32 rounding, step for step."""
+        base = _build(offload_device=None, precision="fp32")
+        off = _build(offload_device="cpu", precision="fp32")
+        data = _data(6, base.train_batch_size)
+        l_base = _run(base, data)
+        l_off = _run(off, data)
+        np.testing.assert_allclose(l_off, l_base, rtol=1e-5, atol=1e-5)
+
+    def test_no_optimizer_state_on_device(self):
+        engine = _build(offload_device="cpu")
+        assert engine.state.opt_state == ()
+        sd = engine.offload_opt.state_dict()
+        masters = [k for k in sd if k.endswith("::master")]
+        assert masters, "offload state must hold fp32 masters"
+        for k in masters:
+            assert isinstance(sd[k], np.ndarray)
+            assert sd[k].dtype == np.float32
+
+    def test_gradient_accumulation(self):
+        base = _build(offload_device=None, gas=2)
+        off = _build(offload_device="cpu", gas=2)
+        data = _data(6, base.train_batch_size)
+        np.testing.assert_allclose(_run(off, data), _run(base, data),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_gradient_clipping_matches(self):
+        base = _build(offload_device=None, clip=0.1)
+        off = _build(offload_device="cpu", clip=0.1)
+        data = _data(6, base.train_batch_size)
+        np.testing.assert_allclose(_run(off, data), _run(base, data),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_offload_on_mesh(self):
+        """Offload composes with an fsdp-sharded mesh (grads gathered to host)."""
+        engine = _build(offload_device="cpu", mesh_kw={"dp": 2, "fsdp": 4})
+        losses = _run(engine, _data(4, engine.train_batch_size))
+        assert losses[-1] < losses[0]
+
+    def test_non_adam_rejected(self):
+        with pytest.raises(ValueError, match="Adam-family"):
+            _build(offload_device="cpu",
+                   optimizer={"type": "sgd", "params": {"lr": 1e-2}})
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        engine = _build(offload_device="cpu")
+        data = _data(8, engine.train_batch_size)
+        for b in data[:4]:
+            engine.train_batch(b)
+        tag = engine.save_checkpoint(str(tmp_path / "ck"))
+        cont = [float(engine.train_batch(b).loss) for b in data[4:]]
+
+        fresh = _build(offload_device="cpu")
+        fresh.load_checkpoint(str(tmp_path / "ck"), tag)
+        assert fresh.offload_opt.step_count == engine.offload_opt.step_count - 4
+        resumed = [float(fresh.train_batch(b).loss) for b in data[4:]]
+        np.testing.assert_allclose(resumed, cont, rtol=1e-4, atol=1e-4)
+
+
+class TestNVMeTier:
+    def test_nvme_matches_cpu_tier(self, tmp_path):
+        cpu_eng = _build(offload_device="cpu")
+        nvme_eng = _build(offload_device="nvme",
+                          nvme_path=str(tmp_path / "nvme"))
+        data = _data(6, cpu_eng.train_batch_size)
+        l_cpu = _run(cpu_eng, data)
+        l_nvme = _run(nvme_eng, data)
+        # identical host math; only the moment storage differs
+        np.testing.assert_allclose(l_nvme, l_cpu, rtol=1e-6, atol=1e-6)
+        files = os.listdir(tmp_path / "nvme" / "moments")
+        assert files, "nvme tier must create moment swap files"
+
+    def test_nvme_multichunk_pipeline(self, tmp_path, monkeypatch):
+        """Leaves spanning >2 chunks exercise the double-buffered prefetch
+        (read i+1 must wait for write i-1 that shares its buffer)."""
+        from deepspeed_tpu.runtime import offload as offload_mod
+        monkeypatch.setattr(offload_mod, "NVME_CHUNK_ELEMS", 64)
+        cpu_eng = _build(offload_device="cpu")
+        nvme_eng = _build(offload_device="nvme",
+                          nvme_path=str(tmp_path / "nvme"))
+        data = _data(5, cpu_eng.train_batch_size)
+        np.testing.assert_allclose(_run(nvme_eng, data), _run(cpu_eng, data),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_aio_roundtrip(self, tmp_path):
+        from deepspeed_tpu.ops import aio
+        if not aio.available():
+            pytest.skip("aio op unavailable")
+        f = aio.AIOFile(str(tmp_path / "x.bin"), 1 << 20)
+        data = np.random.default_rng(0).standard_normal(1 << 17
+                                                        ).astype(np.float32)
+        f.pwrite(data, 0)
+        out = np.empty_like(data)
+        f.pread(out, 0)
+        np.testing.assert_array_equal(out, data)
+        f.close()
