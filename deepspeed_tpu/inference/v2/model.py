@@ -28,15 +28,20 @@ from deepspeed_tpu.models.gpt import GPTConfig, rope
 
 
 class PagedKVCache(NamedTuple):
-    """Per-layer paged KV arrays: [num_blocks, block_size, n_kv_heads, head_dim]
-    stacked on a leading layer axis (reference: KVCacheManager kv_cache.py)."""
+    """Per-layer paged KV arrays: [num_blocks, n_kv_heads, block_size, head_dim]
+    stacked on a leading layer axis (reference: KVCacheManager kv_cache.py).
 
-    k: jax.Array  # [L, num_blocks, bs, nkv, hd]
+    Layout note: (kv_head, token-in-page, head_dim) trailing order makes one
+    page × one kv head a clean [block_size, head_dim] TPU tile — exactly the
+    block the Pallas paged-attention decode kernel streams (ops/
+    paged_attention.py)."""
+
+    k: jax.Array  # [L, num_blocks, nkv, bs, hd]
     v: jax.Array
 
     @classmethod
     def create(cls, cfg: GPTConfig, num_blocks: int, block_size: int, dtype):
-        shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads,
+        shape = (cfg.num_layers, num_blocks, cfg.kv_heads, block_size,
                  cfg.head_dim)
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
@@ -84,24 +89,23 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     if not cfg.use_rope:
         x = x + bb["wpe"].astype(dtype)[token_pos]
 
-    # scatter destinations in the flattened page pool; pad tokens get an
-    # out-of-range index so mode="drop" discards them (never index-clamp pads
-    # to slot 0 — duplicate scatter indices would corrupt real rows)
+    # scatter destinations in the page pool; pad tokens get an out-of-range
+    # index so mode="drop" discards them (never index-clamp pads to slot 0 —
+    # duplicate scatter indices would corrupt real rows)
     blk_idx = token_pos // block_size                        # [N]
     page = block_table[jnp.clip(token_slot, 0), blk_idx]     # [N]
-    dest = page * block_size + token_pos % block_size        # [N]
+    off = token_pos % block_size                             # [N]
     big = jnp.iinfo(jnp.int32).max
-    dest = jnp.where(valid, dest, big)
     scat_slot = jnp.where(valid, token_slot, S)              # S = out of range
     kvpos = jnp.arange(MB * block_size)[None, :]             # [1, Kmax]
 
-    # flat [L * num_blocks * bs, nkv, hd] views updated IN PLACE through the
+    # [L * num_blocks, nkv, bs, hd] views updated IN PLACE through the
     # donated cache buffer — never rebuild the whole pool (a jnp.stack of
     # per-layer copies costs a full cache rewrite per step)
     L = cfg.num_layers
-    pool = cache.k.shape[1] * cache.k.shape[2]          # num_blocks * bs
-    flat_k_all = cache.k.reshape(-1, cfg.kv_heads, cfg.head_dim)
-    flat_v_all = cache.v.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    NB = cache.k.shape[1]
+    flat_k_all = cache.k.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
+    flat_v_all = cache.v.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
 
     for li in range(cfg.num_layers):
         blk = bb[f"block_{li}"]
@@ -116,10 +120,10 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
             q, k = q[0], k[0]
 
         # ---- paged KV append (reference linear_blocked_kv_rotary) ----
-        dest_li = jnp.where(valid, li * pool + dest, big)
-        flat_k_all = flat_k_all.at[dest_li].set(
+        page_li = jnp.where(valid, li * NB + page, big)
+        flat_k_all = flat_k_all.at[page_li, :, off].set(
             k.astype(flat_k_all.dtype), mode="drop")
-        flat_v_all = flat_v_all.at[dest_li].set(
+        flat_v_all = flat_v_all.at[page_li, :, off].set(
             v.astype(flat_v_all.dtype), mode="drop")
 
         # ---- blocked attention (reference blocked_flash), dense-per-slot ----
@@ -127,14 +131,13 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
             scat_slot, dense_idx].set(q, mode="drop")
         qpos_dense = jnp.zeros((S, Q), jnp.int32).at[
             scat_slot, dense_idx].set(token_pos, mode="drop")
-        # gather this slot's pages: [S, MB, bs, nkv, hd] -> [S, Kmax, nkv, hd]
-        pages4 = flat_k_all.reshape(-1, block_size, cfg.kv_heads, cfg.head_dim)
-        k_pages = pages4[li * (pool // block_size) + block_table].reshape(
-            S, MB * block_size, cfg.kv_heads, cfg.head_dim)
-        pages4v = flat_v_all.reshape(-1, block_size, cfg.kv_heads,
-                                     cfg.head_dim)
-        v_pages = pages4v[li * (pool // block_size) + block_table].reshape(
-            S, MB * block_size, cfg.kv_heads, cfg.head_dim)
+        # gather this slot's pages: [S, MB, nkv, bs, hd] -> [S, Kmax, nkv, hd]
+        k_pages = jnp.swapaxes(flat_k_all[li * NB + block_table], 2, 3
+                               ).reshape(S, MB * block_size, cfg.kv_heads,
+                                         cfg.head_dim)
+        v_pages = jnp.swapaxes(flat_v_all[li * NB + block_table], 2, 3
+                               ).reshape(S, MB * block_size, cfg.kv_heads,
+                                         cfg.head_dim)
         # causal over logical positions + kv-length bound; gathered slot j has
         # logical position j because blocks are appended in order
         mask = (kvpos[:, None, :] <= qpos_dense[:, :, None]) & \
@@ -168,16 +171,20 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
 
 def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
-                 dest, owner_block, block_rank, cfg: GPTConfig,
-                 block_size: int):
-    """One decode micro-step over the flattened KV pool: writes each active
-    slot's kv at ``dest`` and attends over the whole pool with an ownership
-    mask.  Shared by the single-step and burst programs."""
+                 block_table, cfg: GPTConfig, block_size: int):
+    """One decode micro-step: writes each active slot's kv into its page and
+    attends over exactly that slot's pages via the paged-attention op
+    (ops/paged_attention.py — Pallas kernel on TPU, masked-gather XLA
+    fallback).  Shared by the single-step and burst programs.
+
+    flat_k_all/flat_v_all: [L*NB, nkv, bs, hd] views of the donated cache.
+    """
+    from deepspeed_tpu import ops
     bb = params["backbone"]
     dtype = cfg.dtype
     S = tokens.shape[0]
-    NB = owner_block.shape[0]
-    pool = NB * block_size
+    L = cfg.num_layers
+    NB = flat_k_all.shape[0] // L
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     g = nh // nkv
 
@@ -185,14 +192,10 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     if not cfg.use_rope:
         x = x + bb["wpe"].astype(dtype)[token_pos]
 
-    j = jnp.arange(pool)
-    owner = owner_block[j // block_size]                      # [pool]
-    kvpos = block_rank[j // block_size] * block_size + j % block_size
-    mask = (owner[None, :] == jnp.arange(S)[:, None]) & \
-           (kvpos[None, :] <= token_pos[:, None]) & active[:, None]  # [S,pool]
-
     big = jnp.iinfo(jnp.int32).max
-    dest = jnp.where(active, dest, big)
+    page = block_table[jnp.arange(S), token_pos // block_size]  # [S]
+    off = token_pos % block_size                                # [S]
+    kv_len = jnp.where(active, token_pos + 1, 0)                # [S]
 
     for li in range(cfg.num_layers):
         blk = bb[f"block_{li}"]
@@ -205,23 +208,16 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
             q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd)
             q, k = q[:, 0], k[:, 0]
 
-        dest_li = jnp.where(active, li * pool + dest, big)
-        flat_k_all = flat_k_all.at[dest_li].set(
+        page_li = jnp.where(active, li * NB + page, big)
+        flat_k_all = flat_k_all.at[page_li, :, off].set(
             k.astype(flat_k_all.dtype), mode="drop")
-        flat_v_all = flat_v_all.at[dest_li].set(
+        flat_v_all = flat_v_all.at[page_li, :, off].set(
             v.astype(flat_v_all.dtype), mode="drop")
 
-        k_pool = jax.lax.dynamic_slice_in_dim(flat_k_all, li * pool, pool)
-        v_pool = jax.lax.dynamic_slice_in_dim(flat_v_all, li * pool, pool)
+        k_pages = jax.lax.dynamic_slice_in_dim(flat_k_all, li * NB, NB)
+        v_pages = jax.lax.dynamic_slice_in_dim(flat_v_all, li * NB, NB)
         qg = q.reshape(S, nkv, g, hd)
-        s_log = jnp.einsum("sngd,pnd->sngp", qg, k_pool,
-                           preferred_element_type=jnp.float32)
-        s_log = s_log * (hd ** -0.5)
-        m = mask[:, None, None, :]
-        s_log = jnp.where(m, s_log, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(s_log, axis=-1)
-        probs = jnp.where(m.any(-1, keepdims=True), probs, 0.0)
-        o = jnp.einsum("sngp,pnd->sngd", probs.astype(dtype), v_pool)
+        o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len)
         o = o.reshape(S, nh, hd)
         x = x + jnp.einsum("skd,kdh->sh", o, ap["wo"].astype(dtype))
         x = x + _mlp(blk["MLP_0"], _norm(blk["Norm_1"], x, cfg), cfg)
@@ -245,23 +241,20 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
     the decisive win when the host↔device link has per-call latency.
 
     batch: tokens0 [S] (first-step tokens), active [S], pos0 [S],
-    block_table [S, MB], owner_block [NB], block_rank [NB] — blocks for
-    positions pos0..pos0+T-1 must be pre-allocated.
+    block_table [S, MB] — blocks for positions pos0..pos0+T-1 must be
+    pre-allocated.
     Returns (tokens [T, S], cache).
     """
-    S = batch["tokens0"].shape[0]
-    flat_k = cache.k.reshape(-1, cfg.kv_heads, cfg.head_dim)
-    flat_v = cache.v.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    bs = block_size
+    flat_k = cache.k.reshape(-1, cfg.kv_heads, bs, cfg.head_dim)
+    flat_v = cache.v.reshape(-1, cfg.kv_heads, bs, cfg.head_dim)
     bt = batch["block_table"]
     active = batch["active"]
 
     def step(carry, _):
         flat_k, flat_v, tokens, pos, rng = carry
-        dest = bt[jnp.arange(S), pos // block_size] * block_size + \
-            pos % block_size
         logits, flat_k, flat_v = _decode_core(
-            params, flat_k, flat_v, tokens, active, pos,
-            dest, batch["owner_block"], batch["block_rank"], cfg, block_size)
+            params, flat_k, flat_v, tokens, active, pos, bt, cfg, block_size)
         rng, sub = jax.random.split(rng)
         nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
         return (flat_k, flat_v, nxt, pos + 1, rng), nxt

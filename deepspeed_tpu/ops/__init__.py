@@ -81,6 +81,12 @@ def _attention_supported(q, k, v, *, causal=True, scale=None, dropout_fn=None,
 register_op("causal_attention", xla=_attention_xla, pallas=_attention_pallas,
             supported=_attention_supported)
 
+from deepspeed_tpu.ops import paged_attention as _paged  # noqa: E402
+from deepspeed_tpu.ops.paged_attention import paged_attention  # noqa: E402
+
+register_op("paged_attention", xla=_paged.xla_paged_attention,
+            pallas=_paged.pallas_paged_attention, supported=_paged.supported)
+
 
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
@@ -92,6 +98,6 @@ def causal_attention(q, k, v, *, causal: bool = True,
                     dropout_fn=dropout_fn, mask=mask, impl=impl)
 
 
-__all__ = ["causal_attention", "flash_attention", "lm_cross_entropy",
-           "masked_nll_sum", "rms_norm", "layer_norm",
+__all__ = ["causal_attention", "flash_attention", "paged_attention",
+           "lm_cross_entropy", "masked_nll_sum", "rms_norm", "layer_norm",
            "op_report", "register_op", "dispatch", "list_ops", "registry"]

@@ -1,0 +1,174 @@
+"""Paged-attention decode — Pallas TPU kernel over a block-table KV pool.
+
+TPU-native replacement for the reference's blocked flash decode kernels
+(inference/v2/kernels/ragged_ops/blocked_flash/ + atom_builder): each serving
+slot owns a list of fixed-size KV pages; decode attends one query token per
+slot over exactly that slot's pages.
+
+Kernel design (vs the XLA fallback, which masks over gathered pages):
+- grid = (slots, kv_heads, max_blocks); the innermost block axis runs an
+  online-softmax accumulation (m/l/acc scratch), like flash attention.
+- the block table rides scalar prefetch (PrefetchScalarGridSpec), so the
+  K/V BlockSpec index maps can look up each slot's b-th physical page.
+- past a slot's last used page the index map CLAMPS to the last used page:
+  Pallas skips the DMA when consecutive grid steps map the same block, so a
+  slot with 3 live pages moves exactly 3 pages of KV through VMEM no matter
+  how large max_blocks is — bandwidth scales with tokens actually attended,
+  the property the reference kernel gets from its atom decomposition.
+- GQA native: q arrives [S, nkv, group, hd]; one grid step attends the whole
+  group for one kv head (scores [group, bs] on the MXU).
+
+Layouts: q [S, nkv, g, hd]; k_pages/v_pages [NB, nkv, bs, hd] (bs = tokens
+per page); block_table [S, MB] int32; kv_lens [S] int32 (0 ⇒ inactive slot →
+zero output).  Output [S, nkv, g, hd].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
+                        scale: Optional[float] = None, interpret=None):
+    """Ground-truth XLA path: gather this slot's pages, masked softmax."""
+    S, nkv, g, hd = q.shape
+    NB, _, bs, _ = k_pages.shape
+    MB = block_table.shape[1]
+    if scale is None:
+        scale = hd ** -0.5
+    # [S, MB, nkv, bs, hd] -> [S, nkv, MB*bs, hd]
+    k_seq = jnp.swapaxes(k_pages[block_table], 2, 3).reshape(
+        S, MB * bs, nkv, hd)
+    v_seq = jnp.swapaxes(v_pages[block_table], 2, 3).reshape(
+        S, MB * bs, nkv, hd)
+    kvpos = jnp.arange(MB * bs)
+    mask = kvpos[None, :] < kv_lens[:, None]                  # [S, K]
+    s_log = jnp.einsum("sngd,sknd->sngk", q, k_seq,
+                       preferred_element_type=jnp.float32) * scale
+    s_log = jnp.where(mask[:, None, None, :], s_log,
+                      jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(s_log, axis=-1)
+    probs = jnp.where(mask[:, None, None, :].any(-1, keepdims=True),
+                      probs, 0.0)
+    return jnp.einsum("sngk,sknd->sngd", probs.astype(q.dtype), v_seq)
+
+
+def _kernel(bt_ref, len_ref,                       # scalar prefetch
+            q_ref, k_ref, v_ref, o_ref,            # blocks
+            m_scr, l_scr, acc_scr, *, bs, scale):
+    s, b = pl.program_id(0), pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    length = len_ref[s]
+
+    @pl.when(b * bs < length)
+    def _body():
+        q = q_ref[0, 0]                            # [g, hd]
+        k = k_ref[0, 0]                            # [bs, hd]
+        v = v_ref[0, 0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [g, bs]
+        kvpos = b * bs + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(kvpos < length, scores, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)                # [g, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)            # inactive slot -> zeros
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    S, nkv, g, hd = q.shape
+    NB, _, bs, _ = k_pages.shape
+    MB = block_table.shape[1]
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_table = block_table.astype(jnp.int32)
+    kv_lens = kv_lens.astype(jnp.int32)
+
+    def page_map(s, h, b, bt, lens):
+        # clamp past-the-end to the last used page: same index as the
+        # previous step ⇒ Pallas elides the DMA, so dead blocks cost nothing
+        used_minus1 = jnp.maximum(lens[s] + bs - 1, bs) // bs - 1
+        return (bt[s, jnp.minimum(b, used_minus1)], h, 0, 0)
+
+    grid = (S, nkv, MB)
+    kernel = functools.partial(_kernel, bs=bs, scale=float(scale))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, hd),
+                             lambda s, h, b, bt, lens: (s, h, 0, 0)),
+                pl.BlockSpec((1, 1, bs, hd), page_map),
+                pl.BlockSpec((1, 1, bs, hd), page_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, hd),
+                                   lambda s, h, b, bt, lens: (s, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, nkv, g, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block_table, kv_lens, q, k_pages, v_pages)
+    return out
+
+
+def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
+              interpret=None):
+    if q.ndim != 4 or k_pages.ndim != 4:
+        return False
+    S, nkv, g, hd = q.shape
+    NB, nkv2, bs, hd2 = k_pages.shape
+    return (nkv == nkv2 and hd == hd2 and hd % 8 == 0 and bs % 8 == 0
+            and block_table.ndim == 2 and block_table.shape[0] == S)
+
+
+def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
+                    scale: Optional[float] = None,
+                    impl: Optional[str] = None,
+                    interpret: Optional[bool] = None):
+    """Registry entry (ops/__init__ registers this like causal_attention)."""
+    from deepspeed_tpu.ops.registry import dispatch
+    return dispatch("paged_attention", q, k_pages, v_pages, block_table,
+                    kv_lens, scale=scale, impl=impl, interpret=interpret)
