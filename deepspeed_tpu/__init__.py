@@ -97,13 +97,19 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
     """Build an inference engine (reference deepspeed.init_inference,
     deepspeed/__init__.py:273 → inference/engine.py:39).
 
-    model: GPT-family flax module or GPTConfig; ``params`` takes trained weights
-    (e.g. ``train_engine.state.params``).  kwargs merge into the config dict for
-    the reference's ``init_inference(model, tensor_parallel=.., dtype=..)``
-    calling style.
+    model: GPT-family flax module, GPTConfig, or a path to an HF model
+    directory (safetensors — llama/mistral/qwen2/gpt2, see checkpoint/hf.py);
+    ``params`` takes trained weights (e.g. ``train_engine.state.params``).
+    kwargs merge into the config dict for the reference's
+    ``init_inference(model, tensor_parallel=.., dtype=..)`` calling style.
     """
     from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
                                          InferenceEngine)
+    from deepspeed_tpu.checkpoint.hf import is_hf_model_dir, load_hf_checkpoint
+    if is_hf_model_dir(model):
+        if params is not None:
+            raise ValueError("pass either an HF model dir or params, not both")
+        model, params = load_hf_checkpoint(model)
     if kwargs:
         if config is None:
             cfg_dict = {}

@@ -1,0 +1,246 @@
+"""HF checkpoint engine — stream safetensors checkpoints into the flax tree.
+
+TPU-native analog of the reference's checkpoint engines + injection-policy
+model zoo: ``HuggingFaceCheckpointEngine`` (inference/v2/checkpoint/
+huggingface_engine.py:124) iterates safetensors shards and yields tensors;
+``replace_module`` (module_inject/replace_module.py:183) + the per-arch
+containers (module_inject/containers/) map them onto fused modules.  Here the
+zoo is a NAME MAP per architecture onto the one GPT-family flax tree
+(models/gpt.py) — llama/mistral/qwen2/gpt2 are all config points of the same
+module, so "injection" is a dict of weight transposes, not graph surgery.
+
+Entry points:
+- ``config_from_hf(path)``   → GPTConfig from an HF ``config.json``
+- ``load_hf_checkpoint(path)`` → (GPTConfig, params tree) streaming shards
+- ``deepspeed_tpu.init_inference("path/to/hf")`` and the v2 engine accept an
+  HF model directory directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# architectures served by the GPT-family tree (reference zoo:
+# inference/v2/model_implementations/{llama_v2,mistral,qwen_v2,...},
+# module_inject/containers/gpt2.py)
+_LLAMA_LIKE = {"LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM"}
+_GPT2_LIKE = {"GPT2LMHeadModel"}
+SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE)
+
+
+def _read_json(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
+                   dtype=None):
+    """Build a GPTConfig from ``<model_path>/config.json``.
+
+    max_seq_len caps the (often huge) HF ``max_position_embeddings`` — it only
+    sizes KV caches here, rope needs no table.
+    """
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    hf = _read_json(os.path.join(model_path, "config.json"))
+    archs = hf.get("architectures") or []
+    arch = archs[0] if archs else hf.get("model_type", "?")
+
+    if arch in _LLAMA_LIKE:
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        head_dim = hf.get("head_dim") or hidden // heads
+        msl = hf.get("max_position_embeddings", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=head_dim,
+            hidden_size=hidden,
+            mlp_dim_override=hf["intermediate_size"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=True, gated_mlp=True,
+            num_kv_heads=hf.get("num_key_value_heads", heads),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+            qkv_bias=(arch == "Qwen2ForCausalLM"),
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _GPT2_LIKE:
+        hidden = hf["n_embd"]
+        n_inner = hf.get("n_inner") or 4 * hidden
+        msl = hf.get("n_positions", 1024)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            head_dim=hidden // hf["n_head"],
+            hidden_size=hidden,
+            mlp_dim_override=n_inner,
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=False, use_rmsnorm=False, gated_mlp=False,
+            tie_embeddings=True,
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    raise ValueError(
+        f"unsupported HF architecture {arch!r}; supported: "
+        f"{SUPPORTED_ARCHITECTURES} (reference zoo: module_inject/"
+        f"replace_module.py replace_policies)")
+
+
+class _ShardReader:
+    """Iterate tensors across safetensors shards without loading a shard twice
+    (reference huggingface_engine.py:124 parameters() generator)."""
+
+    def __init__(self, model_path: str):
+        self.path = model_path
+        index = os.path.join(model_path, "model.safetensors.index.json")
+        single = os.path.join(model_path, "model.safetensors")
+        if os.path.exists(index):
+            weight_map = _read_json(index)["weight_map"]
+            self.name_to_file = {k: os.path.join(model_path, v)
+                                 for k, v in weight_map.items()}
+        elif os.path.exists(single):
+            from safetensors import safe_open
+            with safe_open(single, framework="flax") as f:
+                names = list(f.keys())
+            self.name_to_file = {k: single for k in names}
+        else:
+            raise FileNotFoundError(
+                f"no model.safetensors[.index.json] under {model_path} "
+                f"(torch .bin checkpoints are not supported — convert with "
+                f"save_pretrained(safe_serialization=True))")
+        self._open: Dict[str, Any] = {}
+
+    def names(self):
+        return self.name_to_file.keys()
+
+    def get(self, name: str) -> np.ndarray:
+        from safetensors import safe_open
+        file = self.name_to_file[name]
+        if file not in self._open:
+            self._open[file] = safe_open(file, framework="flax")
+        return self._open[file].get_tensor(name)
+
+    def has(self, name: str) -> bool:
+        return name in self.name_to_file
+
+
+def _llama_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+
+    def lin(name, out_first=True):
+        w = r.get(name)          # torch Linear: [out, in]
+        return w.T               # → [in, out]
+
+    bb: Dict[str, Any] = {"wte": r.get("model.embed_tokens.weight"),
+                          "final_norm": {"scale": r.get("model.norm.weight")}}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        att = {
+            "wq": lin(p + "self_attn.q_proj.weight").reshape(H, nh, hd),
+            "wk": lin(p + "self_attn.k_proj.weight").reshape(H, nkv, hd),
+            "wv": lin(p + "self_attn.v_proj.weight").reshape(H, nkv, hd),
+            "wo": lin(p + "self_attn.o_proj.weight").reshape(nh, hd, H),
+        }
+        if cfg.qkv_bias:
+            att["bq"] = r.get(p + "self_attn.q_proj.bias").reshape(nh, hd)
+            att["bk"] = r.get(p + "self_attn.k_proj.bias").reshape(nkv, hd)
+            att["bv"] = r.get(p + "self_attn.v_proj.bias").reshape(nkv, hd)
+        bb[f"block_{i}"] = {
+            "Attention_0": att,
+            "Norm_0": {"scale": r.get(p + "input_layernorm.weight")},
+            "Norm_1": {"scale": r.get(p + "post_attention_layernorm.weight")},
+            "MLP_0": {
+                "wi": lin(p + "mlp.up_proj.weight"),
+                "wg": lin(p + "mlp.gate_proj.weight"),
+                "wo": lin(p + "mlp.down_proj.weight"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        if r.has("lm_head.weight"):
+            tree["lm_head"] = r.get("lm_head.weight").T      # [H, V]
+        else:   # tie flag missing but head absent → tied in practice
+            tree["lm_head"] = bb["wte"].T
+    return tree
+
+
+def _gpt2_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def g(name):
+        # checkpoints saved from GPT2LMHeadModel prefix with "transformer."
+        return r.get(name if r.has(name) else "transformer." + name)
+
+    bb: Dict[str, Any] = {
+        "wte": g("wte.weight"),
+        "wpe": g("wpe.weight")[:cfg.max_seq_len],
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        # Conv1D stores [in, out] — no transpose (module_inject/containers/
+        # gpt2.py marks these via HFGPT2LayerPolicy)
+        ca = g(p + "attn.c_attn.weight")                     # [H, 3H]
+        cb = g(p + "attn.c_attn.bias")                       # [3H]
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": ca[:, :H].reshape(H, nh, hd),
+                "wk": ca[:, H:2 * H].reshape(H, nh, hd),
+                "wv": ca[:, 2 * H:].reshape(H, nh, hd),
+                "bq": cb[:H].reshape(nh, hd),
+                "bk": cb[H:2 * H].reshape(nh, hd),
+                "bv": cb[2 * H:].reshape(nh, hd),
+                "wo": g(p + "attn.c_proj.weight").reshape(nh, hd, H),
+                "bo": g(p + "attn.c_proj.bias"),
+            },
+            "Norm_0": {"scale": g(p + "ln_1.weight"),
+                       "bias": g(p + "ln_1.bias")},
+            "Norm_1": {"scale": g(p + "ln_2.weight"),
+                       "bias": g(p + "ln_2.bias")},
+            "MLP_0": {
+                "wi": g(p + "mlp.c_fc.weight"),
+                "bi": g(p + "mlp.c_fc.bias"),
+                "wo": g(p + "mlp.c_proj.weight"),
+                "bo": g(p + "mlp.c_proj.bias"),
+            },
+        }
+    return {"backbone": bb}
+
+
+def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
+                       dtype=None) -> Tuple[Any, Dict[str, Any]]:
+    """Load an HF model directory → (GPTConfig, flax params tree).
+
+    Weights keep their checkpoint dtype (engines cast to their serving dtype);
+    ``dtype`` sets the config's COMPUTE dtype only.
+    """
+    cfg = config_from_hf(model_path, max_seq_len=max_seq_len, dtype=dtype)
+    r = _ShardReader(model_path)
+    hf = _read_json(os.path.join(model_path, "config.json"))
+    arch = (hf.get("architectures") or ["?"])[0]
+    tree = (_gpt2_tree if arch in _GPT2_LIKE else _llama_tree)(r, cfg)
+    n = sum(int(np.prod(l.shape))
+            for l in __import__("jax").tree_util.tree_leaves(tree))
+    log_dist(f"loaded HF checkpoint {model_path} ({arch}): {n/1e6:.1f}M params",
+             ranks=[0])
+    return cfg, tree
+
+
+def is_hf_model_dir(path: Any) -> bool:
+    return (isinstance(path, (str, os.PathLike))
+            and os.path.isdir(path)
+            and os.path.exists(os.path.join(path, "config.json")))

@@ -46,10 +46,17 @@ class DSStateManagerConfig(DeepSpeedConfigModel):
     max_q_per_seq: int = 128                # prompt-chunk cap (SplitFuse)
 
 
+class V2TPConfig(DeepSpeedConfigModel):
+    """reference: inference/v2/config_v2.py DeepSpeedTPConfig."""
+
+    tp_size: int = 1
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig."""
 
     dtype: str = "bfloat16"
+    tensor_parallel: V2TPConfig = Field(default_factory=V2TPConfig)
     state_manager: DSStateManagerConfig = Field(
         default_factory=DSStateManagerConfig)
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
@@ -93,11 +100,31 @@ class InferenceEngineV2:
     """model: GPT-family module or GPTConfig; params: trained tree (optional —
     fresh init for testing).  See reference engine_v2.py:30."""
 
-    def __init__(self, model, config=None, params=None, seed: int = 0):
+    def __init__(self, model, config=None, params=None, seed: int = 0,
+                 mesh=None):
         from deepspeed_tpu.models.gpt import GPTConfig, GPTLogits
         from deepspeed_tpu.parallel.metadata import unbox
+        from deepspeed_tpu.checkpoint.hf import (is_hf_model_dir,
+                                                 load_hf_checkpoint)
 
+        if is_hf_model_dir(model):
+            if params is not None:
+                raise ValueError(
+                    "pass either an HF model dir or params, not both")
+            model, params = load_hf_checkpoint(model)
         self.config = RaggedInferenceEngineConfig.parse(config)
+        tp_size = self.config.tensor_parallel.tp_size
+        if mesh is None and tp_size > 1:
+            from deepspeed_tpu.parallel import mesh as mesh_lib
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(
+                tp=tp_size, dp=1, fsdp=1))
+        if tp_size > 1 and mesh.shape.get("tp", 1) != tp_size:
+            raise ValueError(
+                f"tensor_parallel.tp_size={tp_size} but the provided mesh has "
+                f"tp={mesh.shape.get('tp', 1)}; pass a mesh with a matching "
+                f"tp axis or omit the mesh")
+        self.mesh = mesh if (mesh is not None
+                             and mesh.shape.get("tp", 1) > 1) else None
         sm = self.config.state_manager
         model_cfg = model if isinstance(model, GPTConfig) else model.cfg
         model_cfg = dataclasses.replace(model_cfg, dtype=self.config.jnp_dtype,
@@ -122,6 +149,26 @@ class InferenceEngineV2:
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
             else jnp.asarray(p), params)
 
+        if self.mesh is not None:
+            # TP: same logical-axis rules as the v1 engine (AutoTP analog) —
+            # params shard over the tp axis, attention stays per-kv-head local
+            # (reference inference/v2/model_implementations/sharding/qkv.py)
+            from deepspeed_tpu.parallel import partition
+            from deepspeed_tpu.parallel.metadata import annotate_abstract
+            tp = self.mesh.shape["tp"]
+            if model_cfg.kv_heads % tp:
+                raise ValueError(
+                    f"kv_heads={model_cfg.kv_heads} not divisible by tp={tp}; "
+                    f"the paged KV pool shards over kv heads")
+            lm = GPTLogits(model_cfg)
+            boxed = jax.eval_shape(
+                lambda r: lm.init(r, jnp.zeros((1, 8), jnp.int32)),
+                jax.random.PRNGKey(0))
+            annotated = annotate_abstract(boxed["params"])
+            shardings = partition.param_shardings(annotated, self.mesh,
+                                                  zero_stage=0)
+            self.params = jax.device_put(self.params, shardings)
+
         blocks_per_seq = -(-model_cfg.max_seq_len // sm.kv_block_size)
         num_blocks = (sm.num_kv_blocks if sm.num_kv_blocks
                       else sm.max_tracked_sequences * blocks_per_seq)
@@ -131,6 +178,11 @@ class InferenceEngineV2:
             max_seq_len=model_cfg.max_seq_len)
         self.cache = PagedKVCache.create(model_cfg, num_blocks,
                                          sm.kv_block_size, dt)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            kv_sh = NamedSharding(self.mesh, P(None, None, "tp", None, None))
+            self.cache = PagedKVCache(k=jax.device_put(self.cache.k, kv_sh),
+                                      v=jax.device_put(self.cache.v, kv_sh))
         # jitted step per (Qmax, KVblocks) bucket: a decode-only step runs a
         # Q=1 program and short sequences gather few KV blocks — the static-
         # shape analog of the reference's atom decomposition (atom_builder);
@@ -225,7 +277,8 @@ class InferenceEngineV2:
             self._steps[key] = jax.jit(
                 functools.partial(ragged_forward, cfg=self.model_config,
                                   block_size=self._block_size,
-                                  max_q_per_seq=sm.max_q_per_seq),
+                                  max_q_per_seq=sm.max_q_per_seq,
+                                  mesh=self.mesh),
                 donate_argnums=(1,))
         batch = {"tokens": rb.tokens, "token_slot": rb.token_slot,
                  "token_pos": rb.token_pos,
@@ -237,36 +290,25 @@ class InferenceEngineV2:
 
     def _run_decode(self, rb: RaggedBatch) -> "jax.Array":
         S = self.state.max_tracked_sequences
-        NB = self.state.allocator.num_blocks
-        bs = self._block_size
         tokens = np.zeros(S, np.int32)
         active = np.zeros(S, bool)
         token_pos = np.zeros(S, np.int32)
-        dest = np.zeros(S, np.int32)
-        owner_block = np.full(NB, -1, np.int32)
-        block_rank = np.zeros(NB, np.int32)
-        for seq in self.state.tracked.values():
-            bl = np.asarray(seq.blocks, np.int32)
-            owner_block[bl] = seq.slot
-            block_rank[bl] = np.arange(len(bl))
         for i in range(rb.total_tokens):
             sl = rb.token_slot[i]
             tokens[sl] = rb.tokens[i]
             active[sl] = True
-            pos = rb.token_pos[i]
-            token_pos[sl] = pos
-            dest[sl] = rb.block_table[sl, pos // bs] * bs + pos % bs
+            token_pos[sl] = rb.token_pos[i]
         key = "decode"
         if key not in self._steps:
             self._steps[key] = jax.jit(
                 functools.partial(ragged_decode_forward,
                                   cfg=self.model_config,
-                                  block_size=self._block_size),
+                                  block_size=self._block_size,
+                                  mesh=self.mesh),
                 donate_argnums=(1,))
         batch = jax.tree_util.tree_map(jnp.asarray, {
             "tokens": tokens, "active": active, "token_pos": token_pos,
-            "dest": dest, "owner_block": owner_block,
-            "block_rank": block_rank})
+            "block_table": rb.block_table})
         logits, self.cache = self._steps[key](self.params, self.cache, batch)
         return logits
 
@@ -275,13 +317,10 @@ class InferenceEngineV2:
         ``steps`` tokens per sequence (see model.ragged_decode_burst).  Blocks
         for all T positions are pre-allocated; returns tokens [T, S]."""
         S = self.state.max_tracked_sequences
-        NB = self.state.allocator.num_blocks
         tokens0 = np.zeros(S, np.int32)
         active = np.zeros(S, bool)
         pos0 = np.zeros(S, np.int32)
         block_table = np.zeros((S, self.state.max_blocks_per_seq), np.int32)
-        owner_block = np.full(NB, -1, np.int32)
-        block_rank = np.zeros(NB, np.int32)
         for r in reqs:
             seq = self.state.get(r.uid)
             self.state.ensure_blocks(seq, steps)
@@ -291,8 +330,6 @@ class InferenceEngineV2:
             pos0[sl] = seq.seen_tokens
             bl = np.asarray(seq.blocks, np.int32)
             block_table[sl, :len(bl)] = bl
-            owner_block[bl] = sl
-            block_rank[bl] = np.arange(len(bl))
         key = ("burst", steps, gen.do_sample, gen.top_k)
         if key not in self._steps:
             from deepspeed_tpu.inference.engine import _sample_token
@@ -301,12 +338,11 @@ class InferenceEngineV2:
             self._steps[key] = jax.jit(
                 functools.partial(ragged_decode_burst, cfg=self.model_config,
                                   block_size=self._block_size, steps=steps,
-                                  sample_fn=sample_fn),
+                                  sample_fn=sample_fn, mesh=self.mesh),
                 donate_argnums=(1,))
         batch = jax.tree_util.tree_map(jnp.asarray, {
             "tokens0": tokens0, "active": active, "pos0": pos0,
-            "block_table": block_table, "owner_block": owner_block,
-            "block_rank": block_rank})
+            "block_table": block_table})
         toks, self.cache = self._steps[key](
             self.params, self.cache, batch, rng,
             jnp.float32(gen.temperature), jnp.float32(gen.top_p))

@@ -48,22 +48,48 @@ class PagedKVCache(NamedTuple):
 
 def _norm(p, x, cfg):
     from deepspeed_tpu.ops import layer_norm, rms_norm
+    from deepspeed_tpu.ops.norms import LN_EPS, RMS_EPS
     if cfg.use_rmsnorm:
-        return rms_norm(x, p["scale"])
-    return layer_norm(x, p["scale"], p["bias"])
+        return rms_norm(x, p["scale"], eps=cfg.norm_eps or RMS_EPS)
+    return layer_norm(x, p["scale"], p["bias"], eps=cfg.norm_eps or LN_EPS)
 
 
 def _mlp(p, x, cfg):
     h = x @ p["wi"].astype(x.dtype)
+    if cfg.mlp_bias:
+        h = h + p["bi"].astype(x.dtype)
     if cfg.gated_mlp:
         h = jax.nn.silu(x @ p["wg"].astype(x.dtype)) * h
     else:
         h = jax.nn.gelu(h)
-    return h @ p["wo"].astype(x.dtype)
+    y = h @ p["wo"].astype(x.dtype)
+    if cfg.mlp_bias:
+        y = y + p["bo"].astype(x.dtype)
+    return y
+
+
+def _qkv(ap, h, cfg, eq):
+    """q/k/v projections with optional biases (qwen2/gpt2 checkpoints)."""
+    dtype = h.dtype
+    q = jnp.einsum(eq, h, ap["wq"].astype(dtype))
+    k = jnp.einsum(eq, h, ap["wk"].astype(dtype))
+    v = jnp.einsum(eq, h, ap["wv"].astype(dtype))
+    if cfg.qkv_bias:
+        q = q + ap["bq"].astype(dtype)
+        k = k + ap["bk"].astype(dtype)
+        v = v + ap["bv"].astype(dtype)
+    return q, k, v
+
+
+def _attn_out(ap, o, cfg, eq):
+    y = jnp.einsum(eq, o, ap["wo"].astype(o.dtype))
+    if cfg.attn_out_bias:
+        y = y + ap["bo"].astype(o.dtype)
+    return y
 
 
 def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
-                   block_size: int, max_q_per_seq: int):
+                   block_size: int, max_q_per_seq: int, mesh=None):
     """One ragged step.
 
     params: unboxed GPT param tree (the "params" subtree).
@@ -111,12 +137,11 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         blk = bb[f"block_{li}"]
         ap, np_, mp = blk["Attention_0"], blk["Norm_0"], blk["MLP_0"]
         h = _norm(np_, x, cfg)
-        q = jnp.einsum("nh,hkd->nkd", h, ap["wq"].astype(dtype))
-        k = jnp.einsum("nh,hkd->nkd", h, ap["wk"].astype(dtype))
-        v = jnp.einsum("nh,hkd->nkd", h, ap["wv"].astype(dtype))
+        q, k, v = _qkv(ap, h, cfg, "nh,hkd->nkd")
         if cfg.use_rope:
             # rope() takes [B, T, n, d] + positions [B, T]
-            q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim)
+            q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim,
+                        base=cfg.rope_theta)
             q, k = q[0], k[0]
 
         # ---- paged KV append (reference linear_blocked_kv_rotary) ----
@@ -149,7 +174,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
                                        causal=False, mask=mask)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
-        x = x + jnp.einsum("nkd,kdh->nh", o, ap["wo"].astype(dtype))
+        x = x + _attn_out(ap, o, cfg, "nkd,kdh->nh")
 
         # ---- MLP ----
         x = x + _mlp(mp, _norm(blk["Norm_1"], x, cfg), cfg)
@@ -171,7 +196,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
 
 def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
-                 block_table, cfg: GPTConfig, block_size: int):
+                 block_table, cfg: GPTConfig, block_size: int, mesh=None):
     """One decode micro-step: writes each active slot's kv into its page and
     attends over exactly that slot's pages via the paged-attention op
     (ops/paged_attention.py — Pallas kernel on TPU, masked-gather XLA
@@ -201,11 +226,10 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         blk = bb[f"block_{li}"]
         ap = blk["Attention_0"]
         h = _norm(blk["Norm_0"], x, cfg)
-        q = jnp.einsum("sh,hkd->skd", h, ap["wq"].astype(dtype))
-        k = jnp.einsum("sh,hkd->skd", h, ap["wk"].astype(dtype))
-        v = jnp.einsum("sh,hkd->skd", h, ap["wv"].astype(dtype))
+        q, k, v = _qkv(ap, h, cfg, "sh,hkd->skd")
         if cfg.use_rope:
-            q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd)
+            q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd,
+                        base=cfg.rope_theta)
             q, k = q[:, 0], k[:, 0]
 
         page_li = jnp.where(active, li * NB + page, big)
@@ -217,9 +241,10 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         k_pages = jax.lax.dynamic_slice_in_dim(flat_k_all, li * NB, NB)
         v_pages = jax.lax.dynamic_slice_in_dim(flat_v_all, li * NB, NB)
         qg = q.reshape(S, nkv, g, hd)
-        o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len)
+        o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len,
+                                mesh=mesh)
         o = o.reshape(S, nh, hd)
-        x = x + jnp.einsum("skd,kdh->sh", o, ap["wo"].astype(dtype))
+        x = x + _attn_out(ap, o, cfg, "skd,kdh->sh")
         x = x + _mlp(blk["MLP_0"], _norm(blk["Norm_1"], x, cfg), cfg)
 
     x = _norm(bb["final_norm"], x, cfg)
@@ -234,7 +259,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
 def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
                         temperature, top_p,
                         cfg: GPTConfig, *, block_size: int, steps: int,
-                        sample_fn):
+                        sample_fn, mesh=None):
     """T decode steps fused into one device program (``lax``-unrolled scan):
     each step samples on device and feeds the token to the next step, so a
     burst costs ONE dispatch instead of T× (transfer + step + sample + fetch) —
@@ -254,7 +279,8 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
     def step(carry, _):
         flat_k, flat_v, tokens, pos, rng = carry
         logits, flat_k, flat_v = _decode_core(
-            params, flat_k, flat_v, tokens, active, pos, bt, cfg, block_size)
+            params, flat_k, flat_v, tokens, active, pos, bt, cfg, block_size,
+            mesh=mesh)
         rng, sub = jax.random.split(rng)
         nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
         return (flat_k, flat_v, nxt, pos + 1, rng), nxt
@@ -266,25 +292,20 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
 
 
 def ragged_decode_forward(params, cache: PagedKVCache, batch,
-                          cfg: GPTConfig, *, block_size: int):
-    """Decode-only step: one token per active slot, attention over the WHOLE
-    contiguous KV pool with an ownership mask instead of per-slot page gathers.
-
-    Gathering [S, max_kv] pages moves the same bytes as streaming the full pool
-    when slots are near capacity, but as a scattered gather; this path reads the
-    pool once per layer at full HBM bandwidth — the XLA-fallback stand-in for
-    the reference's blocked_flash decode kernel (inference/v2/kernels/
-    ragged_ops/blocked_flash).
+                          cfg: GPTConfig, *, block_size: int, mesh=None):
+    """Decode-only step: one token per active slot, attending over exactly that
+    slot's pages via the paged-attention op (Pallas kernel on TPU; the gathered
+    masked-softmax XLA path is the fallback + ground truth) — the analog of the
+    reference's blocked_flash decode kernel (inference/v2/kernels/ragged_ops/
+    blocked_flash).
 
     batch: tokens [S], active [S] bool, token_pos [S] (position being written),
-    dest [S] flat pool write index, owner_block [NB] int32 (block -> owning
-    slot, -1 free), block_rank [NB] (block's index within its sequence).
+    block_table [S, MB] int32 (each slot's physical pages, in order).
     """
-    flat_k = cache.k.reshape(-1, cfg.kv_heads, cfg.head_dim)
-    flat_v = cache.v.reshape(-1, cfg.kv_heads, cfg.head_dim)
+    flat_k = cache.k.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
+    flat_v = cache.v.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
     logits, flat_k, flat_v = _decode_core(
         params, flat_k, flat_v, batch["tokens"], batch["active"],
-        batch["token_pos"], batch["dest"], batch["owner_block"],
-        batch["block_rank"], cfg, block_size)
+        batch["token_pos"], batch["block_table"], cfg, block_size, mesh=mesh)
     return logits, PagedKVCache(k=flat_k.reshape(cache.k.shape),
                                 v=flat_v.reshape(cache.v.shape))

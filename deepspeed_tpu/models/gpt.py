@@ -62,6 +62,16 @@ class GPTConfig:
     attn_impl: Optional[str] = None
     # chunked unembed+CE (ops/cross_entropy.py); 0 = one-shot logits
     loss_chunk: int = 0
+    # HF-architecture knobs (checkpoint/hf.py maps real configs onto these):
+    # explicit FFN width (llama intermediate_size is not a hidden multiple),
+    # rope base (llama3 5e5, qwen2 1e6), norm eps, and bias placement
+    # (qwen2: qkv only; gpt2: everywhere)
+    mlp_dim_override: Optional[int] = None
+    rope_theta: float = 10000.0
+    norm_eps: Optional[float] = None    # None = ops/norms.py defaults
+    qkv_bias: bool = False
+    attn_out_bias: bool = False
+    mlp_bias: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -69,7 +79,7 @@ class GPTConfig:
 
     @property
     def mlp_dim(self) -> int:
-        return self.hidden_size * self.mlp_ratio
+        return self.mlp_dim_override or self.hidden_size * self.mlp_ratio
 
     @classmethod
     def gpt2_small(cls, **kw):
@@ -167,14 +177,15 @@ class Norm(nn.Module):
     @nn.compact
     def __call__(self, x):
         from deepspeed_tpu.ops import layer_norm, rms_norm
+        from deepspeed_tpu.ops.norms import LN_EPS, RMS_EPS
         c = self.cfg
         scale = self.param("scale", _part(nn.initializers.ones, ("embed",)),
                            (c.hidden_size,), c.param_dtype)
         if c.use_rmsnorm:
-            return rms_norm(x, scale)
+            return rms_norm(x, scale, eps=c.norm_eps or RMS_EPS)
         bias = self.param("bias", _part(nn.initializers.zeros, ("embed",)),
                           (c.hidden_size,), c.param_dtype)
-        return layer_norm(x, scale, bias)
+        return layer_norm(x, scale, bias, eps=c.norm_eps or LN_EPS)
 
 
 def attend_with_mask(q, k, v, mask):
@@ -213,13 +224,30 @@ class Attention(nn.Module):
                         (H, nkv, hd), c.param_dtype)
         wo = self.param("wo", _part(_kernel_init(), ("heads", "kv", "embed")),
                         (nh, hd, H), c.param_dtype)
+        bo = (self.param("bo", _part(nn.initializers.zeros, ("embed",)),
+                         (H,), c.param_dtype)
+              if c.attn_out_bias else None)
+
+        def out_proj(o):
+            y = jnp.einsum("btnd,ndh->bth", o, wo.astype(x.dtype))
+            return y if bo is None else y + bo.astype(x.dtype)
 
         q = jnp.einsum("bth,hnd->btnd", x, wq.astype(x.dtype))
         k = jnp.einsum("bth,hnd->btnd", x, wk.astype(x.dtype))
         v = jnp.einsum("bth,hnd->btnd", x, wv.astype(x.dtype))
+        if c.qkv_bias:
+            q = q + self.param("bq", _part(nn.initializers.zeros,
+                                           ("heads", "kv")),
+                               (nh, hd), c.param_dtype).astype(x.dtype)
+            k = k + self.param("bk", _part(nn.initializers.zeros,
+                                           ("heads", "kv")),
+                               (nkv, hd), c.param_dtype).astype(x.dtype)
+            v = v + self.param("bv", _part(nn.initializers.zeros,
+                                           ("heads", "kv")),
+                               (nkv, hd), c.param_dtype).astype(x.dtype)
 
         if c.use_rope:
-            q, k = rope(q, k, positions, hd)
+            q, k = rope(q, k, positions, hd, base=c.rope_theta)
 
         if use_cache:
             # static KV cache in a flax "cache" collection (reference:
@@ -245,7 +273,7 @@ class Attention(nn.Module):
             if kv_mask is not None:
                 mask = mask & kv_mask[:, None, :].astype(bool)
             out = attend_with_mask(q, ck.value, cv.value, mask)
-            return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype))
+            return out_proj(out)
 
         if (c.sequence_parallel and self.mesh is not None
                 and self.mesh.shape["sp"] > 1):
@@ -267,7 +295,7 @@ class Attention(nn.Module):
                     p, deterministic=False)
             out = ops.causal_attention(q, k, v, dropout_fn=pdrop,
                                        impl=c.attn_impl)
-        return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype))
+        return out_proj(out)
 
 
 class MLP(nn.Module):
@@ -282,6 +310,9 @@ class MLP(nn.Module):
         wo = self.param("wo", _part(_kernel_init(), ("mlp", "embed")),
                         (M, H), c.param_dtype)
         h = x @ wi.astype(x.dtype)
+        if c.mlp_bias:
+            h = h + self.param("bi", _part(nn.initializers.zeros, ("mlp",)),
+                               (M,), c.param_dtype).astype(x.dtype)
         if c.gated_mlp:
             wg = self.param("wg", _part(_kernel_init(), ("embed", "mlp")),
                             (H, M), c.param_dtype)
@@ -290,7 +321,11 @@ class MLP(nn.Module):
             h = nn.gelu(h)
         if c.dropout > 0 and not deterministic:
             h = nn.Dropout(rate=c.dropout)(h, deterministic=False)
-        return h @ wo.astype(x.dtype)
+        y = h @ wo.astype(x.dtype)
+        if c.mlp_bias:
+            y = y + self.param("bo", _part(nn.initializers.zeros, ("embed",)),
+                               (H,), c.param_dtype).astype(x.dtype)
+        return y
 
 
 class Block(nn.Module):

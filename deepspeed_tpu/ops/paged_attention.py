@@ -37,8 +37,12 @@ _NEG_INF = -1e30
 
 
 def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
-                        scale: Optional[float] = None, interpret=None):
-    """Ground-truth XLA path: gather this slot's pages, masked softmax."""
+                        scale: Optional[float] = None, interpret=None,
+                        mesh=None):
+    """Ground-truth XLA path: gather this slot's pages, masked softmax.
+
+    ``mesh`` is accepted for signature parity with the Pallas path; the XLA
+    body is einsum/gather code the SPMD partitioner shards on its own."""
     S, nkv, g, hd = q.shape
     NB, _, bs, _ = k_pages.shape
     MB = block_table.shape[1]
@@ -61,54 +65,98 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     return jnp.einsum("sngk,sknd->sngd", probs.astype(q.dtype), v_seq)
 
 
-def _kernel(bt_ref, len_ref,                       # scalar prefetch
-            q_ref, k_ref, v_ref, o_ref,            # blocks
-            m_scr, l_scr, acc_scr, *, bs, scale):
-    s, b = pl.program_id(0), pl.program_id(2)
-    nb = pl.num_programs(2)
+def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
+            q_ref, k_hbm, v_hbm, o_ref,            # q/o VMEM; pages stay HBM
+            k_buf, v_buf, sem, *, bs, scale):
+    """One (slot, kv-head) per grid step; in-kernel double-buffered DMA loop
+    over exactly the slot's USED pages.
 
-    @pl.when(b == 0)
-    def _init():
-        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
-        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
-
+    The earlier design put the page index on the grid (S, nkv, MB) and clamped
+    past-the-end index maps; with 1-token decode that is thousands of grid
+    steps of [g, bs] work — pure dispatch latency.  Here the grid is (S, nkv)
+    (~slots×heads steps) and the page loop is a `fori_loop` whose trip count is
+    the slot's actual page count, with page b+1's DMA in flight while page b
+    computes (pallas_guide.md double-buffering pattern) — bandwidth scales
+    with tokens attended, grid overhead scales with slots."""
+    s, h = pl.program_id(0), pl.program_id(1)
     length = len_ref[s]
+    n_pages = (length + bs - 1) // bs
+    g, hd = q_ref.shape[2], q_ref.shape[3]
+    q = q_ref[0, 0]                                # [g, hd]
 
-    @pl.when(b * bs < length)
-    def _body():
-        q = q_ref[0, 0]                            # [g, hd]
-        k = k_ref[0, 0]                            # [bs, hd]
-        v = v_ref[0, 0]
+    def dma(hbm, buf, slot, p, way):
+        return pltpu.make_async_copy(
+            hbm.at[bt_ref[s, p], h], buf.at[slot], sem.at[way * 2 + slot])
+
+    @pl.when(n_pages > 0)
+    def _warmup():
+        dma(k_hbm, k_buf, 0, 0, 0).start()
+        dma(v_hbm, v_buf, 0, 0, 1).start()
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p, 2)
+        nxt = jax.lax.rem(p + 1, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _prefetch():
+            dma(k_hbm, k_buf, nxt, p + 1, 0).start()
+            dma(v_hbm, v_buf, nxt, p + 1, 1).start()
+
+        dma(k_hbm, k_buf, slot, p, 0).wait()
+        dma(v_hbm, v_buf, slot, p, 1).wait()
+        k = k_buf[slot]                            # [bs, hd]
+        v = v_buf[slot]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [g, bs]
-        kvpos = b * bs + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
+        kvpos = p * bs + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         scores = jnp.where(kvpos < length, scores, _NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-        p = jnp.exp(scores - m_new)                # [g, bs]
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[...] = jnp.broadcast_to(
-            alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
-            l_scr.shape)
-        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+        pr = jnp.exp(scores - m_new)               # [g, bs]
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(pr, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(pr.astype(v.dtype), v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc_scr[...] = acc_scr[...] * alpha + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        return m_new, l, acc * alpha + pv
 
-    @pl.when(b == nb - 1)
-    def _finalize():
-        l = l_scr[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)            # inactive slot -> zeros
-        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+    m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)                # inactive slot -> zeros
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
 
 
 def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                            scale: Optional[float] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           mesh=None):
+    """Mesh-aware entry: with a ``tp`` axis the kv-head dim is sharded, and the
+    kernel runs per-shard under shard_map (attention is independent per kv
+    head, so TP needs no collective here — the reference shards its blocked
+    flash the same way, model_implementations/sharding/attn.py)."""
+    if (mesh is not None and mesh.shape.get("tp", 1) > 1
+            and q.shape[1] % mesh.shape["tp"] == 0):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        inner = functools.partial(_pallas_paged_attention_local,
+                                  scale=scale, interpret=interpret)
+        kv_spec = P(None, "tp", None, None)
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(kv_spec, kv_spec, kv_spec, P(None, None), P(None)),
+            out_specs=kv_spec, check_vma=False,
+        )(q, k_pages, v_pages, block_table, kv_lens)
+    return _pallas_paged_attention_local(q, k_pages, v_pages, block_table,
+                                         kv_lens, scale=scale,
+                                         interpret=interpret)
+
+
+def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
+                                  scale: Optional[float] = None,
+                                  interpret: Optional[bool] = None):
     S, nkv, g, hd = q.shape
     NB, _, bs, _ = k_pages.shape
     MB = block_table.shape[1]
@@ -119,13 +167,7 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     block_table = block_table.astype(jnp.int32)
     kv_lens = kv_lens.astype(jnp.int32)
 
-    def page_map(s, h, b, bt, lens):
-        # clamp past-the-end to the last used page: same index as the
-        # previous step ⇒ Pallas elides the DMA, so dead blocks cost nothing
-        used_minus1 = jnp.maximum(lens[s] + bs - 1, bs) // bs - 1
-        return (bt[s, jnp.minimum(b, used_minus1)], h, 0, 0)
-
-    grid = (S, nkv, MB)
+    grid = (S, nkv)
     kernel = functools.partial(_kernel, bs=bs, scale=float(scale))
     out = pl.pallas_call(
         kernel,
@@ -133,29 +175,31 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, g, hd),
-                             lambda s, h, b, bt, lens: (s, h, 0, 0)),
-                pl.BlockSpec((1, 1, bs, hd), page_map),
-                pl.BlockSpec((1, 1, bs, hd), page_map),
+                pl.BlockSpec((1, 1, g, hd), lambda s, h, bt, lens: (s, h, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),     # k pages stay in HBM
+                pl.BlockSpec(memory_space=pl.ANY),     # v pages stay in HBM
             ],
             out_specs=pl.BlockSpec((1, 1, g, hd),
-                                   lambda s, h, b, bt, lens: (s, h, 0, 0)),
+                                   lambda s, h, bt, lens: (s, h, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((g, 128), jnp.float32),
-                pltpu.VMEM((g, 128), jnp.float32),
-                pltpu.VMEM((g, hd), jnp.float32),
+                pltpu.VMEM((2, bs, hd), k_pages.dtype),   # k double buffer
+                pltpu.VMEM((2, bs, hd), v_pages.dtype),   # v double buffer
+                pltpu.SemaphoreType.DMA((4,)),            # [way*2 + slot]
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((S, nkv, g, hd), q.dtype),
+        # "arbitrary" both: kernels with internal DMA loops must not be
+        # core-parallelized (jax's own paged_attention kernel hangs under
+        # wrong megacore parallelism — see its docstring caveat)
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_table, kv_lens, q, k_pages, v_pages)
     return out
 
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
-              interpret=None):
+              interpret=None, mesh=None):
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
@@ -167,8 +211,10 @@ def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
 def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                     scale: Optional[float] = None,
                     impl: Optional[str] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    mesh=None):
     """Registry entry (ops/__init__ registers this like causal_attention)."""
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("paged_attention", q, k_pages, v_pages, block_table,
-                    kv_lens, scale=scale, impl=impl, interpret=interpret)
+                    kv_lens, scale=scale, impl=impl, interpret=interpret,
+                    mesh=mesh)

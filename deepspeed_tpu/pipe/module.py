@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.models.gpt import Block, GPTConfig, Norm
-from deepspeed_tpu.pipe.schedule import pipeline_forward
+from deepspeed_tpu.pipe.schedule import make_pipeline_loss, pipeline_forward
 
 
 def _box(value, names):
@@ -68,7 +68,8 @@ class PipeGPT:
     is_pipeline = True
     mesh = None  # engine binding hook (unused — global-view roll needs no mesh)
 
-    def __init__(self, cfg: GPTConfig, num_stages: int):
+    def __init__(self, cfg: GPTConfig, num_stages: int,
+                 schedule: str = "1f1b"):
         if cfg.num_layers % num_stages != 0:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by "
@@ -76,8 +77,12 @@ class PipeGPT:
         if cfg.num_experts:
             raise NotImplementedError("MoE inside the pipeline: use ep mesh "
                                       "axis with the non-pipelined engine")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                             f"expected '1f1b' or 'gpipe'")
         self.cfg = cfg
         self.num_stages = num_stages
+        self.schedule = schedule
         self._block = Block(cfg)
 
     # ---- engine contract ----
@@ -131,31 +136,22 @@ class PipeGPT:
         ids = _3d(batch["input_ids"])
         M, B, T = ids.shape
         embed = _unbox_one(p["embed"]).astype(c.dtype)
-        x = embed[ids]  # [M, B, T, H]
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-        if not c.use_rope:
-            x = x + _unbox_one(p["wpe"]).astype(c.dtype)[None, None, :T]
 
         block = self._block
+        S = self.num_stages
         blocks_params = jax.tree_util.tree_map(_unbox_one, p["blocks"],
                                                is_leaf=lambda x: isinstance(
                                                    x, nn.Partitioned))
         deterministic = c.dropout == 0.0 or rng is None
-        if not deterministic:
-            # per-stage dropout rngs ride along in the vmapped params; folded
-            # per layer inside the stage.  Note: within one pipelined step the
-            # dropout pattern is shared across microbatches (rng is not
-            # tick-dependent) — acceptable regularization-wise, documented here.
-            S = self.num_stages
-            stage_rngs = jax.random.split(rng, S)
-            carry_params = (blocks_params, stage_rngs)
-        else:
-            carry_params = (blocks_params, jnp.zeros((self.num_stages, 2),
-                                                     jnp.uint32))
+        # per-stage dropout rngs folded per layer inside the stage.  Note:
+        # within one pipelined step the dropout pattern is shared across
+        # microbatches (rng is not tick-dependent) — acceptable
+        # regularization-wise, documented here.
+        stage_rngs = (jax.random.split(rng, S) if not deterministic
+                      else jnp.zeros((S, 2), jnp.uint32))
 
-        def stage_fn(sp_and_rng, h):
-            sp, srng = sp_and_rng
-
+        def stage_fn(sp, srng, h):
             def body(carry, lp):
                 h, i = carry
                 if deterministic:
@@ -167,12 +163,6 @@ class PipeGPT:
                 return (h, i + 1), None
             (h, _), _ = lax.scan(body, (h, jnp.int32(0)), sp)
             return h
-
-        if c.remat:
-            stage_fn = jax.checkpoint(
-                stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
-
-        outs = pipeline_forward(stage_fn, carry_params, x)  # [M, B, T, H]
 
         # labels/mask (same contract as models/gpt.py GPT.__call__)
         if batch.get("labels") is not None:
@@ -186,11 +176,27 @@ class PipeGPT:
             labels = jnp.pad(ids[:, :, 1:], ((0, 0), (0, 0), (0, 1)))
             mask = jnp.ones_like(labels, jnp.float32).at[:, :, -1].set(0.0)
 
-        # final norm + head + loss per microbatch (scan keeps only one
-        # microbatch's fp32 logits live at a time)
         scale = _unbox_one(p["final_norm_scale"]).astype(jnp.float32)
         bias = (None if c.use_rmsnorm
                 else _unbox_one(p["final_norm_bias"]).astype(jnp.float32))
+        sum_mask = jnp.sum(mask)
+
+        if self.schedule == "1f1b":
+            return self._apply_1f1b(p, ids, labels, mask, sum_mask,
+                                    scale, bias, blocks_params, stage_rngs,
+                                    stage_fn)
+
+        # ---- GPipe path: forward scan + autodiff backward ----
+        x = embed[ids]  # [M, B, T, H]
+        if not c.use_rope:
+            x = x + _unbox_one(p["wpe"]).astype(c.dtype)[None, None, :T]
+        gp_stage_fn = lambda sp_rng, h: stage_fn(*sp_rng, h)  # noqa: E731
+        if c.remat:
+            gp_stage_fn = jax.checkpoint(
+                gp_stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        outs = pipeline_forward(gp_stage_fn, (blocks_params, stage_rngs),
+                                x)  # [M, B, T, H]
+
         head = (embed.astype(jnp.float32).T if c.tie_embeddings
                 else _unbox_one(p["head"]).astype(jnp.float32))
 
@@ -203,13 +209,56 @@ class PipeGPT:
                 h = rms_norm(h, scale)
             else:
                 h = layer_norm(h, scale, bias)
-            s_nll, s_msk = carry
-            return (s_nll + masked_nll_sum(h, head, lab, msk),
-                    s_msk + jnp.sum(msk)), None
+            s_nll = carry
+            return s_nll + masked_nll_sum(h, head, lab, msk), None
 
-        (sum_nll, sum_mask), _ = lax.scan(
-            micro_loss, (jnp.float32(0.0), jnp.float32(0.0)),
-            (outs, labels, mask))
+        sum_nll, _ = lax.scan(micro_loss, jnp.float32(0.0),
+                              (outs, labels, mask))
+        return sum_nll / jnp.maximum(sum_mask, 1.0)
+
+    def _apply_1f1b(self, p, ids, labels, mask, sum_mask, scale, bias,
+                    blocks_params, stage_rngs, stage_fn):
+        """1F1B fused fwd+bwd schedule (pipe/schedule.py make_pipeline_loss):
+        embedding and loss head fold INTO the pipelined scan so activations die
+        as their microbatch's backward completes — O(stages) residency."""
+        c = self.cfg
+        T = ids.shape[2]
+
+        ep = {"embed": _unbox_one(p["embed"])}
+        if not c.use_rope:
+            ep["wpe"] = _unbox_one(p["wpe"])
+
+        def embed_fn(ep_, bm):
+            xm = ep_["embed"].astype(c.dtype)[bm["input_ids"]]
+            if not c.use_rope:
+                xm = xm + ep_["wpe"].astype(c.dtype)[None, :T]
+            return xm
+
+        hp = {"scale": scale}
+        if bias is not None:
+            hp["bias"] = bias
+        # tied embeddings: the SAME traced array feeds embed_fn and head_fn —
+        # the outer autodiff sums both cotangent paths (reference
+        # TiedLayerSpec/_exec_reduce_tied_grads, free here)
+        hp["head"] = (ep["embed"] if c.tie_embeddings
+                      else _unbox_one(p["head"]))
+
+        def head_fn(hp_, y, bm):
+            from deepspeed_tpu.ops import (layer_norm, masked_nll_sum,
+                                           rms_norm)
+            h = y.astype(jnp.float32)
+            if c.use_rmsnorm:
+                h = rms_norm(h, hp_["scale"])
+            else:
+                h = layer_norm(h, hp_["scale"], hp_["bias"])
+            head = hp_["head"].astype(jnp.float32)
+            if c.tie_embeddings:
+                head = head.T
+            return masked_nll_sum(h, head, bm["labels"], bm["mask"])
+
+        pipeline_loss = make_pipeline_loss(embed_fn, stage_fn, head_fn)
+        batch_tree = {"input_ids": ids, "labels": labels, "mask": mask}
+        sum_nll = pipeline_loss(ep, blocks_params, hp, stage_rngs, batch_tree)
         return sum_nll / jnp.maximum(sum_mask, 1.0)
 
 
