@@ -18,11 +18,11 @@ Entry points:
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -41,6 +41,38 @@ def _read_json(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
+def _arch_of(hf: Dict[str, Any]) -> str:
+    archs = hf.get("architectures") or []
+    return archs[0] if archs else hf.get("model_type", "?")
+
+
+def _reject_unsupported_semantics(hf: Dict[str, Any], arch: str,
+                                  max_seq_len: Optional[int]) -> None:
+    """Raise rather than silently serve a DIFFERENT model: config fields that
+    change the math must be implemented or rejected (round-2 review)."""
+    scaling = hf.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        raise ValueError(
+            f"{arch}: rope_scaling={scaling!r} is not implemented "
+            f"(llama3/yarn-scaled RoPE); logits would be silently wrong")
+    if hf.get("mlp_bias"):
+        raise ValueError(
+            f"{arch}: mlp_bias=true (gate/up/down biases) is not implemented "
+            f"in the SwiGLU body; logits would be silently wrong")
+    window = hf.get("sliding_window")
+    uses_window = window is not None and (
+        hf.get("use_sliding_window", True) if "use_sliding_window" in hf
+        else True)
+    if uses_window:
+        msl = hf.get("max_position_embeddings", 2048)
+        eff = min(msl, max_seq_len or msl)
+        if window < eff:
+            raise ValueError(
+                f"{arch}: sliding_window={window} < effective max_seq_len "
+                f"{eff} — windowed attention is not implemented; cap "
+                f"max_seq_len to {window} to serve exactly")
+
+
 def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
                    dtype=None):
     """Build a GPTConfig from ``<model_path>/config.json``.
@@ -51,14 +83,15 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
     from deepspeed_tpu.models.gpt import GPTConfig
 
     hf = _read_json(os.path.join(model_path, "config.json"))
-    archs = hf.get("architectures") or []
-    arch = archs[0] if archs else hf.get("model_type", "?")
+    arch = _arch_of(hf)
 
     if arch in _LLAMA_LIKE:
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
         hidden = hf["hidden_size"]
         heads = hf["num_attention_heads"]
         head_dim = hf.get("head_dim") or hidden // heads
         msl = hf.get("max_position_embeddings", 2048)
+        attn_bias = bool(hf.get("attention_bias", False))
         return GPTConfig(
             vocab_size=hf["vocab_size"],
             num_layers=hf["num_hidden_layers"],
@@ -72,7 +105,8 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
-            qkv_bias=(arch == "Qwen2ForCausalLM"),
+            qkv_bias=(arch == "Qwen2ForCausalLM") or attn_bias,
+            attn_out_bias=attn_bias,
             dtype=dtype or jnp.bfloat16,
         )
     if arch in _GPT2_LIKE:
@@ -113,7 +147,7 @@ class _ShardReader:
                                  for k, v in weight_map.items()}
         elif os.path.exists(single):
             from safetensors import safe_open
-            with safe_open(single, framework="flax") as f:
+            with safe_open(single, framework="np") as f:
                 names = list(f.keys())
             self.name_to_file = {k: single for k in names}
         else:
@@ -127,11 +161,19 @@ class _ShardReader:
         return self.name_to_file.keys()
 
     def get(self, name: str) -> np.ndarray:
+        # framework="pt" + a zero-copy bf16 view keeps tensors HOST-resident
+        # (framework="flax" would commit every tensor to device-0 HBM before
+        # the engine gets to shard/cast it; framework="np" rejects bf16)
         from safetensors import safe_open
         file = self.name_to_file[name]
         if file not in self._open:
-            self._open[file] = safe_open(file, framework="flax")
-        return self._open[file].get_tensor(name)
+            self._open[file] = safe_open(file, framework="pt")
+        t = self._open[file].get_tensor(name)
+        import torch
+        if t.dtype == torch.bfloat16:
+            import ml_dtypes
+            return t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+        return t.numpy()
 
     def has(self, name: str) -> bool:
         return name in self.name_to_file
@@ -159,6 +201,8 @@ def _llama_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
             att["bq"] = r.get(p + "self_attn.q_proj.bias").reshape(nh, hd)
             att["bk"] = r.get(p + "self_attn.k_proj.bias").reshape(nkv, hd)
             att["bv"] = r.get(p + "self_attn.v_proj.bias").reshape(nkv, hd)
+        if cfg.attn_out_bias:
+            att["bo"] = r.get(p + "self_attn.o_proj.bias")
         bb[f"block_{i}"] = {
             "Attention_0": att,
             "Norm_0": {"scale": r.get(p + "input_layernorm.weight")},
@@ -230,11 +274,10 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
     """
     cfg = config_from_hf(model_path, max_seq_len=max_seq_len, dtype=dtype)
     r = _ShardReader(model_path)
-    hf = _read_json(os.path.join(model_path, "config.json"))
-    arch = (hf.get("architectures") or ["?"])[0]
+    arch = _arch_of(_read_json(os.path.join(model_path, "config.json")))
     tree = (_gpt2_tree if arch in _GPT2_LIKE else _llama_tree)(r, cfg)
     n = sum(int(np.prod(l.shape))
-            for l in __import__("jax").tree_util.tree_leaves(tree))
+            for l in jax.tree_util.tree_leaves(tree))
     log_dist(f"loaded HF checkpoint {model_path} ({arch}): {n/1e6:.1f}M params",
              ranks=[0])
     return cfg, tree

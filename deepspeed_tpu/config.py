@@ -295,15 +295,18 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
     if z.offload_param.device != "none":
         inert.append("zero_optimization.offload_param (param offload to "
                      "cpu/nvme)")
-    if z.zero_quantized_weights or z.zero_quantized_gradients:
-        inert.append("zero_optimization.zero_quantized_weights/gradients "
-                     "(ZeRO++ quantized collectives)")
+    if z.zero_quantized_weights and z.stage < 3:
+        inert.append("zero_optimization.zero_quantized_weights (qwZ is the "
+                     "stage-3 weight all-gather; inert at stage "
+                     f"{z.stage} — set stage 3 and an fsdp mesh axis > 1)")
+    if z.zero_quantized_gradients:
+        inert.append("zero_optimization.zero_quantized_gradients (qgZ "
+                     "quantized grad reduce-scatter; the collective exists — "
+                     "ops/quantization.quantized_psum_scatter — but the "
+                     "engine grad path does not route through it yet)")
     if z.zero_hpz_partition_size != 1:
         inert.append("zero_optimization.zero_hpz_partition_size "
                      "(hierarchical secondary partitions)")
-    if cfg.gradient_compression.enabled:
-        inert.append("gradient_compression (DCN-tier compressed grad "
-                     "collectives)")
     ac = cfg.activation_checkpointing
     if ac.partition_activations or ac.cpu_checkpointing or ac.number_checkpoints:
         inert.append("activation_checkpointing.partition_activations/"

@@ -218,6 +218,29 @@ class DeepSpeedTPUEngine:
         self.grad_shardings = partition.state_leaf_shardings(
             annotated, mesh, self.zero_stage if self.zero_stage >= 2 else 0)
 
+        # ZeRO++ qwZ: per-leaf fsdp-sharded dim for the quantized weight
+        # all-gather (None = leaf not fsdp-sharded) — built once from the
+        # sharding specs, consumed in _loss
+        self._qwz_dims = None
+        if (config.zero_optimization.zero_quantized_weights
+                and self.zero_stage >= 3 and mesh.shape["fsdp"] > 1):
+            def fsdp_dim(sh):
+                # -1 sentinel = leaf not fsdp-sharded (None would vanish as an
+                # empty pytree under tree_map); dims co-sharded with another
+                # axis (tuple specs) keep the partitioner's implicit gather
+                for d, ax in enumerate(sh.spec):
+                    if ax == "fsdp":
+                        return d
+                return -1
+            self._qwz_dims = jax.tree_util.tree_map(fsdp_dim,
+                                                    self.param_shardings)
+        elif (config.zero_optimization.zero_quantized_weights
+              and self.zero_stage >= 3):
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning("zero_quantized_weights set but the fsdp mesh axis "
+                           "is 1 — there is no weight all-gather to quantize; "
+                           "flag is inert on this mesh")
+
         # ---- build + jit the step functions ----
         self._jit_init = jax.jit(
             self._make_init(), out_shardings=self._as_shardings_tuple())
@@ -292,6 +315,12 @@ class DeepSpeedTPUEngine:
             inner, opt_params = optimizers.build_optimizer(
                 cfg.optimizer.type, params)
         chain = []
+        if cfg.gradient_compression.enabled:
+            # error-feedback compressed grads (1-bit-optimizer analog,
+            # runtime/compression.py) — BEFORE clipping so the clip sees the
+            # signal the optimizer will consume
+            from deepspeed_tpu.runtime.compression import compress_gradients
+            chain.append(compress_gradients(cfg.gradient_compression.dtype))
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
             chain.append(optax.clip_by_global_norm(cfg.gradient_clipping))
         chain.append(inner)
@@ -327,6 +356,17 @@ class DeepSpeedTPUEngine:
     def _loss(self, params, batch, rng, scale):
         if not self.use_master_weights:
             params = _cast_params(params, self.compute_dtype)
+        if self._qwz_dims is not None:
+            # ZeRO++ qwZ: explicit int8 weight all-gather (s8 on the wire)
+            # instead of the partitioner's implicit bf16 gather
+            from deepspeed_tpu.ops.quantization import quantized_weight_gather
+            mesh = self.mesh
+
+            def gather(p, d):
+                if d < 0 or p.shape[d] % mesh.shape["fsdp"]:
+                    return p
+                return quantized_weight_gather(p, mesh, "fsdp", d)
+            params = jax.tree_util.tree_map(gather, params, self._qwz_dims)
         loss = self._apply_fn(params, batch, rng)
         return (loss * scale).astype(jnp.float32), loss
 

@@ -1,0 +1,201 @@
+"""Block quantization ops — int8/int4 symmetric, per-block scales.
+
+TPU-native analog of the reference quantizer kernels
+(csrc/quantization/quantize.cu, fake_quantizer.cu; python surface
+deepspeed/ops/quantizer + inference/quantization).  Semantics match the
+reference's symmetric blocked quantizer: a tensor is viewed as flat blocks of
+``block_size`` values; each block stores int values in [-(2^(bits-1)-1),
+2^(bits-1)-1] plus one fp scale.  On TPU this is a handful of elementwise ops
++ a reduce per block — XLA fuses it into surrounding code; there is no kernel
+to write, the value is the WIRE/STORAGE format (quantized collectives, ZeRO++
+weight gathers, ZeRO-Inference weight storage).
+
+int4 packs two values per int8 byte (reference quantize_int4.cu) so the wire
+moves 4 bits/value.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedBlocks(NamedTuple):
+    """values: int8 [N/bs, bs] (int4: packed [N/bs, bs/2]); scales fp32
+    [N/bs, 1]; meta carries the original shape/dtype/bits for dequant."""
+
+    values: jax.Array
+    scales: jax.Array
+    shape: Tuple[int, ...]
+    dtype: object
+    bits: int
+    block_size: int
+
+
+def _pad_to_blocks(flat, block_size):
+    n = flat.shape[0]
+    pad = (-n) % block_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, n
+
+
+def quantize_blockwise(x, *, bits: int = 8,
+                       block_size: int = 256) -> QuantizedBlocks:
+    """Symmetric per-block quantization (reference quantize.cu semantics:
+    scale = max|x| / qmax per block, stochastic-free round-to-nearest)."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat, n = _pad_to_blocks(x.reshape(-1).astype(jnp.float32), block_size)
+    blocks = flat.reshape(-1, block_size)
+    qmax = float(2 ** (bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scales = absmax / qmax
+    inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
+    q = jnp.clip(jnp.round(blocks * inv), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        # pack pairs: low nibble = even index, high nibble = odd index
+        lo = q[:, 0::2] & 0x0F
+        hi = (q[:, 1::2] & 0x0F) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return QuantizedBlocks(values=q, scales=scales, shape=orig_shape,
+                           dtype=orig_dtype, bits=bits, block_size=block_size)
+
+
+def dequantize_blockwise(qb: QuantizedBlocks) -> jax.Array:
+    q = qb.values
+    if qb.bits == 4:
+        lo = (q << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
+        hi = q >> 4                                   # arithmetic shift: high
+        q = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+    x = q.astype(jnp.float32) * qb.scales
+    n = 1
+    for d in qb.shape:
+        n *= d
+    return x.reshape(-1)[:n].reshape(qb.shape).astype(qb.dtype)
+
+
+def quantize_dequantize(x, *, bits: int = 8, block_size: int = 256):
+    """Fake-quant (reference fake_quantizer.cu): the QDQ roundtrip used for
+    error injection / compression emulation inside fp math."""
+    return dequantize_blockwise(quantize_blockwise(x, bits=bits,
+                                                   block_size=block_size))
+
+
+# ---------------------------------------------------------------- collectives
+def quantized_all_gather(x, mesh, axis: str, *, bits: int = 8,
+                         block_size: int = 256, gather_dim: int = 0):
+    """All-gather ``x`` (sharded on ``gather_dim`` over mesh axis) moving int
+    values + fp scales on the wire instead of full-precision values — the
+    ZeRO++ qwZ quantized weight all-gather
+    (reference runtime/zero/stage3.py:1497 all_gather_coalesced with
+    quantization=..., csrc/quantization/ kernels).
+
+    Returns the gathered, dequantized array (replicated over ``axis``).
+    Compression: bits/16 of the bf16 wire volume (+ scales overhead).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    size = mesh.shape[axis]
+    if size == 1:
+        return x
+    if x.shape[gather_dim] % size:
+        raise ValueError(f"dim {gather_dim} ({x.shape[gather_dim]}) not "
+                         f"divisible by mesh axis {axis}={size}")
+
+    in_spec = [None] * x.ndim
+    in_spec[gather_dim] = axis
+
+    def local(xs):
+        qb = quantize_blockwise(xs, bits=bits, block_size=block_size)
+        vg = jax.lax.all_gather(qb.values, axis)         # int8 on the wire
+        sg = jax.lax.all_gather(qb.scales, axis)
+        parts = [
+            dequantize_blockwise(qb._replace(values=vg[i], scales=sg[i]))
+            for i in range(size)]
+        return jnp.concatenate(parts, axis=gather_dim)
+
+    return shard_map(local, mesh=mesh, in_specs=P(*in_spec),
+                     out_specs=P(), check_vma=False)(x)
+
+
+def quantized_psum_scatter(x, mesh, axis: str, *, bits: int = 8,
+                           block_size: int = 256, scatter_dim: int = 0):
+    """Reduce-scatter with int-quantized wire format + fp32 scale exchange —
+    the qgZ quantized gradient reduce direction (reference
+    runtime/zero/stage3.py quantized_reduce_scatter path,
+    csrc/quantization/swizzled_quantize.cu).  all-to-all of quantized shard
+    contributions, local dequant + sum.
+
+    x is replicated per-shard-group input (leading dim divisible by axis
+    size); returns this shard's reduced slice.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    size = mesh.shape[axis]
+    if size == 1:
+        return x
+    if x.shape[scatter_dim] % size:
+        raise ValueError(f"dim {scatter_dim} ({x.shape[scatter_dim]}) not "
+                         f"divisible by mesh axis {axis}={size}")
+
+    out_spec = [None] * x.ndim
+    out_spec[scatter_dim] = axis
+
+    def local(xs):
+        # xs: full array (replicated view per member).  Quantize each target
+        # shard's slice INDEPENDENTLY (blocks never straddle shard
+        # boundaries), all_to_all so member i receives every member's
+        # contribution for slice i, dequant + sum.
+        parts = jnp.split(xs, size, axis=scatter_dim)
+        qbs = [quantize_blockwise(p, bits=bits, block_size=block_size)
+               for p in parts]
+        v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),
+                               axis, 0, 0, tiled=False)
+        s = jax.lax.all_to_all(jnp.stack([q.scales for q in qbs]),
+                               axis, 0, 0, tiled=False)
+        total = jnp.zeros(parts[0].shape, jnp.float32)
+        for i in range(size):
+            qi = qbs[0]._replace(values=v[i], scales=s[i])
+            total = total + dequantize_blockwise(qi).astype(jnp.float32)
+        return total.astype(xs.dtype)
+
+    return shard_map(local, mesh=mesh, in_specs=P(),
+                     out_specs=P(*out_spec), check_vma=False)(x)
+
+
+def quantized_weight_gather(x, mesh, axis: str, gather_dim: int, *,
+                            bits: int = 8, block_size: int = 256):
+    """Differentiable ZeRO++ qwZ gather: forward moves int values on the wire
+    (quantized_all_gather); backward constrains the cotangent back to the
+    sharded layout so XLA emits the ordinary grad reduce-scatter — weight
+    quantization never biases gradients (reference: qwZ quantizes the fwd/bwd
+    weight all-gather only, runtime/zero/stage3.py:1497)."""
+    import jax as _jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * x.ndim
+    spec[gather_dim] = axis
+    shard_sharding = NamedSharding(mesh, P(*spec))
+    dtype = x.dtype
+
+    @_jax.custom_vjp
+    def gather(v):
+        return quantized_all_gather(v, mesh, axis, bits=bits,
+                                    block_size=block_size,
+                                    gather_dim=gather_dim)
+
+    def fwd(v):
+        return gather(v), None
+
+    def bwd(_, ct):
+        return (_jax.lax.with_sharding_constraint(
+            ct.astype(dtype), shard_sharding),)
+
+    gather.defvjp(fwd, bwd)
+    return gather(x)

@@ -194,3 +194,24 @@ class Test1F1B:
 
         m1, m2 = mem(pipe1), mem(pipe2)
         assert m1 < m2, (m1, m2)
+
+    def test_1f1b_bf16_engine_step(self, devices):
+        """bf16 compute (engine casts params) must not break the custom-vjp
+        dtype contract (round-2 review finding)."""
+        cfg = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, num_layers=2,
+                        num_heads=4, head_dim=8, hidden_size=32, mlp_ratio=2)
+        model = PipeGPT(cfg, num_stages=2, schedule="1f1b")
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "mesh": {"pp": 2, "dp": 1},
+            "steps_per_print": 0,
+        }
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, VOCAB, (4, 2, SEQ)).astype(np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=config, example_batch={"input_ids": ids})
+        m = engine.train_batch({"input_ids": ids})
+        assert np.isfinite(float(m.loss))

@@ -1,0 +1,140 @@
+"""Quantization tier tests (reference pattern: tests/unit/ops/quantizer/ +
+tests/unit/runtime/zero/test_zeropp.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, GPTConfig
+from deepspeed_tpu.ops.quantization import (dequantize_blockwise,
+                                            quantize_blockwise,
+                                            quantize_dequantize,
+                                            quantized_all_gather,
+                                            quantized_psum_scatter,
+                                            quantized_weight_gather)
+from deepspeed_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+class TestBlockQuant:
+    def test_int8_roundtrip_error_bounded(self, rng):
+        x = jnp.asarray(rng.standard_normal(1000), jnp.float32)
+        y = quantize_dequantize(x, bits=8, block_size=128)
+        # symmetric int8: error <= scale/2 = max|block|/127/2
+        assert float(jnp.max(jnp.abs(y - x))) <= float(
+            jnp.max(jnp.abs(x))) / 127 / 2 + 1e-7
+
+    def test_int4_pack_roundtrip(self, rng):
+        x = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+        qb = quantize_blockwise(x, bits=4, block_size=64)
+        assert qb.values.shape[-1] == 32          # packed: 2 values/byte
+        y = dequantize_blockwise(qb)
+        assert y.shape == x.shape
+        assert float(jnp.max(jnp.abs(y - x))) <= float(
+            jnp.max(jnp.abs(x))) / 7 / 2 + 1e-7
+
+    def test_zero_block(self):
+        x = jnp.zeros(64)
+        np.testing.assert_array_equal(np.asarray(quantize_dequantize(x)), 0.0)
+
+    def test_preserves_shape_dtype(self, rng):
+        x = jnp.asarray(rng.standard_normal((3, 5, 7)), jnp.bfloat16)
+        y = quantize_dequantize(x, block_size=32)
+        assert y.shape == x.shape and y.dtype == x.dtype
+
+
+class TestQuantizedCollectives:
+    @pytest.fixture()
+    def mesh(self):
+        return build_mesh(MeshSpec(fsdp=4, dp=1, tp=1))
+
+    def test_all_gather_close_to_exact(self, mesh, rng):
+        x = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+        got = jax.jit(lambda v: quantized_all_gather(
+            v, mesh, "fsdp", block_size=64))(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x),
+                                   atol=np.abs(x).max() / 127 + 1e-6)
+
+    def test_psum_scatter_close_to_plain_sum(self, mesh, rng):
+        x = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+        got = jax.jit(lambda v: quantized_psum_scatter(
+            v, mesh, "fsdp", block_size=64))(x)
+        # every member contributes the same replicated x -> sum = size * x
+        np.testing.assert_allclose(np.asarray(got), 4 * np.asarray(x),
+                                   atol=4 * (np.abs(x).max() / 127) + 1e-5)
+
+    def test_wire_dtype_is_int8(self, mesh):
+        """The flag's whole point: the collective moves s8, not f32/bf16."""
+        x = jnp.ones((64, 16), jnp.float32)
+        hlo = jax.jit(lambda v: quantized_all_gather(
+            v, mesh, "fsdp", block_size=64)).lower(x).as_text()
+        assert any(("all_gather" in ln or "all-gather" in ln)
+                   and ("i8" in ln or "s8" in ln)
+                   for ln in hlo.splitlines()), hlo
+
+    def test_weight_gather_backward_is_sharded_identity(self, mesh, rng):
+        """d/dx sum(gather(x) * w) must equal w exactly (quantization must not
+        bias gradients — qwZ quantizes only the forward wire)."""
+        x = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+        g = jax.grad(lambda v: jnp.sum(quantized_weight_gather(
+            v, mesh, "fsdp", 0, block_size=64) * w))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-6)
+
+
+class TestEngineIntegration:
+    def _train(self, extra_cfg, steps=30):
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        rng = np.random.default_rng(0)
+        pool = rng.integers(0, 128, size=(8, 32)).astype(np.int32)
+        config = {
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "mesh": {"dp": 1},
+            "steps_per_print": 0,
+            **extra_cfg,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config=config,
+            example_batch={"input_ids": pool})
+        losses = [float(engine.train_batch({"input_ids": pool}).loss)
+                  for _ in range(steps)]
+        return losses
+
+    def test_gradient_compression_converges(self):
+        base = self._train({})
+        comp = self._train({"gradient_compression": {"enabled": True,
+                                                     "dtype": "int8"}})
+        assert comp[-1] < comp[0] * 0.5
+        # error feedback keeps compressed training near baseline
+        assert abs(comp[-1] - base[-1]) < 0.5 * base[0]
+
+    def test_qwz_changes_hlo_to_int8_gather(self):
+        """zero_quantized_weights + stage 3: the train step's HLO must contain
+        an s8 all-gather (reference ZeRO++ qwZ)."""
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        rng = np.random.default_rng(0)
+        pool = rng.integers(0, 128, size=(8, 32)).astype(np.int32)
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3,
+                                  "zero_quantized_weights": True},
+            "mesh": {"fsdp": 4, "dp": 1},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config=config,
+            example_batch={"input_ids": pool})
+        assert engine._qwz_dims is not None
+        hlo = engine._jit_train_batch.lower(
+            engine.state, {"input_ids": jnp.asarray(pool)[None]}).as_text()
+        assert any(("all_gather" in ln or "all-gather" in ln)
+                   and ("i8" in ln or "s8" in ln)
+                   for ln in hlo.splitlines())
+        # and it still trains
+        losses = [float(engine.train_batch({"input_ids": pool}).loss)
+                  for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.7
