@@ -1,0 +1,2 @@
+from deepspeed_tpu.autotuning.autotuner import (Autotuner,  # noqa: F401
+                                                ProbeResult)

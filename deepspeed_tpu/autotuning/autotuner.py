@@ -1,0 +1,140 @@
+"""Autotuner — find the fastest micro-batch size with real compile+step probes.
+
+Reference parity: ``autotuning/autotuner.py`` — the micro-batch tuner
+(``get_min_max_micro_batch_size`` :741, ``run_tuning_micro_batch_size`` :960)
+and its fast/model-based tuners (tuner/*.py).  The reference launches whole
+training jobs per experiment through the launcher and scrapes metrics files;
+here a probe is in-process — build the engine, compile the train step, time a
+few real steps — because one JAX process drives every local chip, so no
+process orchestration is needed.
+
+Search shape mirrors the reference: geometric doubling from ``start`` until a
+probe fails (OOM) or ``max_mbs`` is hit, then the failure boundary is refined
+by bisection, and the fastest measured micro-batch (tokens/s) wins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    micro_batch: int
+    ok: bool
+    step_time_s: float = float("inf")
+    tokens_per_s: float = 0.0
+    error: str = ""
+
+
+def _is_oom(err: Exception) -> bool:
+    s = str(err)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s.lower())
+
+
+class Autotuner:
+    """model + base config + a batch factory → best micro-batch.
+
+    batch_factory(micro_batch) must return a host batch pytree with
+    ``micro_batch`` leading rows (per chip).
+    """
+
+    def __init__(self, model, base_config: Dict[str, Any],
+                 batch_factory: Callable[[int], Any],
+                 probe_steps: int = 3):
+        self.model = model
+        self.base_config = dict(base_config)
+        self.batch_factory = batch_factory
+        self.probe_steps = probe_steps
+        self.results: List[ProbeResult] = []
+
+    # ---------------------------------------------------------------- probes
+    def _probe(self, mbs: int) -> ProbeResult:
+        import jax
+        import numpy as np
+        import deepspeed_tpu
+
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = mbs
+        cfg["gradient_accumulation_steps"] = 1
+        cfg.pop("train_batch_size", None)
+        cfg["steps_per_print"] = 0
+        batch = self.batch_factory(mbs)
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, config=cfg, example_batch=batch)
+            # per-chip rows → [gas=1, micro_global, ...]
+            dpw = engine.mesh.shape["dp"] * engine.mesh.shape["fsdp"]
+
+            def expand(x):
+                x = np.asarray(x)
+                reps = -(-mbs * dpw // x.shape[0])
+                return np.tile(x, (reps,) + (1,) * (x.ndim - 1)
+                               )[None, :mbs * dpw]
+            full = jax.tree_util.tree_map(expand, batch)
+            m = engine.train_batch(full)          # compile + step 0
+            jax.device_get(m.loss)
+            t0 = time.perf_counter()
+            for _ in range(self.probe_steps):
+                m = engine.train_batch(full)
+            jax.device_get(m.loss)
+            dt = (time.perf_counter() - t0) / self.probe_steps
+            leaves = jax.tree_util.tree_leaves(full)
+            # [gas, rows, T, ...] → real tokens/step (matches engine
+            # train_batch accounting, shape[:3])
+            tokens = int(np.prod(leaves[0].shape[:3]))
+            res = ProbeResult(mbs, True, dt, tokens / dt)
+        except Exception as e:  # noqa: BLE001 — OOM/compile failures end probes
+            if not _is_oom(e):
+                raise
+            res = ProbeResult(mbs, False, error=str(e)[:200])
+        self.results.append(res)
+        log_dist(f"autotune probe mbs={mbs}: "
+                 + (f"{res.tokens_per_s:,.0f} tok/s" if res.ok
+                    else "OOM"), ranks=[0])
+        return res
+
+    # ---------------------------------------------------------------- search
+    def tune_micro_batch_size(self, start: int = 1,
+                              max_mbs: Optional[int] = None) -> int:
+        """Doubling until OOM/max, bisect the boundary, return the fastest
+        micro-batch (reference get_min_max_micro_batch_size :741)."""
+        ok: List[ProbeResult] = []
+        mbs = start
+        last_ok, first_bad = 0, None
+        while True:
+            if max_mbs is not None and mbs > max_mbs:
+                break
+            r = self._probe(mbs)
+            if not r.ok:
+                first_bad = mbs
+                break
+            ok.append(r)
+            last_ok = mbs
+            mbs *= 2
+        if first_bad is not None:
+            lo, hi = last_ok, first_bad
+            while hi - lo > max(1, lo // 4):     # coarse bisect (reference
+                mid = (lo + hi) // 2             # uses similar tolerance)
+                if mid in (lo, hi) or mid == 0:
+                    break
+                r = self._probe(mid)
+                if r.ok:
+                    ok.append(r)
+                    lo = mid
+                else:
+                    hi = mid
+        if not ok:
+            raise RuntimeError(
+                f"no micro batch ≥ {start} fits on this chip "
+                f"(first OOM at {first_bad})")
+        best = max(ok, key=lambda r: r.tokens_per_s)
+        log_dist(f"autotune: best micro_batch={best.micro_batch} "
+                 f"({best.tokens_per_s:,.0f} tok/s over "
+                 f"{len(self.results)} probes)", ranks=[0])
+        return best.micro_batch

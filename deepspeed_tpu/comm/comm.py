@@ -46,12 +46,18 @@ def init_distributed(coordinator_address: Optional[str] = None,
     with _init_lock:
         if _initialized:
             return
-        multi_host = (
-            coordinator_address is not None
-            or os.environ.get("JAX_COORDINATOR_ADDRESS")
-            or (num_processes or 0) > 1
-            or int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1
-        )
+        # launcher-exported rendezvous env (launcher/runner.py) — read it
+        # explicitly rather than trusting jax's own env discovery
+        if coordinator_address is None:
+            # `or None`: an exported-but-empty var means unset, not multi-host
+            coordinator_address = (
+                os.environ.get("JAX_COORDINATOR_ADDRESS") or None)
+        if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+            process_id = int(os.environ["JAX_PROCESS_ID"])
+        multi_host = (coordinator_address is not None
+                      or (num_processes or 0) > 1)
         if multi_host:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

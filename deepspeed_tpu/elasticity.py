@@ -1,0 +1,157 @@
+"""Elastic training — batch-size/chip-count co-design solver.
+
+Reference parity: ``elasticity/elasticity.py`` (``compute_elastic_config``
+:233, ``_get_compatible_gpus_v01`` :84, v0.2 node-granular variant :129).
+Semantics preserved, vocabulary translated to TPU: "gpus" → data-parallel
+chips, "num_gpus_per_node" → chips per host, "model_parallel_size" → the
+product of non-data mesh axes (tp·pp·sp·ep), since elasticity only rescales
+the DATA-parallel extent of the mesh.
+
+The algorithm (same two heuristics as the reference): candidate global batch
+sizes are each micro-batch (and their LCM) scaled by the largest
+highly-composite number that stays under ``max_train_batch_size``; the winner
+is the candidate divisible into valid chip counts the most ways within
+[min_chips, max_chips] (prefer_larger breaks ties toward bigger batches).
+Scaling up/down across the returned chip list never changes the global batch
+⇒ no convergence impact (gradient accumulation absorbs the difference).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+# highly composite numbers — the reference's HCN_LIST (elasticity.py:23)
+# regenerated: n with more divisors than every smaller n
+_HCN = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+        1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+        50400]
+
+
+class ElasticityError(ValueError):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """reference: elasticity/config.py ElasticityConfig."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_chips: int = 1
+    max_chips: int = 10_000
+    chips_per_host: int = 1
+    model_parallel_size: int = 1          # tp·pp·sp·ep product
+    prefer_larger_batch: bool = True
+    version: float = 0.2
+
+
+def _hcn_scale(base: int, cap: int) -> int:
+    """base × (largest HCN keeping the product ≤ cap)."""
+    if base >= cap:
+        return base
+    limit = cap // base
+    best = 1
+    for h in _HCN:
+        if h > limit:
+            break
+        best = h
+    return best * base
+
+
+def candidate_batch_sizes(bases: Sequence[int], cap: int) -> List[int]:
+    return sorted(set(_hcn_scale(b, cap) for b in bases))
+
+
+def valid_chip_counts(batch_size: int, micro_batches: Sequence[int],
+                      lo: int, hi: int) -> List[int]:
+    """All chip counts in [lo, hi] where batch_size = micro × gas × chips has
+    an integer solution for some configured micro batch."""
+    out = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        max_chips = batch_size // mb
+        for d in range(1, int(math.isqrt(max_chips)) + 1):
+            if max_chips % d == 0:
+                for c in (d, max_chips // d):
+                    if lo <= c <= hi:
+                        out.add(c)
+    return sorted(out)
+
+
+def _best_candidate(cands: Sequence[int], micro_batches: Sequence[int],
+                    lo: int, hi: int, prefer_larger: bool,
+                    ) -> Tuple[int, List[int]]:
+    best_bs, best_valid = min(micro_batches), []
+    for bs in cands:
+        valid = valid_chip_counts(bs, micro_batches, lo, hi)
+        better = (len(valid) > len(best_valid)
+                  or (len(valid) == len(best_valid)
+                      and ((prefer_larger and bs > best_bs)
+                           or (not prefer_larger and bs < best_bs))))
+        if better:
+            best_bs, best_valid = bs, valid
+    return best_bs, best_valid
+
+
+def compute_elastic_config(cfg: ElasticityConfig,
+                           current_chips: Optional[int] = None,
+                           ) -> Tuple[int, List[int], Optional[int]]:
+    """→ (global_batch_size, valid data-parallel chip counts, micro_batch for
+    ``current_chips``).  reference compute_elastic_config (elasticity.py:233)
+    + v0.2 host-granular solve (:129)."""
+    mbs = sorted(set(int(m) for m in cfg.micro_batch_sizes))
+    if not mbs or any(m <= 0 for m in mbs):
+        raise ElasticityError(f"bad micro_batch_sizes {cfg.micro_batch_sizes}")
+    if cfg.chips_per_host % cfg.model_parallel_size:
+        raise ElasticityError(
+            f"chips_per_host {cfg.chips_per_host} must be divisible by "
+            f"model_parallel_size {cfg.model_parallel_size} (v0.2 solves at "
+            f"host granularity)")
+    if cfg.max_chips < cfg.chips_per_host:
+        raise ElasticityError(
+            f"max_chips {cfg.max_chips} < chips_per_host "
+            f"{cfg.chips_per_host}: not even one whole host fits the cap")
+
+    dp_per_host = cfg.chips_per_host // cfg.model_parallel_size
+    # the per-host solver works against the cap DIVIDED by dp/host — a micro
+    # batch over that cap would scale back up past max_train_batch_size
+    if any(m > cfg.max_train_batch_size // dp_per_host for m in mbs):
+        raise ElasticityError(
+            f"every micro batch must be ≤ max_train_batch_size/"
+            f"(dp per host) = {cfg.max_train_batch_size // dp_per_host}")
+    bases = mbs + [math.lcm(*mbs)]
+    cands = candidate_batch_sizes(
+        bases, cfg.max_train_batch_size // dp_per_host)
+    bs, valid_hosts = _best_candidate(
+        cands, mbs,
+        max(1, cfg.min_chips // cfg.chips_per_host),
+        max(1, cfg.max_chips // cfg.chips_per_host),
+        cfg.prefer_larger_batch)
+    batch = bs * dp_per_host
+    valid_dp = [h * dp_per_host for h in valid_hosts]
+
+    micro = None
+    if current_chips:
+        current_dp = current_chips // cfg.model_parallel_size
+        if current_dp not in valid_dp:
+            # current size incompatible: rescale around it (reference
+            # elasticity.py:172 fallback)
+            per_mb = [(cfg.max_train_batch_size // (m * current_dp))
+                      * m * current_dp
+                      for m in mbs if m * current_dp
+                      <= cfg.max_train_batch_size]
+            if not per_mb:
+                raise ElasticityError(
+                    f"no micro batch fits {current_chips} chips under "
+                    f"max_train_batch_size")
+            batch = (max(per_mb) if cfg.prefer_larger_batch else min(per_mb))
+            valid_dp = [current_dp]
+        for m in mbs:
+            if (batch // current_dp) % m == 0:
+                if micro is None or (cfg.prefer_larger_batch and m > micro):
+                    micro = m
+    return batch, valid_dp, micro

@@ -541,8 +541,14 @@ class DeepSpeedTPUEngine:
     def _shard_batch(self, batch, leading_gas: bool = False):
         """Place a host batch onto the mesh: batch dim over (dp, fsdp); the
         sequence dim (dim 1 of each microbatch) over sp when Ulysses sequence
-        parallelism is active."""
+        parallelism is active.
+
+        Multi-process: each host passes its PROCESS-LOCAL rows and the global
+        batch is assembled via jax.make_array_from_process_local_data —
+        no host ever holds (or ships) the whole global batch (reference: each
+        rank's dataloader feeds its own local microbatches)."""
         sp = "sp" if self.mesh.shape["sp"] > 1 else None
+        multiproc = jax.process_count() > 1
 
         def put(x):
             x = np.asarray(x)
@@ -552,7 +558,10 @@ class DeepSpeedTPUEngine:
                 dims[1] = sp
             if leading_gas:
                 dims = [None] + dims
-            return jax.device_put(x, NamedSharding(self.mesh, P(*dims)))
+            sharding = NamedSharding(self.mesh, P(*dims))
+            if multiproc:
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
         return jax.tree_util.tree_map(put, batch)
 
     def _reshape_gas(self, batch):
@@ -575,16 +584,29 @@ class DeepSpeedTPUEngine:
         t0 = time.perf_counter()
         self.tput_timer.start()
         first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
-        if first_shape[0] != self.gas:
-            if first_shape[0] != self.config.train_batch_size:
-                raise ValueError(
-                    f"train_batch leading dim {first_shape[0]} is neither "
-                    f"gas={self.gas} nor train_batch_size="
-                    f"{self.config.train_batch_size}")
+        # multi-process: each host feeds its process-local slice of the global
+        # batch (train_batch_size / process_count rows)
+        local_bs = self.config.train_batch_size // jax.process_count()
+        micro_local = local_bs // self.gas
+        # disambiguate [gas, micro_local, ...] (pre-shaped) from the flat
+        # [local_bs, ...] form by the SECOND dim too — when gas == local_bs
+        # the leading dim alone cannot tell them apart
+        if (first_shape[0] == self.gas and len(first_shape) > 1
+                and first_shape[1] == micro_local):
+            pass                            # already [gas, micro_local, ...]
+        elif first_shape[0] == local_bs:
             batch = self._reshape_gas(batch)
+        else:
+            raise ValueError(
+                f"train_batch leading dims {first_shape[:2]} match neither "
+                f"[gas={self.gas}, micro_local={micro_local}, ...] nor the "
+                f"flat process-local batch [{local_bs}, ...] "
+                f"(train_batch_size={self.config.train_batch_size} / "
+                f"{jax.process_count()} processes)")
         lead_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
-        # [gas, micro_global, T, ...] → tokens per optimizer step
-        tokens = (int(np.prod(lead_shape[:3])) if len(lead_shape) >= 3 else 0)
+        # [gas, micro_local, T, ...] → tokens per optimizer step (global)
+        tokens = (int(np.prod(lead_shape[:3])) * jax.process_count()
+                  if len(lead_shape) >= 3 else 0)
         self.timers(DATA_TIMER).start()
         batch = self._shard_batch(batch, leading_gas=True)
         self.timers(DATA_TIMER).stop()

@@ -1,0 +1,4 @@
+"""Launcher — multi-host process orchestration (``python -m
+deepspeed_tpu.launcher``, the ``deepspeed``/``dstpu`` CLI analog)."""
+
+from deepspeed_tpu.launcher.runner import main, parse_hostfile  # noqa: F401

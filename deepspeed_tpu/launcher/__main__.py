@@ -1,0 +1,4 @@
+from deepspeed_tpu.launcher.runner import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

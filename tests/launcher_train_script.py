@@ -1,0 +1,58 @@
+"""Worker script for the launcher test: trains the tiny GPT over a
+2-process × 4-virtual-device CPU fleet (reference pattern: the
+tests/unit/common.py DistributedTest worker body).
+
+Launched by ``python -m deepspeed_tpu.launcher --sim_hosts 2`` — rendezvous
+env comes from the launcher; each process feeds its process-LOCAL batch rows
+(engine._shard_batch assembles the global array)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models import GPT, GPTConfig  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    deepspeed_tpu.comm.init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+    config = {
+        "train_batch_size": 16,          # 8 local rows per process
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    rng = np.random.default_rng(0)      # same pool on both hosts...
+    pool = rng.integers(0, 128, size=(16, 32)).astype(np.int32)
+    local = pool[rank * 8:(rank + 1) * 8]   # ...each host feeds ITS slice
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(cfg), config=config,
+        example_batch={"input_ids": local})
+
+    losses = [float(engine.train_batch({"input_ids": local}).loss)
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    # checkpointing is COLLECTIVE under multi-process (orbax barriers +
+    # per-process shard writes): every rank calls save/load
+    tag = engine.save_checkpoint(os.path.join(out_dir, "ckpt"))
+    engine.load_checkpoint(os.path.join(out_dir, "ckpt"), tag)
+    with open(os.path.join(out_dir, f"rank{rank}.ok"), "w") as f:
+        f.write(f"{losses[0]} {losses[-1]}")
+
+
+if __name__ == "__main__":
+    main()

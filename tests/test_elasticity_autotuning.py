@@ -1,0 +1,92 @@
+"""Elasticity solver + autotuner tests (reference pattern:
+tests/unit/elasticity/test_elastic.py, tests/unit/autotuning/test_autotuning.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.elasticity import (ElasticityConfig, ElasticityError,
+                                      candidate_batch_sizes,
+                                      compute_elastic_config,
+                                      valid_chip_counts)
+from deepspeed_tpu.models import GPT, GPTConfig
+
+
+class TestSolver:
+    def test_reference_example(self):
+        """The reference docstring example (elasticity.py:243): micro [2,4,6],
+        max batch 2000 — the known v0.1 answer is batch 1680 with 23 valid
+        counts in [1, 10000]."""
+        cfg = ElasticityConfig(micro_batch_sizes=[2, 4, 6],
+                               max_train_batch_size=2000,
+                               min_chips=1, max_chips=10000)
+        batch, valid, _ = compute_elastic_config(cfg)
+        assert batch == 1680
+        assert valid[0] == 1 and valid[-1] <= 10000
+        # every valid count admits an integer micro×gas decomposition
+        for c in valid:
+            assert any(batch % (m * c) == 0 for m in [2, 4, 6])
+
+    def test_valid_counts_are_exact(self):
+        got = valid_chip_counts(24, [2, 3], 1, 100)
+        # 24/2=12 and 24/3=8 and all their divisors
+        assert got == sorted({1, 2, 3, 4, 6, 8, 12})
+
+    def test_candidate_scaling_uses_hcn(self):
+        # base 2, cap 100 -> 2*48=96 (48 is the largest HCN <= 50)
+        assert 96 in candidate_batch_sizes([2], 100)
+
+    def test_current_chips_micro_batch(self):
+        cfg = ElasticityConfig(micro_batch_sizes=[2, 4],
+                               max_train_batch_size=256)
+        batch, valid, micro = compute_elastic_config(cfg, current_chips=8)
+        assert 8 in valid
+        assert micro in (2, 4)
+        assert batch % (micro * 8) == 0
+
+    def test_incompatible_current_rescales(self):
+        cfg = ElasticityConfig(micro_batch_sizes=[2],
+                               max_train_batch_size=97)
+        batch, valid, micro = compute_elastic_config(cfg, current_chips=7)
+        assert valid == [7]
+        assert batch % (2 * 7) == 0 and batch <= 97
+
+    def test_host_granularity(self):
+        """v0.2: chips_per_host=4, tp=2 → dp/host=2; valid counts are
+        host-multiples of 2."""
+        cfg = ElasticityConfig(micro_batch_sizes=[2, 4],
+                               max_train_batch_size=512,
+                               chips_per_host=4, model_parallel_size=2)
+        batch, valid, _ = compute_elastic_config(cfg)
+        assert all(v % 2 == 0 for v in valid)
+
+    def test_errors(self):
+        with pytest.raises(ElasticityError, match="divisible"):
+            compute_elastic_config(ElasticityConfig(
+                chips_per_host=3, model_parallel_size=2))
+        with pytest.raises(ElasticityError, match="max_train_batch_size"):
+            compute_elastic_config(ElasticityConfig(
+                micro_batch_sizes=[64], max_train_batch_size=32))
+
+
+class TestAutotuner:
+    def test_micro_batch_search(self):
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        rng = np.random.default_rng(0)
+
+        def factory(mbs):
+            return {"input_ids": rng.integers(0, 128, (mbs, 32))
+                    .astype(np.int32)}
+
+        tuner = Autotuner(GPT(cfg), {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "mesh": {"dp": 1},
+        }, factory, probe_steps=2)
+        best = tuner.tune_micro_batch_size(start=1, max_mbs=8)
+        assert best in (1, 2, 4, 8)
+        probed = [r.micro_batch for r in tuner.results]
+        assert probed == [1, 2, 4, 8]       # full doubling ladder, no OOM
+        assert all(r.ok for r in tuner.results)
+        # fastest measured throughput wins
+        fastest = max(tuner.results, key=lambda r: r.tokens_per_s)
+        assert best == fastest.micro_batch
