@@ -126,6 +126,60 @@ class MeshConfig(DeepSpeedConfigModel):
     tp: int = 1
 
 
+class CurriculumLearningConfig(DeepSpeedConfigModel):
+    """reference: runtime/data_pipeline/config.py get_curriculum_learning."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: dict = Field(default_factory=dict)
+
+
+class RandomLTDConfig(DeepSpeedConfigModel):
+    """reference: runtime/data_pipeline/config.py get_data_routing
+    (random_ltd block)."""
+
+    enabled: bool = False
+    random_ltd_layer_ids: list = Field(default_factory=list)
+    min_value: int = 128
+    max_value: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: dict = Field(default_factory=dict)
+
+
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """reference: inference/config.py DeepSpeedHybridEngineConfig (consumed by
+    runtime/hybrid_engine.py via deepspeed.initialize)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+class DataSamplingConfig(DeepSpeedConfigModel):
+    curriculum_learning: CurriculumLearningConfig = Field(
+        default_factory=CurriculumLearningConfig)
+
+
+class DataRoutingConfig(DeepSpeedConfigModel):
+    random_ltd: RandomLTDConfig = Field(default_factory=RandomLTDConfig)
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    """reference: runtime/data_pipeline/config.py get_data_efficiency_config."""
+
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: DataSamplingConfig = Field(
+        default_factory=DataSamplingConfig)
+    data_routing: DataRoutingConfig = Field(default_factory=DataRoutingConfig)
+
+
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     """reference: "activation_checkpointing" block
     (runtime/activation_checkpointing/checkpointing.py:1073 configure)."""
@@ -203,6 +257,10 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    data_efficiency: DataEfficiencyConfig = Field(
+        default_factory=DataEfficiencyConfig)
+    hybrid_engine: HybridEngineConfig = Field(
+        default_factory=HybridEngineConfig)
     gradient_compression: GradientCompressionConfig = Field(
         default_factory=GradientCompressionConfig)
 

@@ -1,0 +1,9 @@
+"""Data-efficiency pipeline — curriculum learning, curriculum-aware sampling,
+random layerwise token dropping (reference deepspeed/runtime/data_pipeline/)."""
+
+from deepspeed_tpu.data_pipeline.curriculum import (  # noqa: F401
+    CurriculumScheduler)
+from deepspeed_tpu.data_pipeline.sampler import (  # noqa: F401
+    CurriculumDataSampler, truncate_to_difficulty)
+from deepspeed_tpu.data_pipeline.random_ltd import (  # noqa: F401
+    RandomLTDScheduler, random_ltd_block_indices)

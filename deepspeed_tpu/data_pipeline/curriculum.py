@@ -1,0 +1,125 @@
+"""Curriculum scheduler — difficulty as a pure function of the step clock.
+
+Reference parity: ``runtime/data_pipeline/curriculum_scheduler.py``
+(CurriculumScheduler :16; fixed_root math :130, fixed_linear = root of
+degree 1 :147, fixed_discrete :122).  Same schedule semantics; state is a
+plain dict so it rides the engine checkpoint like any client state.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+
+class CurriculumScheduler:
+    """schedule_config (same keys as the reference ds_config block):
+
+    {"curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 1024,
+     "schedule_type": "fixed_linear" | "fixed_root" | "fixed_discrete"
+                      | "custom",
+     "schedule_config": {
+        fixed_linear: {"total_curriculum_step": N, "difficulty_step": k}
+        fixed_root:   {... + "root_degree": d}
+        fixed_discrete: {"difficulty": [...], "max_step": [...]}}}
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        sc = dict(config.get("schedule_config", {}))
+        self.schedule_config = sc
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in sc:
+                    raise ValueError(
+                        f"{self.schedule_type} schedule requires "
+                        f"schedule_config[{key!r}]")
+            if self.schedule_type == "fixed_root" and "root_degree" not in sc:
+                raise ValueError(
+                    "fixed_root schedule requires schedule_config"
+                    "['root_degree']")
+            if self.curriculum_type == "seqlen" \
+                    and sc["difficulty_step"] % 8:
+                # reference warns for tensor-core alignment; on TPU the lane
+                # constraint is the same story (multiples of 8/128)
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(
+                    "seqlen curriculum difficulty_step should be a multiple "
+                    "of 8 for efficient TPU tiling")
+        elif self.schedule_type == "fixed_discrete":
+            for key in ("difficulty", "max_step"):
+                if key not in sc:
+                    raise ValueError(
+                        f"fixed_discrete schedule requires "
+                        f"schedule_config[{key!r}]")
+            if len(sc["difficulty"]) != len(sc["max_step"]) + 1 and \
+                    len(sc["difficulty"]) != len(sc["max_step"]):
+                raise ValueError(
+                    "fixed_discrete: len(difficulty) must equal "
+                    "len(max_step) (or max_step may omit the final plateau)")
+        elif self.schedule_type == "custom":
+            pass
+        else:
+            raise ValueError(
+                f"unsupported schedule_type {self.schedule_type!r}")
+
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+
+    # ---- reference get_difficulty / update_difficulty ----
+    def _fixed_root(self, step: int, degree: float) -> int:
+        sc = self.schedule_config
+        frac = (float(step) / sc["total_curriculum_step"]) ** (1.0 / degree)
+        diff = math.floor(
+            frac * (self.max_difficulty - self.min_difficulty)
+            + self.min_difficulty)
+        diff -= diff % sc["difficulty_step"]
+        # clamp BOTH ends: the step-rounding can land below min_difficulty
+        # (even 0) when min is not a difficulty_step multiple
+        return max(min(diff, self.max_difficulty), self.min_difficulty)
+
+    def _fixed_discrete(self, step: int) -> int:
+        sc = self.schedule_config
+        diffs: List[int] = sc["difficulty"]
+        steps: List[int] = sc["max_step"]
+        if step > steps[-1]:
+            return diffs[-1]
+        for i, s in enumerate(steps):
+            if step <= s:
+                return diffs[i]
+        return diffs[-1]
+
+    def get_difficulty(self, step: int) -> int:
+        if self.schedule_type == "fixed_linear":
+            return self._fixed_root(step, 1.0)
+        if self.schedule_type == "fixed_root":
+            return self._fixed_root(
+                step, self.schedule_config["root_degree"])
+        if self.schedule_type == "fixed_discrete":
+            return self._fixed_discrete(step)
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom schedule: call "
+                               "set_custom_get_difficulty first")
+        return self.custom_get_difficulty(step)
+
+    def update_difficulty(self, step: int) -> int:
+        if self.current_difficulty < self.max_difficulty:
+            self.current_difficulty = self.get_difficulty(step)
+        return self.current_difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    # ---- checkpointable state (reference get_state/set_state) ----
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty,
+                "first_step": self.first_step}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = state["current_difficulty"]
+        self.first_step = state["first_step"]

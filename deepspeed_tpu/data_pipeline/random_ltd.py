@@ -1,0 +1,72 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference parity: ``runtime/data_pipeline/data_routing/basic_layer.py``
+(RandomLayerTokenDrop), ``scheduler.py`` (BaseScheduler — kept-seqlen grows
+fixed_linear over steps), ``utils.py`` (index sampling).  Paper: "Random-LTD:
+Random and Layerwise Token Dropping" (PAPERS.md).
+
+TPU-native shape discipline: the kept-token count must be STATIC under jit,
+so the host samples the keep indices per step ([n_ltd_layers, B, keep] int32,
+sorted) and ships them IN THE BATCH — a new keep bucket changes the array
+shape, which re-keys jit automatically; ``seq_per_step`` bounds the number of
+distinct programs.  Sorted indices keep index-order causality == position
+causality, so the subset attention needs no custom mask.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+class RandomLTDScheduler:
+    """Kept-seqlen schedule (reference data_routing/scheduler.py
+    BaseScheduler.__fixed_linear_get_value): keep grows from min_value to
+    max_value by seq_per_step every require_steps optimizer steps."""
+
+    def __init__(self, config: Dict):
+        self.min_value = int(config["min_value"])
+        self.max_value = int(config["max_value"])
+        sc = config.get("schedule_config", {})
+        self.require_steps = int(sc.get("require_steps", 1))
+        self.seq_per_step = int(sc.get("seq_per_step", 8))
+        if config.get("schedule_type", "fixed_linear") != "fixed_linear":
+            raise ValueError("random-LTD supports fixed_linear schedules")
+
+    def get_value(self, step: int) -> int:
+        grown = self.min_value + (step // self.require_steps) \
+            * self.seq_per_step
+        return min(self.max_value, grown)
+
+
+def random_ltd_block_indices(step: int, keep: int, batch: int, seq_len: int,
+                             n_layers: int, seed: int = 0) -> np.ndarray:
+    """Sample SORTED keep indices [n_layers, batch, keep] — independent per
+    ltd layer and per row (reference utils.py gather indices)."""
+    if keep > seq_len:
+        keep = seq_len
+    rng = np.random.default_rng((seed * 1_000_003 + step) & 0x7FFFFFFF)
+    out = np.empty((n_layers, batch, keep), np.int32)
+    for l in range(n_layers):
+        for b in range(batch):
+            out[l, b] = np.sort(rng.choice(seq_len, keep, replace=False))
+    return out
+
+
+def apply_random_ltd(block_apply, x, positions, idx):
+    """Run one transformer block on the kept-token subset and scatter the
+    result back; dropped tokens bypass the layer (identity skip — reference
+    basic_layer.py forward).
+
+    block_apply(x_kept, pos_kept) -> (out_kept, aux)
+    x: [B, T, H]; positions: [B, T]; idx: [B, keep] sorted int32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x_k = jnp.take_along_axis(x, idx[..., None], axis=1)
+    pos_k = jnp.take_along_axis(positions, idx, axis=1)
+    out_k, aux = block_apply(x_k, pos_k)
+    x = jax.vmap(lambda xb, ib, ob: xb.at[ib].set(ob))(x, idx, out_k)
+    return x, aux

@@ -1,0 +1,104 @@
+"""Curriculum-aware data sampling + seqlen truncation.
+
+Reference parity: ``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(DeepSpeedDataSampler — difficulty-clustered index selection driven by the
+curriculum clock) and the seqlen post-process
+(``curriculum via truncate``, legacy curriculum in megatron helpers).
+
+TPU notes: samples must keep STATIC shapes inside jit, so seqlen curriculum
+is realized by ``truncate_to_difficulty`` on the HOST batch (bucketed to
+``difficulty_step`` so the engine compiles one program per bucket, a bounded
+set) — the analog of the reference truncating on the GPU before the fwd.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class CurriculumDataSampler:
+    """Deterministic curriculum sampler over a difficulty-annotated dataset.
+
+    difficulties[i] = difficulty of sample i (e.g. its sequence length).
+    Each epoch reshuffles (seed+epoch); at each batch request only samples
+    with difficulty ≤ the scheduler's current difficulty are eligible
+    (reference data_sampler.py:188 get_next_global_batch: the curriculum
+    filters the difficulty-sorted global index).
+    """
+
+    def __init__(self, difficulties: Sequence[int], batch_size: int,
+                 scheduler, seed: int = 0,
+                 drop_last: bool = True):
+        self.difficulties = np.asarray(difficulties)
+        order = np.argsort(self.difficulties, kind="stable")
+        self.sorted_idx = order                       # easy → hard
+        self.sorted_diff = self.difficulties[order]
+        self.batch_size = int(batch_size)
+        self.scheduler = scheduler
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.step = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """One epoch: every sample is drawn exactly once WHEN it becomes
+        eligible (reference data_sampler consumes difficulty clusters as the
+        curriculum unlocks them); while the curriculum is still ramping with
+        the easy pool exhausted, easy samples recycle rather than stalling."""
+        rng = np.random.default_rng(self.seed + self.epoch)
+        n = len(self.difficulties)
+        consumed = np.zeros(n, bool)
+        sd = self.sorted_diff.tolist()
+        while not consumed.all():
+            diff = self.scheduler.update_difficulty(self.step)
+            n_eligible = bisect.bisect_right(sd, diff)
+            if n_eligible == 0:
+                raise ValueError(
+                    f"no samples with difficulty ≤ {diff}; lower "
+                    f"min_difficulty or re-bin the dataset")
+            elig = self.sorted_idx[:n_eligible]
+            avail = elig[~consumed[elig]]
+            if avail.size == 0:
+                if diff >= getattr(self.scheduler, "max_difficulty", diff):
+                    break   # remaining samples exceed max_difficulty forever
+                avail = elig          # recycle easy pool while ramping
+            pick = rng.choice(avail, size=min(self.batch_size, avail.size),
+                              replace=False)
+            consumed[pick] = True
+            if pick.size < self.batch_size:
+                if self.drop_last and consumed.all():
+                    break                     # drop the incomplete final batch
+                # mid-ramp short batch: pad by recycling eligible samples,
+                # without in-batch duplicates when the pool allows
+                pool = np.setdiff1d(elig, pick)
+                need = self.batch_size - pick.size
+                if pool.size >= need:
+                    pad = rng.choice(pool, need, replace=False)
+                else:
+                    pad = rng.choice(elig, need)
+                pick = np.concatenate([pick, pad])
+            self.step += 1
+            yield np.asarray(pick, np.int64)
+
+
+def truncate_to_difficulty(batch, difficulty: int,
+                           difficulty_step: int = 1,
+                           seq_keys: Sequence[str] = ("input_ids", "labels",
+                                                      "loss_mask")):
+    """Truncate sequence-shaped leaves to the curriculum seqlen, rounded UP to
+    a difficulty_step multiple so the jit program count stays bounded
+    (reference: seqlen curriculum truncates the batch before forward)."""
+    eff = -(-difficulty // difficulty_step) * difficulty_step
+
+    def cut(k, x):
+        x = np.asarray(x)
+        if k in seq_keys and x.ndim >= 2 and x.shape[-1] > eff:
+            return x[..., :eff]
+        return x
+    return {k: cut(k, v) for k, v in batch.items()}

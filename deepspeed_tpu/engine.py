@@ -126,6 +126,24 @@ class DeepSpeedTPUEngine:
         if (hasattr(model, "clone") and hasattr(model, "mesh")
                 and model.mesh is None):
             model = model.clone(mesh=self.mesh)
+        # random-LTD: push the configured layer ids into the model config so
+        # ds_config is the single source of truth (reference: the data_routing
+        # block rewires layers at initialize() time)
+        rl_cfg = config.data_efficiency.data_routing.random_ltd
+        if (config.data_efficiency.enabled and rl_cfg.enabled
+                and hasattr(model, "clone") and hasattr(model, "cfg")
+                and hasattr(model.cfg, "random_ltd_layer_ids")):
+            cfg_ids = tuple(rl_cfg.random_ltd_layer_ids)
+            model_ids = tuple(model.cfg.random_ltd_layer_ids)
+            if not model_ids:
+                import dataclasses as _dc
+                model = model.clone(cfg=_dc.replace(
+                    model.cfg, random_ltd_layer_ids=cfg_ids))
+            elif model_ids != cfg_ids:
+                raise ValueError(
+                    f"random_ltd_layer_ids mismatch: model cfg has "
+                    f"{model_ids}, ds_config says {cfg_ids} — set them in "
+                    f"ONE place")
         # pipeline models consume all gas microbatches in one pipelined scan
         # (reference: PipelineEngine.train_batch owns the microbatch loop)
         self.gas_in_model = bool(getattr(model, "is_pipeline", False))
@@ -286,6 +304,35 @@ class DeepSpeedTPUEngine:
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(warmup_steps=1)
         self.wall_clock_breakdown = bool(config.wall_clock_breakdown)
+
+        # ---- data-efficiency pipeline (reference runtime/data_pipeline/) ----
+        self.curriculum_scheduler = None
+        self.random_ltd_scheduler = None
+        de = config.data_efficiency
+        if de.enabled and de.data_sampling.curriculum_learning.enabled:
+            from deepspeed_tpu.data_pipeline import CurriculumScheduler
+            cl = de.data_sampling.curriculum_learning
+            if cl.curriculum_type != "seqlen":
+                raise NotImplementedError(
+                    "engine-integrated curriculum supports the seqlen metric; "
+                    "other metrics go through data_pipeline."
+                    "CurriculumDataSampler on the dataloader side")
+            self.curriculum_scheduler = CurriculumScheduler(
+                cl.model_dump(exclude={"enabled"}))
+        if de.enabled and de.data_routing.random_ltd.enabled:
+            from deepspeed_tpu.data_pipeline import RandomLTDScheduler
+            rl = de.data_routing.random_ltd
+            if self.gas_in_model:
+                raise NotImplementedError(
+                    "random-LTD inside the pipeline engine is unsupported")
+            if not rl.random_ltd_layer_ids:
+                raise ValueError("random_ltd.random_ltd_layer_ids is empty")
+            if self.mesh.shape["sp"] > 1:
+                raise NotImplementedError("random-LTD with Ulysses sequence "
+                                          "parallelism is unsupported")
+            self.random_ltd_scheduler = RandomLTDScheduler(rl.model_dump())
+            self._ltd_layer_ids = tuple(rl.random_ltd_layer_ids)
+            self._de_seed = de.seed
         self._flops_profiled = False
         self._last_batch = None
         if config.dump_state:
@@ -538,6 +585,47 @@ class DeepSpeedTPUEngine:
 
     # ------------------------------------------------------------------ data
 
+    def _apply_data_efficiency(self, batch):
+        """Host-side curriculum seqlen truncation + random-LTD keep-index
+        injection on the FLAT batch (reference: data_pipeline hooks in
+        deepspeed.initialize / DataEfficiency tutorial).  Shape changes re-key
+        jit per difficulty/keep bucket — difficulty_step / seq_per_step bound
+        the program count."""
+        if self.curriculum_scheduler is None \
+                and self.random_ltd_scheduler is None:
+            return batch
+        if not isinstance(batch, dict):
+            return batch
+        batch = dict(batch)
+        # normalize the pre-shaped [gas, micro_local, ...] form to flat rows —
+        # ltd index shapes and truncation work on [rows, T]; train_batch's
+        # shape check reshapes back afterwards
+        ids0 = np.asarray(batch["input_ids"])
+        local_bs = self.config.train_batch_size // jax.process_count()
+        if (ids0.ndim >= 3 and ids0.shape[0] == self.gas
+                and ids0.shape[1] == local_bs // self.gas):
+            batch = {k: np.asarray(v).reshape(
+                (-1,) + np.asarray(v).shape[2:]) for k, v in batch.items()}
+        step = self.global_steps
+        if self.curriculum_scheduler is not None:
+            from deepspeed_tpu.data_pipeline import truncate_to_difficulty
+            diff = self.curriculum_scheduler.update_difficulty(step)
+            dstep = self.curriculum_scheduler.schedule_config.get(
+                "difficulty_step", 1)
+            batch = truncate_to_difficulty(batch, diff, dstep)
+        if self.random_ltd_scheduler is not None:
+            from deepspeed_tpu.data_pipeline import random_ltd_block_indices
+            ids = np.asarray(batch["input_ids"])
+            rows, T = ids.shape[0], ids.shape[-1]
+            keep = self.random_ltd_scheduler.get_value(step)
+            # decorrelate drop patterns across hosts: each process samples
+            # for its own local rows
+            idx = random_ltd_block_indices(
+                step, keep, rows, T, len(self._ltd_layer_ids),
+                seed=self._de_seed + 31337 * jax.process_index())
+            batch["random_ltd_idx"] = np.moveaxis(idx, 0, 1)
+        return batch
+
     def _shard_batch(self, batch, leading_gas: bool = False):
         """Place a host batch onto the mesh: batch dim over (dp, fsdp); the
         sequence dim (dim 1 of each microbatch) over sp when Ulysses sequence
@@ -583,6 +671,7 @@ class DeepSpeedTPUEngine:
         """
         t0 = time.perf_counter()
         self.tput_timer.start()
+        batch = self._apply_data_efficiency(batch)
         first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
         # multi-process: each host feeds its process-local slice of the global
         # batch (train_batch_size / process_count rows)
@@ -642,6 +731,7 @@ class DeepSpeedTPUEngine:
             raise RuntimeError(
                 "pipeline models only support train_batch(), not the "
                 "forward/backward/step trio")
+        batch = self._apply_data_efficiency(batch)
         batch = self._shard_batch(batch)
         with self.mesh:
             grads, loss = self._jit_grad(self.state, batch,
@@ -688,6 +778,22 @@ class DeepSpeedTPUEngine:
         self._last_metrics = metrics
         self._post_step_reporting(metrics)
         return metrics
+
+    def hybrid_engine(self, inference_config=None):
+        """Train↔generate bridge for RLHF (runtime/hybrid_engine.py;
+        reference DeepSpeedHybridEngine).  Built lazily, cached — enable via
+        the ``hybrid_engine`` config block or call directly."""
+        if getattr(self, "_hybrid", None) is None:
+            from deepspeed_tpu.runtime.hybrid_engine import HybridEngine
+            self._hybrid = HybridEngine(self, inference_config)
+            self._hybrid_cfg = inference_config
+        elif (inference_config is not None
+              and inference_config != self._hybrid_cfg):
+            raise ValueError(
+                "hybrid_engine() was already built with a different "
+                "inference_config; build a HybridEngine directly for a "
+                "second configuration")
+        return self._hybrid
 
     # ------------------------------------------------------------------ info
 

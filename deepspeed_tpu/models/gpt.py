@@ -72,6 +72,9 @@ class GPTConfig:
     qkv_bias: bool = False
     attn_out_bias: bool = False
     mlp_bias: bool = False
+    # random-LTD (data_pipeline/random_ltd.py): layers that run on a kept
+    # token subset when the batch carries "random_ltd_idx"
+    random_ltd_layer_ids: tuple = ()
 
     @property
     def kv_heads(self) -> int:
@@ -369,11 +372,15 @@ class GPTBackbone(nn.Module):
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
                  positions=None, use_cache: bool = False, kv_mask=None,
-                 start_index=0, kv_positions=None):
+                 start_index=0, kv_positions=None, ltd_idx=None):
         """positions: [B, T] absolute positions (default arange — the training
         path); the inference engine passes per-row positions for left-padded
         prompts and incremental decode.  kv_mask: [B, max_seq_len] validity of
-        cache slots.  start_index: scalar cache write offset."""
+        cache slots.  start_index: scalar cache write offset.  ltd_idx:
+        [n_ltd_layers, B, keep] sorted random-LTD keep indices (data_pipeline/
+        random_ltd.py) — layers in cfg.random_ltd_layer_ids run on the kept
+        subset only, dropped tokens skip them (reference data_routing/
+        basic_layer.py)."""
         c = self.cfg
         B, T = input_ids.shape
         emb = self.param("wte", _part(_kernel_init(), ("vocab", "embed")),
@@ -395,14 +402,23 @@ class GPTBackbone(nn.Module):
         if c.remat and not use_cache:
             block_cls = nn.remat(Block, static_argnums=(3, 4),
                                  policy=jax.checkpoint_policies.nothing_saveable)
+        ltd_layers = tuple(c.random_ltd_layer_ids or ())
         aux_total = jnp.float32(0.0)
         for i in range(c.num_layers):
             # reference examples put MoE on every other layer
             is_moe = (c.num_experts > 0 and i % c.moe_every == c.moe_every - 1)
-            x, aux = block_cls(c, is_moe, self.mesh,
-                               name=f"block_{i}")(x, positions, deterministic,
-                                                  use_cache, kv_mask,
-                                                  start_index, kv_positions)
+            block = block_cls(c, is_moe, self.mesh, name=f"block_{i}")
+            if (ltd_idx is not None and i in ltd_layers and not use_cache):
+                from deepspeed_tpu.data_pipeline.random_ltd import \
+                    apply_random_ltd
+                idx = ltd_idx[ltd_layers.index(i)]
+                x, aux = apply_random_ltd(
+                    lambda xk, pk: block(xk, pk, deterministic, False,
+                                         None, 0, None),
+                    x, positions, idx)
+            else:
+                x, aux = block(x, positions, deterministic,
+                               use_cache, kv_mask, start_index, kv_positions)
             aux_total = aux_total + aux
         x = Norm(c, name="final_norm")(x)
         return x, emb, aux_total
@@ -441,8 +457,13 @@ class GPT(nn.Module):
     def __call__(self, batch, deterministic: bool = False):
         c = self.cfg
         input_ids = batch["input_ids"]
+        ltd = batch.get("random_ltd_idx")       # [B, n_ltd, keep] host layout
+        if ltd is not None:
+            ltd = jnp.moveaxis(jnp.asarray(ltd), 1, 0)   # → [n_ltd, B, keep]
         x, emb, moe_aux = GPTBackbone(c, self.mesh,
-                                      name="backbone")(input_ids, deterministic)
+                                      name="backbone")(input_ids,
+                                                       deterministic,
+                                                       ltd_idx=ltd)
         if c.tie_embeddings:
             unembed = emb.astype(x.dtype).T                # [H, V]
         else:
