@@ -1,0 +1,6 @@
+"""``python -m deepspeed_tpu`` → environment report (the ds_report CLI)."""
+
+from deepspeed_tpu.env_report import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -287,3 +287,136 @@ def is_hf_model_dir(path: Any) -> bool:
     return (isinstance(path, (str, os.PathLike))
             and os.path.isdir(path)
             and os.path.exists(os.path.join(path, "config.json")))
+
+
+# ----------------------------------------------------------- export direction
+def save_hf_checkpoint(cfg, params, model_path: str) -> None:
+    """Export a flax GPT tree as an HF model directory (config.json +
+    model.safetensors) — the cross-framework leg of universal checkpointing
+    (reference checkpoint/ds_to_universal.py exports framework-neutral
+    fragments; here the neutral format IS the HF layout, so the exported
+    model loads straight into ``transformers`` or back through
+    ``load_hf_checkpoint``).
+
+    Supports the llama family (rope+rmsnorm+SwiGLU) and gpt2 config points of
+    the GPT module — the same coverage as the import direction.
+    """
+    import torch
+    from safetensors.torch import save_file
+
+    params = dict(params)
+    if "params" in params:
+        params = params["params"]
+    bb = params["backbone"]
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+    os.makedirs(model_path, exist_ok=True)
+
+    def t(x):
+        arr = np.asarray(jax.device_get(x))
+        if arr.dtype.name == "bfloat16":
+            return torch.from_numpy(
+                arr.view(np.int16).copy()).view(torch.bfloat16)
+        return torch.from_numpy(np.ascontiguousarray(arr))
+
+    tensors: Dict[str, Any] = {}
+    if cfg.use_rope and cfg.use_rmsnorm and cfg.gated_mlp:
+        arch = "Qwen2ForCausalLM" if cfg.qkv_bias else "LlamaForCausalLM"
+        hf_cfg = {
+            "architectures": [arch],
+            "model_type": "qwen2" if cfg.qkv_bias else "llama",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": H,
+            "intermediate_size": cfg.mlp_dim,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": nh,
+            "num_key_value_heads": nkv,
+            "head_dim": hd,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rms_norm_eps": cfg.norm_eps or 1e-6,
+            "rope_theta": cfg.rope_theta,
+            "tie_word_embeddings": bool(cfg.tie_embeddings),
+            "hidden_act": "silu",
+            "torch_dtype": "float32",
+        }
+        tensors["model.embed_tokens.weight"] = t(bb["wte"])
+        tensors["model.norm.weight"] = t(bb["final_norm"]["scale"])
+        for i in range(cfg.num_layers):
+            blk = bb[f"block_{i}"]
+            ap, mp = blk["Attention_0"], blk["MLP_0"]
+            p = f"model.layers.{i}."
+            tensors[p + "self_attn.q_proj.weight"] = t(
+                np.asarray(ap["wq"]).reshape(H, nh * hd).T)
+            tensors[p + "self_attn.k_proj.weight"] = t(
+                np.asarray(ap["wk"]).reshape(H, nkv * hd).T)
+            tensors[p + "self_attn.v_proj.weight"] = t(
+                np.asarray(ap["wv"]).reshape(H, nkv * hd).T)
+            tensors[p + "self_attn.o_proj.weight"] = t(
+                np.asarray(ap["wo"]).reshape(nh * hd, H).T)
+            if cfg.qkv_bias:
+                tensors[p + "self_attn.q_proj.bias"] = t(
+                    np.asarray(ap["bq"]).reshape(-1))
+                tensors[p + "self_attn.k_proj.bias"] = t(
+                    np.asarray(ap["bk"]).reshape(-1))
+                tensors[p + "self_attn.v_proj.bias"] = t(
+                    np.asarray(ap["bv"]).reshape(-1))
+            tensors[p + "input_layernorm.weight"] = t(blk["Norm_0"]["scale"])
+            tensors[p + "post_attention_layernorm.weight"] = t(
+                blk["Norm_1"]["scale"])
+            tensors[p + "mlp.up_proj.weight"] = t(np.asarray(mp["wi"]).T)
+            tensors[p + "mlp.gate_proj.weight"] = t(np.asarray(mp["wg"]).T)
+            tensors[p + "mlp.down_proj.weight"] = t(np.asarray(mp["wo"]).T)
+        if not cfg.tie_embeddings:
+            tensors["lm_head.weight"] = t(np.asarray(params["lm_head"]).T)
+    elif not cfg.use_rope and not cfg.use_rmsnorm and not cfg.gated_mlp:
+        if not cfg.tie_embeddings:
+            raise ValueError(
+                "GPT2LMHeadModel always ties wte/lm_head — an untied "
+                "gpt2-point model cannot round-trip through the gpt2 "
+                "architecture; train with tie_embeddings=True to export")
+        hf_cfg = {
+            "architectures": ["GPT2LMHeadModel"],
+            "model_type": "gpt2",
+            "vocab_size": cfg.vocab_size,
+            "n_embd": H, "n_layer": cfg.num_layers, "n_head": nh,
+            "n_positions": cfg.max_seq_len, "n_ctx": cfg.max_seq_len,
+            "n_inner": cfg.mlp_dim,
+            "layer_norm_epsilon": cfg.norm_eps or 1e-5,
+            "torch_dtype": "float32",
+        }
+        tensors["wte.weight"] = t(bb["wte"])
+        tensors["wpe.weight"] = t(bb["wpe"])
+        tensors["ln_f.weight"] = t(bb["final_norm"]["scale"])
+        tensors["ln_f.bias"] = t(bb["final_norm"]["bias"])
+        for i in range(cfg.num_layers):
+            blk = bb[f"block_{i}"]
+            ap, mp = blk["Attention_0"], blk["MLP_0"]
+            p = f"h.{i}."
+            ca = np.concatenate([np.asarray(ap[k]).reshape(H, -1)
+                                 for k in ("wq", "wk", "wv")], axis=1)
+            cb = np.concatenate([np.asarray(ap[k]).reshape(-1)
+                                 for k in ("bq", "bk", "bv")])
+            tensors[p + "attn.c_attn.weight"] = t(ca)        # Conv1D [in,out]
+            tensors[p + "attn.c_attn.bias"] = t(cb)
+            tensors[p + "attn.c_proj.weight"] = t(
+                np.asarray(ap["wo"]).reshape(nh * hd, H))
+            tensors[p + "attn.c_proj.bias"] = t(ap["bo"])
+            tensors[p + "ln_1.weight"] = t(blk["Norm_0"]["scale"])
+            tensors[p + "ln_1.bias"] = t(blk["Norm_0"]["bias"])
+            tensors[p + "ln_2.weight"] = t(blk["Norm_1"]["scale"])
+            tensors[p + "ln_2.bias"] = t(blk["Norm_1"]["bias"])
+            tensors[p + "mlp.c_fc.weight"] = t(mp["wi"])
+            tensors[p + "mlp.c_fc.bias"] = t(mp["bi"])
+            tensors[p + "mlp.c_proj.weight"] = t(mp["wo"])
+            tensors[p + "mlp.c_proj.bias"] = t(mp["bo"])
+    else:
+        raise ValueError(
+            "export supports llama-family (rope+rmsnorm+SwiGLU) and gpt2 "
+            "(learned-pos+LN+GELU) config points; got a mixed configuration")
+
+    with open(os.path.join(model_path, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+    save_file(tensors, os.path.join(model_path, "model.safetensors"))
+    log_dist(f"exported HF checkpoint → {model_path} "
+             f"({hf_cfg['architectures'][0]}, {len(tensors)} tensors)",
+             ranks=[0])

@@ -114,13 +114,17 @@ class CommsLogger:
             logger.info("comm op=%s axis=%s bytes=%d", name, axis, nbytes)
 
     def log_summary(self) -> List[str]:
-        """Summary lines: op, count, total bytes, (eager) algo bandwidth."""
+        """Summary lines: op, count, total bytes (+ algo bandwidth ONLY for
+        eager-timed ops — jitted collectives are scheduled/overlapped by XLA,
+        so a per-op wall-time is not observable and reporting 0.00GB/s for
+        them was noise; use `jax.profiler` traces for on-device timing)."""
         lines = []
         for name, rec in sorted(self.records.items()):
-            bw = (rec.total_bytes / rec.total_time_s / 1e9) if rec.total_time_s else 0.0
+            bw = (f" algo_bw={rec.total_bytes / rec.total_time_s / 1e9:.2f}"
+                  f"GB/s" if rec.total_time_s else "")
             lines.append(
                 f"{name:: <24} count={rec.count} bytes={rec.total_bytes} "
-                f"axes={sorted(rec.axes)} algo_bw={bw:.2f}GB/s")
+                f"axes={sorted(rec.axes)}{bw}")
         for line in lines:
             logger.info(line)
         return lines

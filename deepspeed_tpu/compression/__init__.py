@@ -1,0 +1,6 @@
+"""Compression library — staged quantization-aware training + layer reduction
+(reference deepspeed/compression/)."""
+
+from deepspeed_tpu.compression.basic import (  # noqa: F401
+    CompressionSpec, layer_reduction_init, parse_compression_config,
+    scheduled_weight_qdq)

@@ -1,0 +1,134 @@
+"""Compression core — scheduled weight QAT + layer reduction.
+
+Reference parity: ``deepspeed/compression/`` — ``init_compression``
+(compress.py:44 wires LinearLayer_Compress modules per config group),
+``basic_layer.py`` (QuantAct/Embedding/Linear compress layers with staged
+bit schedules), ``helper.py`` (layer reduction / student init from teacher
+layers; the XTC recipe "extreme compression": 32→8→ternary staged QAT).
+
+TPU-native: no module surgery — compression is a pure function over the param
+tree applied inside the jitted loss:
+
+- each config group = (param-path regex, bit schedule); matching leaves get
+  straight-through QDQ at the bits the STEP CLOCK dictates (`jnp.where`
+  selects the stage in-graph, so one compiled program covers the whole
+  schedule — no re-jit at stage boundaries);
+- ``layer_reduction_init`` builds a shallower student tree from teacher
+  layers (reference compression/helper.py student initialization).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionSpec:
+    """One weight-quantization group (reference config different_groups)."""
+
+    pattern: str               # regex over the "/"-joined param path
+    start_bits: int = 8
+    target_bits: int = 8
+    quantization_period: int = 0   # steps between stage halvings (0 = fixed)
+    offset: int = 0                # step when quantization begins
+
+    def stages(self) -> List[Tuple[int, int]]:
+        """[(step_threshold, bits)] — start_bits at ``offset``, halving every
+        ``quantization_period`` steps down to target_bits (reference
+        basic_layer.py Quantizer period schedule; XTC's staged ladder).
+        Bits snap to the quantizer's supported grid {≥16: off, 8, 4, 2} —
+        reference configs use values like 12/14 that have no blockwise-int
+        representation here."""
+        def snap(b):
+            return b if b >= 16 else (8 if b >= 8 else (4 if b >= 4 else 2))
+        out = [(self.offset, snap(self.start_bits))]
+        bits, step = self.start_bits, self.offset
+        while bits > self.target_bits and self.quantization_period > 0:
+            bits = max(bits // 2, self.target_bits)
+            step += self.quantization_period
+            if snap(bits) != out[-1][1]:
+                out.append((step, snap(bits)))
+        if self.quantization_period == 0 and \
+                self.target_bits != self.start_bits:
+            out = [(self.offset, snap(self.target_bits))]
+        return out
+
+
+def parse_compression_config(cfg: Dict[str, Any]) -> List[CompressionSpec]:
+    """reference compress.py get_compress_methods: read
+    compression_training.weight_quantization.different_groups."""
+    wq = (cfg or {}).get("weight_quantization", {})
+    shared = wq.get("shared_parameters", {})
+    if not shared.get("enabled", bool(wq.get("different_groups"))):
+        return []
+    specs = []
+    for name, group in (wq.get("different_groups") or {}).items():
+        p = group.get("params", {})
+        modules = group.get("modules", [".*"])
+        for m in modules:
+            specs.append(CompressionSpec(
+                pattern=m,
+                start_bits=int(p.get("start_bits", 8)),
+                target_bits=int(p.get("target_bits",
+                                      p.get("start_bits", 8))),
+                quantization_period=int(p.get("quantization_period", 0)),
+                offset=int(shared.get("schedule_offset", 0))))
+    return specs
+
+
+def _qdq_ste(w, bits: int, block_size: int = 256):
+    from deepspeed_tpu.ops.quantization import quantize_dequantize
+    q = quantize_dequantize(w, bits=bits, block_size=block_size)
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def scheduled_weight_qdq(params, specs: Sequence[CompressionSpec], step):
+    """Apply each group's staged QDQ to matching leaves.  ``step`` may be a
+    traced scalar — stages select via jnp.where so the whole schedule lives
+    in one compiled program."""
+    if not specs:
+        return params
+    compiled = [(re.compile(s.pattern), s.stages()) for s in specs]
+
+    def visit(path, leaf):
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            return leaf
+        name = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                        for p in path)
+        for rx, stages in compiled:
+            if rx.search(name):
+                out = leaf
+                for thr, bits in stages:
+                    if bits >= 16:       # ≥16 bits ≡ uncompressed on TPU
+                        continue
+                    # stages() snapped bits to {8,4,2}; 2 = XTC ternary
+                    q = _qdq_ste(leaf, bits)
+                    out = jnp.where(step >= thr, q, out)
+                return out
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def layer_reduction_init(params: Dict[str, Any], keep_layers: Sequence[int],
+                         num_layers: int) -> Dict[str, Any]:
+    """Student tree from teacher layers (reference compression/helper.py
+    student_initialization: copy `teacher_layer` list into consecutive
+    student slots; embeddings/head shared)."""
+    params = dict(params)
+    inner = params.get("params", params)
+    bb = dict(inner["backbone"])
+    for i, src in enumerate(keep_layers):
+        if f"block_{src}" not in bb:
+            raise ValueError(f"teacher layer {src} not found")
+        bb[f"block_{i}"] = inner["backbone"][f"block_{src}"]
+    for j in range(len(keep_layers), num_layers):
+        bb.pop(f"block_{j}", None)
+    out = dict(inner)
+    out["backbone"] = bb
+    return {"params": out} if "params" in params else out

@@ -103,6 +103,9 @@ class ZeroConfig(DeepSpeedConfigModel):
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_hpz_partition_size: int = 1
+    # MiCS subgroup sharding (reference runtime/zero/mics.py): shard params
+    # within groups of this many chips, replicate across groups; 0 = off
+    mics_shard_size: int = 0
     # stage-3 knobs kept for config parity; XLA's scheduler supersedes most:
     stage3_max_live_parameters: int = 1_000_000_000
     stage3_prefetch_bucket_size: AutoInt = 50_000_000
@@ -261,6 +264,9 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(
         default_factory=HybridEngineConfig)
+    # reference deepspeed/compression/ config block (weight_quantization
+    # groups; consumed by compression/basic.py via the engine loss hook)
+    compression_training: Optional[dict] = None
     gradient_compression: GradientCompressionConfig = Field(
         default_factory=GradientCompressionConfig)
 
@@ -373,6 +379,13 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
     if cfg.prescale_gradients:
         inert.append("prescale_gradients (losses are globally averaged on the "
                      "global-batch jax.Array view; pre-scaling is a no-op)")
+    if cfg.compression_training:
+        # only weight_quantization.different_groups is consumed
+        # (compression/basic.py); every other reference sub-block must scream
+        for key in cfg.compression_training:
+            if key != "weight_quantization":
+                inert.append(f"compression_training.{key} (only "
+                             f"weight_quantization is implemented)")
     for item in inert:
         logger.warning(f"config key accepted but NOT implemented on TPU yet: "
                        f"{item} — this run will NOT honor it")

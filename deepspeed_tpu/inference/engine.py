@@ -100,17 +100,35 @@ class InferenceEngine:
             jnp.asarray(p), params)
         with self.mesh:
             self.params = jax.device_put(params, self.param_shardings)
-
-        self._jit_forward = jax.jit(
-            lambda p, ids: self.module.apply({"params": p}, ids),
-            in_shardings=(self.param_shardings, NamedSharding(mesh, P())))
-        self._gen_cache = {}
         self.num_parameters = sum(
             int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(self.params))
+
+        # ZeRO-Inference: store weights int-quantized, dequantize on the fly
+        # per consumer (reference inference/quantization/; the dead "quant"
+        # knob found in round-2 review now does what it says)
+        self._materialize = None
+        if self.config.quant.enabled:
+            if mesh.shape["tp"] > 1:
+                raise NotImplementedError(
+                    "quant.enabled with tp>1 serving is not supported yet; "
+                    "quantized weights target single-chip HBM savings")
+            from deepspeed_tpu.ops.quantization import make_param_store
+            self.params, self._materialize = make_param_store(
+                self.params, bits=self.config.quant.bits,
+                block_size=self.config.quant.group_size)
+
+        mat = self._materialize or (lambda p: p)
+        self._jit_forward = jax.jit(
+            lambda p, ids: self.module.apply({"params": mat(p)}, ids),
+            in_shardings=None if self._materialize else (
+                self.param_shardings, NamedSharding(mesh, P())))
+        self._gen_cache = {}
         log_dist(f"inference engine ready: params="
                  f"{self.num_parameters/1e6:.1f}M tp={mesh.shape['tp']} "
-                 f"dtype={self.config.dtype}", ranks=[0])
+                 f"dtype={self.config.dtype}"
+                 + (f" quant=int{self.config.quant.bits}"
+                    if self._materialize else ""), ranks=[0])
 
     # ---- reference InferenceEngine.forward (inference/engine.py:584) ----
     def forward(self, batch):
@@ -136,7 +154,10 @@ class InferenceEngine:
         module, cfg = self.module, self.model_config
         S = cfg.max_seq_len
 
+        materialize = self._materialize or (lambda p: p)
+
         def gen(params, ids, attn_mask, rng, temperature, top_p):
+            params = materialize(params)
             B, L = ids.shape
             sample = functools.partial(_sample_token, do_sample=do_sample,
                                        temperature=temperature, top_k=top_k,
@@ -182,6 +203,8 @@ class InferenceEngine:
                                    jnp.arange(max_new_tokens - 1))
             return jnp.concatenate([tok0[:, None], toks.T], axis=1)
 
+        if self._materialize is not None:
+            return jax.jit(gen)
         return jax.jit(gen, in_shardings=(
             self.param_shardings, NamedSharding(self.mesh, P()),
             NamedSharding(self.mesh, P()), NamedSharding(self.mesh, P()),

@@ -55,6 +55,7 @@ class GPTConfig:
     moe_every: int = 2                  # MoE replaces MLP every Nth block
     moe_aux_coef: float = 0.01
     moe_capacity_factor: float = 1.25
+    moe_dropless: bool = False          # ragged grouped-GEMM routing (ep=1)
     # parallelism (mesh passed separately to the GPT module attribute)
     sequence_parallel: bool = False     # Ulysses attention over the sp axis
     # kernel selection (reference: replace_with_kernel_inject / DS_BUILD flags);
@@ -354,6 +355,7 @@ class Block(nn.Module):
                                capacity_factor=c.moe_capacity_factor,
                                mlp_ratio=c.mlp_ratio, mesh=self.mesh,
                                param_dtype=c.param_dtype,
+                               dropless=c.moe_dropless,
                                name="moe")(Norm(c)(x), rng, deterministic)
             x = x + moe_out
         else:

@@ -41,6 +41,28 @@ def _expert_ffn(d, wi, wo):
     return jnp.einsum("ecm,emh->ech", h, wo.astype(d.dtype))
 
 
+def _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo):
+    """Dropless grouped GEMM via ``lax.ragged_dot`` (megablox semantics —
+    reference analog: inference/v2 MoE gather/scatter + cutlass grouped GEMM,
+    and the MegaBlocks paper): tokens sort by expert, each expert multiplies
+    exactly its rows — no capacity padding, no dropped tokens.
+
+    tokens [S, H]; expert_idx [S, k]; weights [S, k] → [S, H]."""
+    S, H = tokens.shape
+    k = expert_idx.shape[1]
+    E = wi.shape[0]
+    flat_e = expert_idx.reshape(-1)                       # [S*k]
+    order = jnp.argsort(flat_e)                           # group by expert
+    tok_rows = jnp.repeat(jnp.arange(S), k)[order]        # source token/row
+    sorted_tok = tokens[tok_rows]
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    h = jax.lax.ragged_dot(sorted_tok, wi.astype(tokens.dtype), group_sizes)
+    h = nn.gelu(h)
+    o = jax.lax.ragged_dot(h, wo.astype(tokens.dtype), group_sizes)
+    w = weights.reshape(-1)[order].astype(o.dtype)
+    return jnp.zeros_like(tokens).at[tok_rows].add(o * w[:, None])
+
+
 class MoE(nn.Module):
     """Mixture-of-experts layer (reference deepspeed.moe.layer.MoE).
 
@@ -60,6 +82,9 @@ class MoE(nn.Module):
     mlp_ratio: int = 4
     mesh: Optional[Mesh] = None
     param_dtype: object = jnp.float32
+    # dropless routing (ragged grouped GEMM, no capacity/no token drops);
+    # ep>1 keeps the capacity path (the A2A needs static per-rank shapes)
+    dropless: bool = False
 
     @nn.compact
     def __call__(self, x, rng: Optional[jax.Array] = None,
@@ -80,10 +105,22 @@ class MoE(nn.Module):
         logits = tokens @ wg.astype(x.dtype)
         noise_std = 1.0 / E if (self.noisy_gate_policy and not deterministic
                                 and rng is not None) else 0.0
+
+        ep = self.mesh.shape["ep"] if self.mesh is not None else 1
+        if self.dropless:
+            if ep > 1:
+                raise NotImplementedError(
+                    "dropless MoE with ep>1: the a2a route needs static "
+                    "shapes; use the capacity path for expert parallelism")
+            from deepspeed_tpu.moe.sharded_moe import dropless_topk
+            aux, expert_idx, weights = dropless_topk(logits, self.k, rng,
+                                                     noise_std)
+            out = _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo)
+            return self._finish(x, out.reshape(B, T, H), aux, k_init)
+
         aux, combine, dispatch = topk_gating(
             logits, self.k, cf, self.min_capacity, rng, noise_std)
 
-        ep = self.mesh.shape["ep"] if self.mesh is not None else 1
         if ep > 1:
             out = _ep_route(self.mesh, tokens, combine, dispatch, wi, wo)
         else:
@@ -92,10 +129,13 @@ class MoE(nn.Module):
             expert_out = _expert_ffn(dispatched, wi, wo)
             out = jnp.einsum("sec,ech->sh", combine.astype(x.dtype), expert_out)
 
-        out = out.reshape(B, T, H)
+        return self._finish(x, out.reshape(B, T, H), aux, k_init)
+
+    def _finish(self, x, out, aux, k_init):
         if self.use_residual:
             # Residual MoE (reference layer.py use_residual): dense MLP branch
             # mixed with the MoE branch by a learned per-token coefficient
+            H, M = self.hidden_size, self.hidden_size * self.mlp_ratio
             mi = self.param("residual_wi", _part(k_init, ("embed", "mlp")),
                             (H, M), self.param_dtype)
             mo = self.param("residual_wo", _part(k_init, ("mlp", "embed")),

@@ -94,3 +94,35 @@ def top2_gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
                 noise_std=0.0):
     """reference sharded_moe.py:288 top2gating."""
     return topk_gating(logits, 2, capacity_factor, min_capacity, rng, noise_std)
+
+
+def dropless_topk(logits: jax.Array, k: int,
+                  rng: Optional[jax.Array] = None, noise_std: float = 0.0,
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dropless top-k routing: (aux_loss, expert_idx [S,k], weights [S,k]).
+
+    The capacity-free side of the gating algebra (reference sharded_moe.py
+    uses fixed capacity; MegaBlocks-style dropless needs only the assignment
+    and normalized weights — the grouped GEMM handles raggedness).  Expert
+    choice and weight normalization match ``topk_gating`` exactly, so at
+    large capacity the two paths agree numerically."""
+    S, E = logits.shape
+    if rng is not None and noise_std > 0.0:
+        logits = logits + jax.random.normal(rng, logits.shape,
+                                            logits.dtype) * noise_std
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    remaining = gates
+    idxs, gate_vals, masks = [], [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = _one_hot(idx, E)
+        idxs.append(idx)
+        masks.append(mask)
+        gate_vals.append(jnp.sum(gates * mask, axis=-1))
+        remaining = remaining * (1.0 - mask)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+    denom = jnp.clip(sum(gate_vals), 1e-9, None)
+    weights = jnp.stack([g / denom for g in gate_vals], axis=1)
+    return aux_loss, jnp.stack(idxs, axis=1).astype(jnp.int32), weights

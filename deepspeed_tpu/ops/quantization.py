@@ -46,8 +46,8 @@ def quantize_blockwise(x, *, bits: int = 8,
                        block_size: int = 256) -> QuantizedBlocks:
     """Symmetric per-block quantization (reference quantize.cu semantics:
     scale = max|x| / qmax per block, stochastic-free round-to-nearest)."""
-    if bits not in (4, 8):
-        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if bits not in (2, 4, 8):
+        raise ValueError(f"bits must be 2, 4, or 8, got {bits}")
     orig_shape, orig_dtype = x.shape, x.dtype
     flat, n = _pad_to_blocks(x.reshape(-1).astype(jnp.float32), block_size)
     blocks = flat.reshape(-1, block_size)
@@ -199,3 +199,42 @@ def quantized_weight_gather(x, mesh, axis: str, gather_dim: int, *,
 
     gather.defvjp(fwd, bwd)
     return gather(x)
+
+
+def make_param_store(params, *, bits: int = 8, block_size: int = 128):
+    """Pack a param tree into int-quantized storage + a jit-safe materializer
+    — ZeRO-Inference weight storage (reference inference/quantization/
+    __init__.py _init_group_wise_weight_quantization: weights live in HBM at
+    ``bits``/16 of their bf16 size; each consumer dequantizes on the fly and
+    XLA frees the transient fp buffer after use).
+
+    Returns (stored, materialize): ``stored`` is a pytree (list) holding
+    {"v": int8, "s": fp32} for quantized leaves and the raw leaf otherwise;
+    ``materialize(stored)`` rebuilds the original tree inside jit.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    stored, metas = [], []
+    for leaf in leaves:
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating) and \
+                leaf.size >= block_size:
+            qb = quantize_blockwise(leaf, bits=bits, block_size=block_size)
+            stored.append({"v": qb.values, "s": qb.scales})
+            metas.append((tuple(leaf.shape), leaf.dtype, bits, block_size))
+        else:
+            stored.append(leaf)
+            metas.append(None)
+
+    def materialize(stored_list):
+        out = []
+        for item, meta in zip(stored_list, metas):
+            if meta is None:
+                out.append(item)
+            else:
+                shape, dtype, b, bs = meta
+                out.append(dequantize_blockwise(QuantizedBlocks(
+                    values=item["v"], scales=item["s"], shape=shape,
+                    dtype=dtype, bits=b, block_size=bs)))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return stored, materialize

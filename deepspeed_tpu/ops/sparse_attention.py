@@ -1,0 +1,161 @@
+"""Block-sparse attention — sparsity patterns + layout-masked attention.
+
+Reference parity: ``deepspeed/ops/sparse_attention/`` — ``SparsityConfig``
+family (sparsity_config.py: Fixed, BigBird, BSLongformer, Variable) and the
+block-sparse ``SparseSelfAttention`` (sparse_self_attention.py) built on
+Triton matmul/softmax kernels (matmul.py, softmax.py).
+
+TPU-native: the sparsity pattern is a STATIC [nb, nb] block layout computed
+on the host; attention applies it as a block-expanded mask through the ops
+attention path, which XLA fuses (the masked dense form — correct everywhere).
+A Pallas kernel that *skips* dead blocks entirely (flash-style inner loop over
+each row-block's active blocks, the Triton analog) is the designated fast
+path for long sequences; the layout contract here is what it will consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SparsityConfig:
+    """Base pattern config (reference sparsity_config.py:15)."""
+
+    block: int = 16
+    different_layout_per_head: bool = False   # parity knob; one layout here
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks active (reference DenseSparsityConfig) — debugging/parity."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        nb = _nblocks(seq_len, self.block)
+        return np.ones((nb, nb), bool)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + periodic global blocks
+    (reference FixedSparsityConfig:67, the Sparse-Transformer 'fixed'
+    pattern)."""
+
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        nb = _nblocks(seq_len, self.block)
+        lay = np.zeros((nb, nb), bool)
+        nl, ng = self.num_local_blocks, self.num_global_blocks
+        for i in range(nb):
+            w0 = (i // nl) * nl
+            lay[i, w0:i + 1] = True              # local window (causal)
+        # last ng blocks of every preceding window attend globally
+        for w0 in range(0, nb, nl):
+            g0 = max(w0 + nl - ng, 0)
+            for i in range(nb):
+                if i >= w0 + nl:
+                    lay[i, g0:w0 + nl] = True
+        return lay
+
+
+@dataclasses.dataclass(frozen=True)
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Sliding window + designated global blocks
+    (reference BSLongformerSparsityConfig:296)."""
+
+    num_sliding_window_blocks: int = 3
+    global_block_indices: tuple = (0,)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        nb = _nblocks(seq_len, self.block)
+        lay = np.zeros((nb, nb), bool)
+        w = self.num_sliding_window_blocks
+        for i in range(nb):
+            lay[i, max(0, i - w + 1):i + 1] = True
+        for g in self.global_block_indices:
+            if g < nb:
+                lay[:, g] = True                  # everyone sees global
+                lay[g, :] = True                  # global sees everyone
+        return lay
+
+
+@dataclasses.dataclass(frozen=True)
+class BigBirdSparsityConfig(SparsityConfig):
+    """Random + window + global blocks (reference BigBirdSparsityConfig:218).
+
+    Random blocks are drawn with a fixed seed so the layout is deterministic
+    per (seq_len, config) — the layout must be static under jit."""
+
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    num_global_blocks: int = 1
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        nb = _nblocks(seq_len, self.block)
+        lay = np.zeros((nb, nb), bool)
+        w = self.num_sliding_window_blocks
+        rng = np.random.default_rng(self.seed)
+        for i in range(nb):
+            lay[i, max(0, i - w + 1):i + 1] = True
+            lo = min(i + 1, nb)
+            if lo > 0 and self.num_random_blocks:
+                picks = rng.choice(lo, min(self.num_random_blocks, lo),
+                                   replace=False)
+                lay[i, picks] = True
+        g = self.num_global_blocks
+        lay[:, :g] = True
+        lay[:g, :] = True
+        return lay
+
+
+def _nblocks(seq_len: int, block: int) -> int:
+    if seq_len % block:
+        raise ValueError(f"seq_len {seq_len} not divisible by block {block}")
+    return seq_len // block
+
+
+def expand_layout_mask(layout: np.ndarray, block: int,
+                       causal: bool = True) -> np.ndarray:
+    """[nb, nb] block layout → [T, T] boolean attention mask (∧ causal)."""
+    mask = np.kron(layout, np.ones((block, block), bool))
+    if causal:
+        T = mask.shape[0]
+        mask &= np.tril(np.ones((T, T), bool))
+    return mask
+
+
+def sparse_attention(q, k, v, config: SparsityConfig, *,
+                     causal: bool = True, dropout_fn=None,
+                     impl: Optional[str] = None):
+    """Block-sparse attention on [B, T, N, D] (reference
+    SparseSelfAttention.forward): the static layout masks the score matrix;
+    fully-masked rows would be NaN, so the layout always includes the
+    diagonal (every pattern above does)."""
+    T = q.shape[1]
+    layout = config.make_layout(T)
+    mask = jnp.asarray(expand_layout_mask(layout, config.block, causal))
+    from deepspeed_tpu import ops
+    return ops.causal_attention(q, k, v, causal=False,
+                                mask=jnp.broadcast_to(mask, (q.shape[0],) +
+                                                      mask.shape),
+                                dropout_fn=dropout_fn, impl=impl)
+
+
+def sparsity_ratio(config: SparsityConfig, seq_len: int,
+                   causal: bool = True) -> float:
+    """Fraction of ACTIVE attention entries — the compute/memory saving a
+    block-skipping kernel realizes."""
+    m = expand_layout_mask(config.make_layout(seq_len), config.block, causal)
+    denom = np.tril(np.ones(m.shape, bool)).sum() if causal else m.size
+    return float(m.sum() / denom)

@@ -1,0 +1,111 @@
+"""Compression library tests (reference pattern:
+tests/unit/compression/test_compression.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionSpec, layer_reduction_init,
+                                       parse_compression_config,
+                                       scheduled_weight_qdq)
+from deepspeed_tpu.models import GPT, GPTConfig
+
+
+class TestSpecs:
+    def test_parse_reference_config_shape(self):
+        specs = parse_compression_config({
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 5},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                                       "quantization_period": 10},
+                            "modules": ["Attention_0"]}}}})
+        assert len(specs) == 1
+        s = specs[0]
+        assert s.pattern == "Attention_0" and s.offset == 5
+        assert s.stages() == [(5, 8), (15, 4)]
+
+    def test_disabled_returns_empty(self):
+        assert parse_compression_config(None) == []
+        assert parse_compression_config({"weight_quantization": {
+            "shared_parameters": {"enabled": False}}}) == []
+
+    def test_xtc_ladder_to_ternary(self):
+        s = CompressionSpec(pattern=".*", start_bits=8, target_bits=2,
+                            quantization_period=100)
+        assert s.stages() == [(0, 8), (100, 4), (200, 2)]
+
+
+class TestScheduledQDQ:
+    def test_stage_selection_by_step(self, rng):
+        params = {"layer": {"weight": jnp.asarray(
+            rng.standard_normal(512), jnp.float32)}}
+        specs = [CompressionSpec(pattern="weight", start_bits=8,
+                                 target_bits=2, quantization_period=10)]
+        w = params["layer"]["weight"]
+        before = scheduled_weight_qdq(params, specs,
+                                      jnp.int32(0))["layer"]["weight"]
+        final = scheduled_weight_qdq(params, specs,
+                                     jnp.int32(25))["layer"]["weight"]
+        err8 = float(jnp.abs(before - w).max())
+        err2 = float(jnp.abs(final - w).max())
+        assert 0 < err8 < err2          # coarser grid later in the schedule
+        # ternary endpoint: few distinct magnitudes per block
+        assert len(np.unique(np.round(np.asarray(final), 6))) < 300
+
+    def test_non_matching_leaves_untouched(self, rng):
+        params = {"a": {"kernel": jnp.ones(64)}, "b": {"other": jnp.ones(64)}}
+        out = scheduled_weight_qdq(
+            params, [CompressionSpec(pattern="kernel", target_bits=4)],
+            jnp.int32(5))
+        np.testing.assert_array_equal(np.asarray(out["b"]["other"]), 1.0)
+
+
+class TestEngineQAT:
+    def test_training_converges_under_quantization(self):
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        rng = np.random.default_rng(0)
+        pool = rng.integers(0, 128, size=(8, 32)).astype(np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "mesh": {"dp": 1}, "steps_per_print": 0,
+                "compression_training": {"weight_quantization": {
+                    "shared_parameters": {"enabled": True},
+                    "different_groups": {"wq1": {
+                        "params": {"start_bits": 8, "target_bits": 8},
+                        "modules": ["Attention_0|MLP_0"]}}}},
+            }, example_batch={"input_ids": pool})
+        assert engine._compression_specs
+        losses = [float(engine.train_batch({"input_ids": pool}).loss)
+                  for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.6
+
+
+class TestLayerReduction:
+    def test_student_from_teacher_layers(self, rng):
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=16)   # 2 layers
+        model = GPT(cfg)
+        batch = {"input_ids": rng.integers(0, 64, (2, 16)).astype(np.int32)}
+        from deepspeed_tpu.parallel.metadata import unbox
+        v = unbox(model.init(jax.random.PRNGKey(0), batch))
+        import dataclasses
+        scfg = dataclasses.replace(cfg, num_layers=1)
+        student_params = layer_reduction_init(v, keep_layers=[1],
+                                              num_layers=cfg.num_layers)
+        student = GPT(scfg)
+        loss = student.apply(student_params, batch, deterministic=True)
+        assert np.isfinite(float(loss))
+        # student layer 0 == teacher layer 1
+        a = jax.tree_util.tree_leaves(
+            student_params["params"]["backbone"]["block_0"])
+        b = jax.tree_util.tree_leaves(v["params"]["backbone"]["block_1"])
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_missing_layer_raises(self):
+        with pytest.raises(ValueError, match="not found"):
+            layer_reduction_init({"params": {"backbone": {}}}, [3], 4)

@@ -155,3 +155,42 @@ def test_checkpoint_reshard_on_load(tmp_path, devices):
     e2.load_checkpoint(str(tmp_path), tag)
     w2 = np.asarray(jax.tree_util.tree_leaves(e2.state.params)[0])
     np.testing.assert_allclose(w1, w2, rtol=1e-6)
+
+
+class TestMiCS:
+    """MiCS subgroup sharding (reference runtime/zero/mics.py): params shard
+    within mics_shard_size groups, replicate across them."""
+
+    def test_mesh_and_shardings(self, devices, rng):
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        pool = rng.integers(0, 128, size=(8, 32)).astype(np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3, "mics_shard_size": 4},
+                "steps_per_print": 0,
+            }, example_batch={"input_ids": pool})
+        assert engine.mesh.shape["fsdp"] == 4       # shard group
+        assert engine.mesh.shape["dp"] == 2         # replica groups
+        # params shard over fsdp only (not dp): every fsdp-sharded leaf's
+        # spec mentions "fsdp" and never "dp"
+        specs = [s.spec for s in
+                 jax.tree_util.tree_leaves(engine.param_shardings)]
+        assert any("fsdp" in str(s) for s in specs)
+        assert not any("'dp'" in str(s) for s in specs)
+        m = engine.train_batch({"input_ids": pool})
+        assert np.isfinite(float(m.loss))
+
+    def test_requires_stage3(self, rng):
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=16)
+        with pytest.raises(ValueError, match="stage 3"):
+            deepspeed_tpu.initialize(
+                model=GPT(cfg), config={
+                    "train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2, "mics_shard_size": 4},
+                }, example_batch={"input_ids": rng.integers(
+                    0, 64, size=(8, 16)).astype(np.int32)})

@@ -10,6 +10,7 @@ Here: build a TINY randomly-initialized HF model per supported architecture,
 import json
 import os
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -50,7 +51,34 @@ def _check(path, model, rng, vocab, atol=2e-3):
 
 @pytest.fixture(scope="module")
 def tmp_models(tmp_path_factory):
-    return str(tmp_path_factory.mktemp("hf_models"))
+    """Directory of tiny HF fixture models, built ON DEMAND so any test (or
+    -k selection) can run in isolation."""
+    root = str(tmp_path_factory.mktemp("hf_models"))
+
+    def ensure(name):
+        path = os.path.join(root, name)
+        if os.path.exists(os.path.join(path, "config.json")):
+            return path
+        if name == "llama":
+            torch.manual_seed(0)
+            model = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+                vocab_size=128, hidden_size=64, intermediate_size=172,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64,
+                rms_norm_eps=1e-5, rope_theta=10000.0,
+                tie_word_embeddings=False))
+        elif name == "gpt2":
+            torch.manual_seed(3)
+            model = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+                vocab_size=128, n_positions=64, n_embd=64, n_layer=2,
+                n_head=4))
+        else:
+            raise KeyError(name)
+        model.eval().save_pretrained(path, safe_serialization=True)
+        return path
+
+    root_path = type("Models", (str,), {"ensure": staticmethod(ensure)})(root)
+    return root_path
 
 
 class TestLlamaFamily:
@@ -117,7 +145,7 @@ class TestV2Serving:
         """Greedy tokens from the ragged engine == HF greedy generate."""
         from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
-        path = os.path.join(tmp_models, "llama")
+        path = tmp_models.ensure("llama")
         torch_model = transformers.LlamaForCausalLM.from_pretrained(path).eval()
         prompt = rng.integers(0, 128, (1, 10)).astype(np.int32)
         with torch.no_grad():
@@ -143,6 +171,46 @@ class TestErrors:
             config_from_hf(path)
 
     def test_is_hf_model_dir(self, tmp_models):
-        assert is_hf_model_dir(os.path.join(tmp_models, "llama"))
+        assert is_hf_model_dir(tmp_models.ensure("llama"))
         assert not is_hf_model_dir("/nonexistent")
         assert not is_hf_model_dir({"not": "a path"})
+
+
+class TestExport:
+    """Universal-checkpoint export leg: flax tree → HF directory →
+    transformers (reference checkpoint/ds_to_universal.py cross-framework
+    goal)."""
+
+    def test_llama_export_roundtrip_via_transformers(self, tmp_models, rng):
+        from deepspeed_tpu.checkpoint.hf import (load_hf_checkpoint,
+                                                 save_hf_checkpoint)
+        src = tmp_models.ensure("llama")
+        cfg, params = load_hf_checkpoint(src, dtype=jnp.float32)
+        out = os.path.join(tmp_models, "llama_exported")
+        save_hf_checkpoint(cfg, params, out)
+        model = transformers.LlamaForCausalLM.from_pretrained(out).eval()
+        ids = rng.integers(0, 128, (2, 10)).astype(np.int32)
+        want = _torch_logits(model, ids)
+        got = _our_logits(src, ids)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+    def test_gpt2_export_roundtrip(self, tmp_models, rng):
+        from deepspeed_tpu.checkpoint.hf import (load_hf_checkpoint,
+                                                 save_hf_checkpoint)
+        src = tmp_models.ensure("gpt2")
+        cfg, params = load_hf_checkpoint(src, dtype=jnp.float32)
+        out = os.path.join(tmp_models, "gpt2_exported")
+        save_hf_checkpoint(cfg, params, out)
+        # reload through OUR importer too (full cycle)
+        cfg2, params2 = load_hf_checkpoint(out, dtype=jnp.float32)
+        a = jax.tree_util.tree_leaves(params)
+        b = jax.tree_util.tree_leaves(params2)
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x, np.float32),
+                                       np.asarray(y, np.float32), atol=1e-6)
+        model = transformers.GPT2LMHeadModel.from_pretrained(out).eval()
+        ids = rng.integers(0, 128, (2, 10)).astype(np.int32)
+        want = _torch_logits(model, ids)
+        got = _our_logits(src, ids)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)

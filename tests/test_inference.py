@@ -168,3 +168,42 @@ class TestInferenceConfig:
             {"tensor_parallel": 4}).tensor_parallel.tp_size == 4
         assert parse_inference_config(
             {"tensor_parallel": {"tp_size": 2}}).tensor_parallel.tp_size == 2
+
+
+class TestZeroInference:
+    """Weight-quantized serving (ZeRO-Inference analog; reference
+    inference/quantization/)."""
+
+    def test_int8_logits_close_and_generate_works(self, tiny_cfg, rng):
+        e_fp = deepspeed_tpu.init_inference(
+            tiny_cfg, config={"dtype": "fp32"})
+        e_q8 = deepspeed_tpu.init_inference(
+            tiny_cfg, config={"dtype": "fp32",
+                              "quant": {"enabled": True, "bits": 8,
+                                        "group_size": 64}},
+            params={"params": e_fp.params})
+        ids = rng.integers(0, 97, (2, 12)).astype(np.int32)
+        lf = np.asarray(e_fp.forward(ids))
+        lq = np.asarray(e_q8.forward(ids))
+        # int8 weights: logits close, not equal
+        assert np.max(np.abs(lf - lq)) < 0.15 * np.max(np.abs(lf))
+        assert not np.array_equal(lf, lq)
+        out = e_q8.generate(ids, max_new_tokens=4, do_sample=False)
+        assert out.shape == (2, 4)
+
+    def test_int4_storage_is_quarter_size(self, tiny_cfg):
+        e_q4 = deepspeed_tpu.init_inference(
+            tiny_cfg, config={"dtype": "fp32",
+                              "quant": {"enabled": True, "bits": 4,
+                                        "group_size": 64}})
+        stored_bytes = sum(
+            l.size * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(e_q4.params))
+        fp_bytes = e_q4.num_parameters * 4
+        assert stored_bytes < 0.45 * fp_bytes   # 1/8 values + scales + raws
+
+    def test_quant_with_tp_raises(self, tiny_cfg):
+        with pytest.raises(NotImplementedError, match="tp>1"):
+            deepspeed_tpu.init_inference(
+                tiny_cfg, config={"dtype": "fp32", "tensor_parallel": 2,
+                                  "quant": {"enabled": True}})

@@ -98,3 +98,56 @@ def test_moe_gpt_trains(devices):
     # expert weights actually sharded over ep
     wi = engine.state.params["params"]["backbone"]["block_1"]["moe"]["wi"]
     assert "ep" in str(wi.sharding.spec)
+
+
+class TestDropless:
+    """Dropless (ragged grouped GEMM) path vs the capacity path — identical
+    expert math when capacity is large enough to drop nothing."""
+
+    def test_matches_capacity_path_no_drops(self, rng):
+        from deepspeed_tpu.moe import MoE
+        B, T, H, E = 2, 8, 16, 4
+        x = jnp.asarray(rng.standard_normal((B, T, H)), jnp.float32)
+        dense = MoE(hidden_size=H, num_experts=E, k=2, mlp_ratio=2,
+                    capacity_factor=float(E), eval_capacity_factor=float(E))
+        drop = MoE(hidden_size=H, num_experts=E, k=2, mlp_ratio=2,
+                   dropless=True)
+        v = dense.init(jax.random.PRNGKey(0), x, None, True)
+        yd, auxd = dense.apply(v, x, None, True)
+        yr, auxr = drop.apply(v, x, None, True)
+        np.testing.assert_allclose(np.asarray(yr), np.asarray(yd),
+                                   atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(float(auxr), float(auxd), rtol=1e-6)
+
+    def test_dropless_never_drops_under_imbalance(self, rng):
+        """Pathological routing (all tokens to one expert): capacity path
+        drops, dropless must not."""
+        from deepspeed_tpu.moe import MoE
+        from deepspeed_tpu.moe.layer import _expert_ffn_ragged
+        B, T, H, E = 1, 16, 8, 4
+        x = jnp.asarray(np.tile(rng.standard_normal((1, 1, H)), (B, T, 1)),
+                        jnp.float32)   # identical tokens → one expert wins
+        drop = MoE(hidden_size=H, num_experts=E, k=1, mlp_ratio=2,
+                   dropless=True)
+        v = drop.init(jax.random.PRNGKey(1), x, None, True)
+        y, _ = drop.apply(v, x, None, True)
+        # every token got SOME expert output (no zero rows from drops)
+        assert np.all(np.abs(np.asarray(y)).sum(-1) > 0)
+
+    def test_dropless_grads_flow(self, rng):
+        from deepspeed_tpu.moe import MoE
+        B, T, H, E = 2, 4, 8, 4
+        x = jnp.asarray(rng.standard_normal((B, T, H)), jnp.float32)
+        drop = MoE(hidden_size=H, num_experts=E, k=2, mlp_ratio=2,
+                   dropless=True)
+        v = drop.init(jax.random.PRNGKey(2), x, None, True)
+
+        def loss(vv):
+            y, aux = drop.apply(vv, x, None, True)
+            return jnp.sum(y ** 2) + 0.01 * aux
+        from deepspeed_tpu.parallel.metadata import unbox
+        g = unbox(jax.grad(loss)(v))
+        gl = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(l)).all() for l in gl)
+        # expert weights receive gradient
+        assert np.abs(np.asarray(g["params"]["wi"])).sum() > 0

@@ -147,17 +147,30 @@ class TestInertConfigWarnings:
     def test_unimplemented_keys_warn(self, caplog):
         cfg = parse_config({
             "zero_optimization": {
-                "stage": 3,
+                "stage": 2,
                 "offload_param": {"device": "nvme"},
+                # implemented at stage 3 only — inert at stage 2 must warn
                 "zero_quantized_weights": True,
+                "zero_quantized_gradients": True,
             },
-            "gradient_compression": {"enabled": True},
         })
         inert = warn_inert_config(cfg)
         joined = " ".join(inert)
         assert "offload_param" in joined
         assert "zero_quantized_weights" in joined
-        assert "gradient_compression" in joined
+        assert "zero_quantized_gradients" in joined
+
+    def test_implemented_keys_do_not_warn(self):
+        """gradient_compression + stage-3 qwZ are live now (round 2) — the
+        inert list must NOT name them."""
+        cfg = parse_config({
+            "zero_optimization": {"stage": 3,
+                                  "zero_quantized_weights": True},
+            "gradient_compression": {"enabled": True, "dtype": "int8"},
+        })
+        joined = " ".join(warn_inert_config(cfg))
+        assert "gradient_compression" not in joined
+        assert "zero_quantized_weights" not in joined
 
     def test_clean_config_does_not_warn(self):
         cfg = parse_config({"zero_optimization": {"stage": 2},

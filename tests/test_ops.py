@@ -152,3 +152,58 @@ class TestPagedAttention:
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(want, np.float32),
                                    atol=2e-2, rtol=2e-2)
+
+
+class TestSparseAttention:
+    """Block-sparse attention patterns (reference ops/sparse_attention/)."""
+
+    def _qkv(self, rng, B=2, T=32, N=2, D=8):
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.standard_normal((B, T, N, D)), jnp.float32)
+        return mk(), mk(), mk()
+
+    def test_dense_config_matches_causal(self, rng):
+        from deepspeed_tpu.ops.sparse_attention import (DenseSparsityConfig,
+                                                        sparse_attention)
+        q, k, v = self._qkv(rng)
+        got = sparse_attention(q, k, v, DenseSparsityConfig(block=8))
+        want = ops.causal_attention(q, k, v, impl="xla")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_fixed_pattern_masks_long_range(self, rng):
+        from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                        expand_layout_mask,
+                                                        sparse_attention,
+                                                        sparsity_ratio)
+        cfg = FixedSparsityConfig(block=8, num_local_blocks=2,
+                                  num_global_blocks=1)
+        lay = cfg.make_layout(64)
+        assert lay.shape == (8, 8)
+        assert lay[7, 7] and lay[0, 0]          # diagonal always active
+        assert not lay[7, 4]                    # distant non-global masked
+        assert sparsity_ratio(cfg, 64) < 1.0
+        q, k, v = self._qkv(rng, T=64)
+        out = sparse_attention(q, k, v, cfg)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_longformer_and_bigbird_layouts(self):
+        from deepspeed_tpu.ops.sparse_attention import (
+            BigBirdSparsityConfig, BSLongformerSparsityConfig)
+        lf = BSLongformerSparsityConfig(
+            block=4, num_sliding_window_blocks=2, global_block_indices=(0,))
+        lay = lf.make_layout(32)
+        assert lay[:, 0].all() and lay[0, :].all()      # global block
+        assert lay[5, 4] and not lay[5, 2]              # window of 2
+        bb = BigBirdSparsityConfig(block=4, num_random_blocks=1,
+                                   num_sliding_window_blocks=2,
+                                   num_global_blocks=1)
+        lay2 = bb.make_layout(32)
+        assert lay2[:, 0].all()
+        # deterministic layout (static under jit)
+        np.testing.assert_array_equal(lay2, bb.make_layout(32))
+
+    def test_bad_block_size_raises(self):
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+        with pytest.raises(ValueError, match="divisible"):
+            FixedSparsityConfig(block=7).make_layout(32)
