@@ -57,7 +57,8 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_dropless: bool = False          # ragged grouped-GEMM routing (ep=1)
     # parallelism (mesh passed separately to the GPT module attribute)
-    sequence_parallel: bool = False     # Ulysses attention over the sp axis
+    sequence_parallel: bool = False     # attention over the sp axis
+    sp_impl: str = "ulysses"            # "ulysses" (a2a head swap) | "ring"
     # kernel selection (reference: replace_with_kernel_inject / DS_BUILD flags);
     # None = registry auto (pallas flash on TPU, XLA elsewhere)
     attn_impl: Optional[str] = None
@@ -281,14 +282,24 @@ class Attention(nn.Module):
 
         if (c.sequence_parallel and self.mesh is not None
                 and self.mesh.shape["sp"] > 1):
-            # Ulysses: seq-shard → head-shard swap around local attention.
-            # Dropout falls on the attention *output* here (rng plumbing inside
-            # shard_map isn't worth it); local path keeps standard prob-dropout.
+            # sequence parallelism: Ulysses (seq→head all-to-all swap around
+            # local attention) or ring (KV blocks rotate over neighbor links;
+            # no head-divisibility constraint — sequence/ring.py).  Dropout
+            # falls on the attention *output* here (rng plumbing inside
+            # shard_map isn't worth it); local path keeps standard
+            # prob-dropout.
             from deepspeed_tpu import ops
-            from deepspeed_tpu.sequence import ulysses_attention
-            local_attn = lambda q_, k_, v_: ops.causal_attention(  # noqa: E731
-                q_, k_, v_, impl=c.attn_impl)
-            out = ulysses_attention(local_attn, self.mesh, q, k, v)
+            if c.sp_impl == "ring":
+                from deepspeed_tpu.sequence import ring_attention
+                out = ring_attention(self.mesh, q, k, v)
+            elif c.sp_impl != "ulysses":
+                raise ValueError(f"unknown sp_impl {c.sp_impl!r}; expected "
+                                 f"'ulysses' or 'ring'")
+            else:
+                from deepspeed_tpu.sequence import ulysses_attention
+                local_attn = lambda q_, k_, v_: ops.causal_attention(  # noqa: E731,E501
+                    q_, k_, v_, impl=c.attn_impl)
+                out = ulysses_attention(local_attn, self.mesh, q, k, v)
             if c.dropout > 0 and not deterministic:
                 out = nn.Dropout(rate=c.dropout)(out, deterministic=False)
         else:

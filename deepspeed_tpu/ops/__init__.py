@@ -87,6 +87,10 @@ from deepspeed_tpu.ops.paged_attention import paged_attention  # noqa: E402
 register_op("paged_attention", xla=_paged.xla_paged_attention,
             pallas=_paged.pallas_paged_attention, supported=_paged.supported)
 
+from deepspeed_tpu.ops.evoformer import evoformer_attention  # noqa: E402
+
+register_op("evoformer_attention", xla=evoformer_attention)
+
 
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
@@ -99,5 +103,6 @@ def causal_attention(q, k, v, *, causal: bool = True,
 
 
 __all__ = ["causal_attention", "flash_attention", "paged_attention",
+           "evoformer_attention",
            "lm_cross_entropy", "masked_nll_sum", "rms_norm", "layer_norm",
            "op_report", "register_op", "dispatch", "list_ops", "registry"]

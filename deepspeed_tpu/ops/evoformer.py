@@ -1,0 +1,49 @@
+"""Evoformer attention (DS4Science analog).
+
+Reference parity: ``csrc/deepspeed4science/evoformer_attn/`` +
+``deepspeed/ops/deepspeed4science/evoformer_attn.py`` — AlphaFold2-style
+attention over [B, N, S, H, D] (N = MSA rows / residue pairs) with two
+broadcastable bias terms folded into the logits:
+
+    out = softmax(Q·Kᵀ·d^-1/2 + bias1 + bias2) · V
+    bias1: [B, N, 1, 1, S]   (per-key mask bias, e.g. -1e9 padding)
+    bias2: [B, 1, H, S, S]   (pair-representation bias, shared over N)
+
+The reference builds this on CUTLASS fMHA; on TPU the fused einsum chain is
+exactly what XLA maps onto the MXU, and the bias adds fuse into the softmax —
+the op exists for API/semantics parity and as the numeric ground truth for a
+future Pallas blockwise version at long S.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def evoformer_attention(q, k, v, bias1: Optional[jax.Array] = None,
+                        bias2: Optional[jax.Array] = None):
+    """q/k/v: [B, N, S, H, D]; bias1 broadcastable to [B, N, 1, 1, S];
+    bias2 broadcastable to [B, 1, H, S, S].  Returns [B, N, S, H, D].
+
+    reference evoformer_attn.py:DS4Sci_EvoformerAttention (inputs validated
+    the same way: 5-D tensors, biases optional)."""
+    if q.ndim != 5:
+        raise ValueError(f"evoformer attention expects [B, N, S, H, D] "
+                         f"tensors, got rank {q.ndim}")
+    scale = q.shape[-1] ** -0.5
+    # [B, N, H, S, S]
+    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if bias1 is not None:
+        # [B, N, 1, 1, S] broadcasts over heads + query positions
+        logits = logits + jnp.asarray(bias1, jnp.float32)
+    if bias2 is not None:
+        # [B, 1, H, S, S] broadcasts over N
+        logits = logits + jnp.asarray(bias2, jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnhqk,bnkhd->bnqhd", probs,
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype)

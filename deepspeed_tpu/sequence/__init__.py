@@ -1,3 +1,5 @@
-from deepspeed_tpu.sequence.ulysses import DistributedAttention, ulysses_attention
+from deepspeed_tpu.sequence.ring import ring_attention
+from deepspeed_tpu.sequence.ulysses import (DistributedAttention,
+                                            ulysses_attention)
 
-__all__ = ["DistributedAttention", "ulysses_attention"]
+__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention"]

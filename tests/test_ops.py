@@ -207,3 +207,42 @@ class TestSparseAttention:
         from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
         with pytest.raises(ValueError, match="divisible"):
             FixedSparsityConfig(block=7).make_layout(32)
+
+
+class TestEvoformer:
+    """DS4Science evoformer attention (reference csrc/deepspeed4science/)."""
+
+    def test_matches_naive_softmax(self, rng):
+        from deepspeed_tpu.ops.evoformer import evoformer_attention
+        B, N, S, H, D = 2, 3, 8, 2, 4
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        bias1 = jnp.asarray(rng.standard_normal((B, N, 1, 1, S)), jnp.float32)
+        bias2 = jnp.asarray(rng.standard_normal((B, 1, H, S, S)), jnp.float32)
+        got = evoformer_attention(q, k, v, bias1, bias2)
+        # naive reference
+        logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q, k) * (D ** -0.5)
+        logits = logits + bias1 + bias2
+        want = jnp.einsum("bnhqk,bnkhd->bnqhd",
+                          jax.nn.softmax(logits, -1), v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+        assert got.shape == q.shape
+
+    def test_mask_bias_excludes_keys(self, rng):
+        from deepspeed_tpu.ops.evoformer import evoformer_attention
+        B, N, S, H, D = 1, 1, 4, 1, 4
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        bias1 = jnp.zeros((B, N, 1, 1, S)).at[..., -1].set(-1e9)
+        out = evoformer_attention(q, k, v, bias1)
+        # last key masked → output equals attention over first S-1 keys
+        want = evoformer_attention(q, k[:, :, :-1], v[:, :, :-1])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_rank_check(self):
+        from deepspeed_tpu.ops.evoformer import evoformer_attention
+        with pytest.raises(ValueError, match="B, N, S, H, D"):
+            evoformer_attention(jnp.zeros((2, 3, 4)), jnp.zeros((2, 3, 4)),
+                                jnp.zeros((2, 3, 4)))
