@@ -31,7 +31,8 @@ from deepspeed_tpu.utils.logging import log_dist
 # architectures served by the GPT-family tree (reference zoo:
 # inference/v2/model_implementations/{llama_v2,mistral,qwen_v2,...},
 # module_inject/containers/gpt2.py)
-_LLAMA_LIKE = {"LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM"}
+_LLAMA_LIKE = {"LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
+               "MixtralForCausalLM"}
 _GPT2_LIKE = {"GPT2LMHeadModel"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE)
 
@@ -92,7 +93,19 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
         head_dim = hf.get("head_dim") or hidden // heads
         msl = hf.get("max_position_embeddings", 2048)
         attn_bias = bool(hf.get("attention_bias", False))
+        moe_kw = {}
+        if arch == "MixtralForCausalLM":
+            # every layer is MoE with SwiGLU experts (modeling_mixtral.py
+            # MixtralSparseMoeBlock); gated_mlp=True drives the per-expert
+            # gate in moe/layer.py
+            # dropless routing: inference must never drop tokens (the
+            # capacity path is a training trade-off), and it matches HF's
+            # exact top-k + renormalize semantics
+            moe_kw = dict(num_experts=hf["num_local_experts"],
+                          moe_k=hf["num_experts_per_tok"],
+                          moe_every=1, moe_dropless=True)
         return GPTConfig(
+            **moe_kw,
             vocab_size=hf["vocab_size"],
             num_layers=hf["num_hidden_layers"],
             num_heads=heads,
@@ -203,16 +216,31 @@ def _llama_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
             att["bv"] = r.get(p + "self_attn.v_proj.bias").reshape(nkv, hd)
         if cfg.attn_out_bias:
             att["bo"] = r.get(p + "self_attn.o_proj.bias")
-        bb[f"block_{i}"] = {
+        blk = {
             "Attention_0": att,
             "Norm_0": {"scale": r.get(p + "input_layernorm.weight")},
             "Norm_1": {"scale": r.get(p + "post_attention_layernorm.weight")},
-            "MLP_0": {
+        }
+        if cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1:
+            # Mixtral MoE block (modeling_mixtral.py MixtralSparseMoeBlock):
+            # gate router + per-expert w1(gate)/w3(up)/w2(down)
+            m = p + "block_sparse_moe."
+            blk["moe"] = {
+                "gate": lin(m + "gate.weight"),                  # [H, E]
+                "wge": np.stack([lin(m + f"experts.{e}.w1.weight")
+                                 for e in range(cfg.num_experts)]),
+                "wi": np.stack([lin(m + f"experts.{e}.w3.weight")
+                                for e in range(cfg.num_experts)]),
+                "wo": np.stack([lin(m + f"experts.{e}.w2.weight")
+                                for e in range(cfg.num_experts)]),
+            }
+        else:
+            blk["MLP_0"] = {
                 "wi": lin(p + "mlp.up_proj.weight"),
                 "wg": lin(p + "mlp.gate_proj.weight"),
                 "wo": lin(p + "mlp.down_proj.weight"),
-            },
-        }
+            }
+        bb[f"block_{i}"] = blk
     tree: Dict[str, Any] = {"backbone": bb}
     if not cfg.tie_embeddings:
         if r.has("lm_head.weight"):
@@ -321,10 +349,17 @@ def save_hf_checkpoint(cfg, params, model_path: str) -> None:
 
     tensors: Dict[str, Any] = {}
     if cfg.use_rope and cfg.use_rmsnorm and cfg.gated_mlp:
-        arch = "Qwen2ForCausalLM" if cfg.qkv_bias else "LlamaForCausalLM"
+        moe = bool(cfg.num_experts)
+        if moe and cfg.moe_every != 1:
+            raise ValueError("Mixtral export requires MoE on every layer "
+                             "(moe_every=1)")
+        if moe:
+            arch = "MixtralForCausalLM"
+        else:
+            arch = "Qwen2ForCausalLM" if cfg.qkv_bias else "LlamaForCausalLM"
         hf_cfg = {
             "architectures": [arch],
-            "model_type": "qwen2" if cfg.qkv_bias else "llama",
+            "model_type": arch.replace("ForCausalLM", "").lower(),
             "vocab_size": cfg.vocab_size,
             "hidden_size": H,
             "intermediate_size": cfg.mlp_dim,
@@ -339,11 +374,14 @@ def save_hf_checkpoint(cfg, params, model_path: str) -> None:
             "hidden_act": "silu",
             "torch_dtype": "float32",
         }
+        if moe:
+            hf_cfg["num_local_experts"] = cfg.num_experts
+            hf_cfg["num_experts_per_tok"] = cfg.moe_k
         tensors["model.embed_tokens.weight"] = t(bb["wte"])
         tensors["model.norm.weight"] = t(bb["final_norm"]["scale"])
         for i in range(cfg.num_layers):
             blk = bb[f"block_{i}"]
-            ap, mp = blk["Attention_0"], blk["MLP_0"]
+            ap = blk["Attention_0"]
             p = f"model.layers.{i}."
             tensors[p + "self_attn.q_proj.weight"] = t(
                 np.asarray(ap["wq"]).reshape(H, nh * hd).T)
@@ -363,9 +401,24 @@ def save_hf_checkpoint(cfg, params, model_path: str) -> None:
             tensors[p + "input_layernorm.weight"] = t(blk["Norm_0"]["scale"])
             tensors[p + "post_attention_layernorm.weight"] = t(
                 blk["Norm_1"]["scale"])
-            tensors[p + "mlp.up_proj.weight"] = t(np.asarray(mp["wi"]).T)
-            tensors[p + "mlp.gate_proj.weight"] = t(np.asarray(mp["wg"]).T)
-            tensors[p + "mlp.down_proj.weight"] = t(np.asarray(mp["wo"]).T)
+            if moe:
+                m = p + "block_sparse_moe."
+                mo = blk["moe"]
+                tensors[m + "gate.weight"] = t(np.asarray(mo["gate"]).T)
+                for e in range(cfg.num_experts):
+                    tensors[m + f"experts.{e}.w1.weight"] = t(
+                        np.asarray(mo["wge"][e]).T)
+                    tensors[m + f"experts.{e}.w3.weight"] = t(
+                        np.asarray(mo["wi"][e]).T)
+                    tensors[m + f"experts.{e}.w2.weight"] = t(
+                        np.asarray(mo["wo"][e]).T)
+            else:
+                mp = blk["MLP_0"]
+                tensors[p + "mlp.up_proj.weight"] = t(np.asarray(mp["wi"]).T)
+                tensors[p + "mlp.gate_proj.weight"] = t(
+                    np.asarray(mp["wg"]).T)
+                tensors[p + "mlp.down_proj.weight"] = t(
+                    np.asarray(mp["wo"]).T)
         if not cfg.tie_embeddings:
             tensors["lm_head.weight"] = t(np.asarray(params["lm_head"]).T)
     elif not cfg.use_rope and not cfg.use_rmsnorm and not cfg.gated_mlp:

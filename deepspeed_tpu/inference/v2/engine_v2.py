@@ -129,10 +129,10 @@ class InferenceEngineV2:
         model_cfg = model if isinstance(model, GPTConfig) else model.cfg
         model_cfg = dataclasses.replace(model_cfg, dtype=self.config.jnp_dtype,
                                         dropout=0.0)
-        if model_cfg.num_experts:
+        if model_cfg.num_experts and self.mesh is not None:
             raise NotImplementedError(
-                "v2 ragged serving of MoE models lands with the grouped-GEMM "
-                "kernel; use the v1 engine for MoE")
+                "v2 MoE serving with tensor parallelism: the dropless expert "
+                "route is single-shard; drop the tp config for MoE models")
         self.model_config = model_cfg
 
         if params is None:

@@ -68,6 +68,24 @@ def _mlp(p, x, cfg):
     return y
 
 
+def _ffn(blk, x, cfg):
+    """Dense MLP or MoE block body on FLAT tokens [N, H] — MoE routes through
+    the dropless ragged grouped GEMM (moe/layer.py), which fits serving
+    exactly: the ragged token set per step IS the ragged expert batch
+    (reference inference/v2 MoE gather/scatter + cutlass grouped GEMM,
+    model_implementations/mixtral)."""
+    if "moe" in blk:
+        from deepspeed_tpu.moe.layer import _expert_ffn_ragged
+        from deepspeed_tpu.moe.sharded_moe import dropless_topk
+        mp = blk["moe"]
+        logits = x @ mp["gate"].astype(x.dtype)
+        _, idx, w = dropless_topk(logits, cfg.moe_k)
+        weg = mp["wge"].astype(x.dtype) if "wge" in mp else None
+        return _expert_ffn_ragged(x, idx, w, mp["wi"].astype(x.dtype),
+                                  mp["wo"].astype(x.dtype), weg)
+    return _mlp(blk["MLP_0"], x, cfg)
+
+
 def _qkv(ap, h, cfg, eq):
     """q/k/v projections with optional biases (qwen2/gpt2 checkpoints)."""
     dtype = h.dtype
@@ -135,7 +153,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
     for li in range(cfg.num_layers):
         blk = bb[f"block_{li}"]
-        ap, np_, mp = blk["Attention_0"], blk["Norm_0"], blk["MLP_0"]
+        ap, np_ = blk["Attention_0"], blk["Norm_0"]
         h = _norm(np_, x, cfg)
         q, k, v = _qkv(ap, h, cfg, "nh,hkd->nkd")
         if cfg.use_rope:
@@ -176,8 +194,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         o = jnp.where(valid[:, None, None], o, 0)
         x = x + _attn_out(ap, o, cfg, "nkd,kdh->nh")
 
-        # ---- MLP ----
-        x = x + _mlp(mp, _norm(blk["Norm_1"], x, cfg), cfg)
+        # ---- MLP / MoE ----
+        x = x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
 
     x = _norm(bb["final_norm"], x, cfg)
 
@@ -245,7 +263,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
                                 mesh=mesh)
         o = o.reshape(S, nh, hd)
         x = x + _attn_out(ap, o, cfg, "skd,kdh->sh")
-        x = x + _mlp(blk["MLP_0"], _norm(blk["Norm_1"], x, cfg), cfg)
+        x = x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
 
     x = _norm(bb["final_norm"], x, cfg)
     if cfg.tie_embeddings:

@@ -364,9 +364,11 @@ class Block(nn.Module):
             moe_out, aux = MoE(hidden_size=c.hidden_size,
                                num_experts=c.num_experts, k=c.moe_k,
                                capacity_factor=c.moe_capacity_factor,
-                               mlp_ratio=c.mlp_ratio, mesh=self.mesh,
+                               mlp_ratio=c.mlp_ratio, mlp_dim=c.mlp_dim,
+                               mesh=self.mesh,
                                param_dtype=c.param_dtype,
                                dropless=c.moe_dropless,
+                               gated=c.gated_mlp,
                                name="moe")(Norm(c)(x), rng, deterministic)
             x = x + moe_out
         else:

@@ -33,15 +33,19 @@ def _part(init, names):
     return nn.with_partitioning(init, names)
 
 
-def _expert_ffn(d, wi, wo):
+def _expert_ffn(d, wi, wo, wg=None):
     """Grouped expert FFN: one big [E,...] einsum (MXU grouped matmul) instead of
-    the reference's per-expert module list (moe/experts.py)."""
+    the reference's per-expert module list (moe/experts.py).  wg (per-expert
+    gate, [E, H, M]) switches GELU → SwiGLU (Mixtral experts)."""
     h = jnp.einsum("ech,ehm->ecm", d, wi.astype(d.dtype))
-    h = nn.gelu(h)
+    if wg is not None:
+        h = nn.silu(jnp.einsum("ech,ehm->ecm", d, wg.astype(d.dtype))) * h
+    else:
+        h = nn.gelu(h)
     return jnp.einsum("ecm,emh->ech", h, wo.astype(d.dtype))
 
 
-def _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo):
+def _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo, wg=None):
     """Dropless grouped GEMM via ``lax.ragged_dot`` (megablox semantics —
     reference analog: inference/v2 MoE gather/scatter + cutlass grouped GEMM,
     and the MegaBlocks paper): tokens sort by expert, each expert multiplies
@@ -57,7 +61,11 @@ def _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo):
     sorted_tok = tokens[tok_rows]
     group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
     h = jax.lax.ragged_dot(sorted_tok, wi.astype(tokens.dtype), group_sizes)
-    h = nn.gelu(h)
+    if wg is not None:
+        h = nn.silu(jax.lax.ragged_dot(sorted_tok, wg.astype(tokens.dtype),
+                                       group_sizes)) * h
+    else:
+        h = nn.gelu(h)
     o = jax.lax.ragged_dot(h, wo.astype(tokens.dtype), group_sizes)
     w = weights.reshape(-1)[order].astype(o.dtype)
     return jnp.zeros_like(tokens).at[tok_rows].add(o * w[:, None])
@@ -80,17 +88,21 @@ class MoE(nn.Module):
     noisy_gate_policy: Optional[str] = None
     use_residual: bool = False
     mlp_ratio: int = 4
+    mlp_dim: Optional[int] = None       # explicit FFN width (Mixtral 14336)
     mesh: Optional[Mesh] = None
     param_dtype: object = jnp.float32
     # dropless routing (ragged grouped GEMM, no capacity/no token drops);
     # ep>1 keeps the capacity path (the A2A needs static per-rank shapes)
     dropless: bool = False
+    # SwiGLU experts (per-expert gate matrix — Mixtral style)
+    gated: bool = False
 
     @nn.compact
     def __call__(self, x, rng: Optional[jax.Array] = None,
                  deterministic: bool = False):
         B, T, H = x.shape
-        E, M = self.num_experts, self.hidden_size * self.mlp_ratio
+        E = self.num_experts
+        M = self.mlp_dim or self.hidden_size * self.mlp_ratio
         cf = self.eval_capacity_factor if deterministic else self.capacity_factor
         k_init = nn.initializers.normal(stddev=0.02)
 
@@ -100,6 +112,9 @@ class MoE(nn.Module):
                         (E, H, M), self.param_dtype)
         wo = self.param("wo", _part(k_init, ("expert", "mlp", "embed")),
                         (E, M, H), self.param_dtype)
+        weg = (self.param("wge", _part(k_init, ("expert", "embed", "mlp")),
+                          (E, H, M), self.param_dtype)
+               if self.gated else None)    # per-expert SwiGLU gate (Mixtral)
 
         tokens = x.reshape(B * T, H)
         logits = tokens @ wg.astype(x.dtype)
@@ -115,18 +130,18 @@ class MoE(nn.Module):
             from deepspeed_tpu.moe.sharded_moe import dropless_topk
             aux, expert_idx, weights = dropless_topk(logits, self.k, rng,
                                                      noise_std)
-            out = _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo)
+            out = _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo, weg)
             return self._finish(x, out.reshape(B, T, H), aux, k_init)
 
         aux, combine, dispatch = topk_gating(
             logits, self.k, cf, self.min_capacity, rng, noise_std)
 
         if ep > 1:
-            out = _ep_route(self.mesh, tokens, combine, dispatch, wi, wo)
+            out = _ep_route(self.mesh, tokens, combine, dispatch, wi, wo, weg)
         else:
             dispatched = jnp.einsum("sec,sh->ech",
                                     dispatch.astype(x.dtype), tokens)
-            expert_out = _expert_ffn(dispatched, wi, wo)
+            expert_out = _expert_ffn(dispatched, wi, wo, weg)
             out = jnp.einsum("sec,ech->sh", combine.astype(x.dtype), expert_out)
 
         return self._finish(x, out.reshape(B, T, H), aux, k_init)
@@ -149,7 +164,7 @@ class MoE(nn.Module):
         return out, aux
 
 
-def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo):
+def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo, weg=None):
     """all-to-all route (reference sharded_moe.py MOELayer.forward): dispatch
     einsum → A2A (tokens meet their expert owners) → local experts → A2A back →
     combine einsum, inside shard_map over the ep axis.
@@ -164,12 +179,14 @@ def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo):
     # parallel groups, utils/groups.py:114); expert weights live on ep only.
     tok_spec = P(("dp", "fsdp", "ep"), None)
     sec_spec = P(("dp", "fsdp", "ep"), None, None)
+    w_spec = P("ep", None, None)
+    gated = weg is not None
+    in_specs = (tok_spec, sec_spec, sec_spec, w_spec, w_spec) + \
+        ((w_spec,) if gated else ())
 
-    @partial(shard_map, mesh=mesh,
-             in_specs=(tok_spec, sec_spec, sec_spec,
-                       P("ep", None, None), P("ep", None, None)),
+    @partial(shard_map, mesh=mesh, in_specs=in_specs,
              out_specs=tok_spec, check_vma=False)
-    def route(tokens, combine, dispatch, wi, wo):
+    def route(tokens, combine, dispatch, wi, wo, *maybe_weg):
         # local shapes: tokens [S/(dp·fsdp·ep), H]; combine/dispatch [S', E, C];
         # wi [E/ep, H, M]; wo [E/ep, M, H]
         dispatched = jnp.einsum("sec,sh->ech",
@@ -177,10 +194,12 @@ def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo):
         # [E, C, H] → [E/ep, C*ep, H]
         dispatched = lax.all_to_all(dispatched, "ep", split_axis=0,
                                     concat_axis=1, tiled=True)
-        expert_out = _expert_ffn(dispatched, wi, wo)
+        expert_out = _expert_ffn(dispatched, wi, wo,
+                                 maybe_weg[0] if maybe_weg else None)
         expert_out = lax.all_to_all(expert_out, "ep", split_axis=1,
                                     concat_axis=0, tiled=True)
         return jnp.einsum("sec,ech->sh", combine.astype(tokens.dtype),
                           expert_out)
 
-    return route(tokens, combine, dispatch, wi, wo)
+    args = (tokens, combine, dispatch, wi, wo) + ((weg,) if gated else ())
+    return route(*args)

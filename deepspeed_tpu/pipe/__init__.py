@@ -1,4 +1,6 @@
+from deepspeed_tpu.pipe.generic import LayerSpec, PipelineModule
 from deepspeed_tpu.pipe.module import PipeGPT, gpt_params_to_pipe
-from deepspeed_tpu.pipe.schedule import pipeline_forward
+from deepspeed_tpu.pipe.schedule import make_pipeline_loss, pipeline_forward
 
-__all__ = ["PipeGPT", "gpt_params_to_pipe", "pipeline_forward"]
+__all__ = ["PipeGPT", "gpt_params_to_pipe", "pipeline_forward",
+           "LayerSpec", "PipelineModule", "make_pipeline_loss"]

@@ -1,0 +1,148 @@
+"""Generic pipeline container — LayerSpec lists over arbitrary flax layers.
+
+Reference parity: ``runtime/pipe/module.py`` — ``LayerSpec`` (:30, lazy layer
+construction), ``PipelineModule`` (:86, "the forward pass is implicitly
+defined by the module ``layers``... output of each layer feeds the next"),
+``partition_method`` (:370 — uniform here; stages must be structurally
+identical for SPMD stacking, the transformer case).
+
+TPU-native: per-layer param trees stack on a leading [S, L/S, ...] pp-sharded
+axis (pipe/module.py machinery) and the schedule is the shared 1F1B fused
+scan / GPipe scan from pipe/schedule.py.  The embedding ("stage -1") and loss
+head ("stage S") are explicit modules — in the reference they are just the
+first/last LayerSpecs, but folding them into the schedule is what gives the
+1F1B path its O(stages) memory, so they are first-class here.
+
+Constraint vs the reference: every pipelined layer must share ONE param
+structure (same module class/shapes).  Heterogeneous bodies — e.g. conv stem
+then transformer — belong in the embed/head modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.pipe.module import _stack_layer_params, _unbox_one
+from deepspeed_tpu.pipe.schedule import make_pipeline_loss, pipeline_forward
+
+
+class LayerSpec:
+    """Lazy layer description (reference pipe/module.py:30): the module is
+    built per layer at init time, so N layers cost N param trees, not N live
+    module graphs."""
+
+    def __init__(self, typename: Callable[..., nn.Module], *args, **kwargs):
+        if not callable(typename):
+            raise TypeError(f"LayerSpec typename must be a flax module "
+                            f"class/factory, got {type(typename)!r}")
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self) -> nn.Module:
+        return self.typename(*self.args, **self.kwargs)
+
+
+class PipelineModule:
+    """Engine model contract ((init, apply) + is_pipeline) over a LayerSpec
+    list.
+
+    layers: LayerSpecs (or prebuilt modules) with IDENTICAL param structure;
+      each maps activation → activation: ``module.apply(vars, x) -> x``.
+    embed: flax module, ``apply(vars, batch_micro) -> x`` (stage-0 input).
+    head: flax module, ``apply(vars, y, batch_micro) -> scalar`` per-micro
+      loss (summed across microbatches, divided by M — return a mean within
+      the micro for the usual convention).
+    """
+
+    is_pipeline = True
+    mesh = None
+
+    def __init__(self, layers: Sequence[Any], num_stages: int, *,
+                 embed: nn.Module, head: nn.Module,
+                 schedule: str = "1f1b"):
+        if len(layers) % num_stages:
+            raise ValueError(f"{len(layers)} layers not divisible by "
+                             f"{num_stages} stages")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.layers = [sp.build() if isinstance(sp, LayerSpec) else sp
+                       for sp in layers]
+        self.num_stages = num_stages
+        self.embed = embed
+        self.head = head
+        self.schedule = schedule
+
+    # ------------------------------------------------------------ contract
+    def _micro(self, batch, m: Optional[int] = None):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a)[m] if m is not None else jnp.asarray(a),
+            batch)
+
+    def init(self, rng, batch):
+        from deepspeed_tpu.parallel.metadata import unbox
+        bm = self._micro(batch, 0)
+        k_embed, k_layers, k_head = jax.random.split(rng, 3)
+        embed_vars = unbox(self.embed.init(k_embed, bm))
+        x = self.embed.apply(embed_vars, bm)
+        layer_params = []
+        for i, layer in enumerate(self.layers):
+            v = unbox(layer.init(jax.random.fold_in(k_layers, i), x))
+            layer_params.append(v["params"])
+        head_vars = unbox(self.head.init(k_head, x, bm))
+        return {"params": {
+            "embed": embed_vars.get("params", {}),
+            "layers": _stack_layer_params(layer_params, self.num_stages),
+            "head": head_vars.get("params", {}),   # param-free heads allowed
+        }}
+
+    def apply(self, variables, batch, rng=None):
+        del rng   # deterministic container; dropout-bearing stacks use PipeGPT
+        p = variables["params"]
+        layer0 = self.layers[0]
+        M = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        stage_params = jax.tree_util.tree_map(
+            _unbox_one, p["layers"],
+            is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+        def embed_fn(ep, bm):
+            return self.embed.apply({"params": ep}, bm)
+
+        def stage_fn(sp, _aux, x):
+            def body(h, lp):
+                return layer0.apply({"params": lp}, h), None
+            h, _ = lax.scan(body, x, sp)
+            return h
+
+        def head_fn(hp, y, bm):
+            return jnp.asarray(
+                self.head.apply({"params": hp}, y, bm), jnp.float32)
+
+        ep = jax.tree_util.tree_map(_unbox_one, p["embed"])
+        hp = jax.tree_util.tree_map(_unbox_one, p["head"])
+        aux = jnp.zeros((self.num_stages, 1), jnp.uint32)
+
+        if self.schedule == "1f1b":
+            loss_fn = make_pipeline_loss(embed_fn, stage_fn, head_fn)
+            return loss_fn(ep, stage_params, hp, aux, batch) / M
+
+        # per-microbatch embed (vmap over the leading M axis — matches the
+        # 1F1B path's micro-at-a-time contract for dict AND array batches)
+        x = jax.vmap(lambda bm: embed_fn(ep, bm))(batch)
+        outs = pipeline_forward(lambda sp_aux, h: stage_fn(*sp_aux, h),
+                                (stage_params, aux), x)
+
+        def micro_loss(s, xs):
+            m_idx, y = xs
+            bm = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[m_idx],
+                                        batch)
+            return s + head_fn(hp, y, bm), None
+
+        total, _ = lax.scan(micro_loss, jnp.float32(0.0),
+                            (jnp.arange(M), outs))
+        return total / M
