@@ -18,8 +18,10 @@ Layout mirrors the reference's ``save_dir/tag/...`` + ``latest`` tag file
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import threading
 from typing import Any, Optional, Tuple
 
 import jax
@@ -28,23 +30,81 @@ import orbax.checkpoint as ocp
 
 LATEST_FILE = "latest"
 
+# one long-lived async checkpointer (orbax guidance; a fresh instance per save
+# would serialize on its own setup) + a waiter thread for deferred metadata
+_CKPTR: Optional[ocp.StandardCheckpointer] = None
+_PENDING: Optional[threading.Thread] = None
+_PENDING_ERROR: Optional[BaseException] = None
+
+
+def _checkpointer() -> ocp.StandardCheckpointer:
+    global _CKPTR
+    if _CKPTR is None:
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def wait_pending() -> None:
+    """Block until any in-flight async save fully commits (metadata
+    included) and RE-RAISE any failure from the background write — a lost
+    checkpoint must not look like a successful one.  Registered atexit so
+    in-flight saves flush even when the caller forgets."""
+    global _PENDING, _PENDING_ERROR
+    if _PENDING is not None:
+        _PENDING.join()
+        _PENDING = None
+    if _CKPTR is not None:
+        _CKPTR.wait_until_finished()
+    if _PENDING_ERROR is not None:
+        err, _PENDING_ERROR = _PENDING_ERROR, None
+        raise RuntimeError("async checkpoint save failed") from err
+
+
+atexit.register(wait_pending)
+
 
 def _ckpt_path(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), tag, "state")
 
 
-def save_train_state(save_dir: str, tag: str, state, client_state: dict = None
-                     ) -> str:
-    path = _ckpt_path(save_dir, tag)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state, force=True)
-    ckptr.wait_until_finished()
+def _write_meta(save_dir: str, tag: str, client_state: dict) -> None:
     if jax.process_index() == 0:
         with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
             json.dump(client_state or {}, f)
-        # reference: 'latest' tag file (engine.py _save_checkpoint)
+        # reference: 'latest' tag file (engine.py _save_checkpoint) — written
+        # only once the checkpoint is committed, so 'latest' never points at
+        # a partial save
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(tag)
+
+
+def save_train_state(save_dir: str, tag: str, state, client_state: dict = None,
+                     block: bool = True) -> str:
+    """Save the train state.  ``block=False`` returns as soon as the on-device
+    arrays are snapshotted — the write streams in the background while
+    training continues (reference async_io/decoupled checkpointing; orbax
+    AsyncCheckpointer), and the 'latest' pointer lands on commit."""
+    global _PENDING
+    wait_pending()                       # serialize with any previous save
+    path = _ckpt_path(save_dir, tag)
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=True)
+    if block:
+        ckptr.wait_until_finished()
+        _write_meta(save_dir, tag, client_state)
+        return path
+
+    def _finish():
+        global _PENDING_ERROR
+        try:
+            ckptr.wait_until_finished()
+            _write_meta(save_dir, tag, client_state)
+        except BaseException as e:  # noqa: BLE001 — surfaced by wait_pending
+            _PENDING_ERROR = e
+
+    # non-daemon: the atexit wait_pending() must be able to join it
+    _PENDING = threading.Thread(target=_finish, daemon=False)
+    _PENDING.start()
     return path
 
 
@@ -60,12 +120,12 @@ def restore_train_state(load_dir: str, tag: str, shardings, like_state
                         ) -> Tuple[Any, dict]:
     """Restore into the given shardings (resharding on load is free — this is the
     universal-checkpoint capability, reference checkpoint/ds_to_universal.py)."""
+    wait_pending()                       # a racing async save must commit
     path = _ckpt_path(load_dir, tag)
     abstract = jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         like_state, shardings)
-    ckptr = ocp.StandardCheckpointer()
-    state = ckptr.restore(path, abstract)
+    state = _checkpointer().restore(path, abstract)
     cs_path = os.path.join(load_dir, tag, "client_state.json")
     client_state = {}
     if os.path.exists(cs_path):

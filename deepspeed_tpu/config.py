@@ -368,9 +368,7 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
                      "quantized grad reduce-scatter; the collective exists — "
                      "ops/quantization.quantized_psum_scatter — but the "
                      "engine grad path does not route through it yet)")
-    if z.zero_hpz_partition_size != 1:
-        inert.append("zero_optimization.zero_hpz_partition_size "
-                     "(hierarchical secondary partitions)")
+    # zero_hpz_partition_size at stage<3 is a hard engine error (not inert)
     ac = cfg.activation_checkpointing
     if ac.partition_activations or ac.cpu_checkpointing or ac.number_checkpoints:
         inert.append("activation_checkpointing.partition_activations/"

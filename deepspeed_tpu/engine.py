@@ -217,6 +217,23 @@ class DeepSpeedTPUEngine:
         rng = jax.random.PRNGKey(config.seed)
         boxed = jax.eval_shape(self._init_fn, rng, example_batch)
         annotated = annotate_abstract(boxed)
+
+        # hpZ (reference zero_hpz_partition_size,
+        # partition_parameters.py:1653): PARAMS shard only within the
+        # fsdp subgroup (fwd/bwd gathers ride intra-group ICI) while
+        # optimizer state + grads shard over the FULL (fsdp, dp) world
+        hpz = config.zero_optimization.zero_hpz_partition_size
+        self._state_fsdp_axes = ("fsdp",)
+        if hpz and hpz > 1:
+            if self.zero_stage < 3:
+                raise ValueError("zero_hpz_partition_size requires stage 3")
+            if mesh.shape["fsdp"] != hpz:
+                raise ValueError(
+                    f"zero_hpz_partition_size={hpz} must equal the fsdp mesh "
+                    f"axis ({mesh.shape['fsdp']}); set mesh "
+                    f"{{'fsdp': {hpz}, 'dp': -1}} so dp carries the "
+                    f"cross-group replicas")
+            self._state_fsdp_axes = ("fsdp", "dp")
         self.param_shardings = partition.param_shardings(
             annotated, mesh, self.zero_stage)
         abstract_params = jax.tree_util.tree_map(
@@ -232,7 +249,8 @@ class DeepSpeedTPUEngine:
         else:
             abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
             self.opt_shardings = partition.opt_state_shardings(
-                abstract_opt, annotated, mesh, self.zero_stage)
+                abstract_opt, annotated, mesh, self.zero_stage,
+                fsdp_axes=self._state_fsdp_axes)
 
         self.state_shardings = TrainState(
             step=NamedSharding(mesh, P()),
@@ -245,7 +263,8 @@ class DeepSpeedTPUEngine:
         # grad accumulation buffers: sharded like optimizer state at stage ≥ 2
         # (ZeRO-2 gradient partitioning, reference stage_1_and_2.py:1361)
         self.grad_shardings = partition.state_leaf_shardings(
-            annotated, mesh, self.zero_stage if self.zero_stage >= 2 else 0)
+            annotated, mesh, self.zero_stage if self.zero_stage >= 2 else 0,
+            fsdp_axes=self._state_fsdp_axes)
 
         # staged QAT groups (compression/basic.py); empty = off
         from deepspeed_tpu.compression import parse_compression_config
@@ -380,12 +399,18 @@ class DeepSpeedTPUEngine:
             inner, opt_params = optimizers.build_optimizer(
                 cfg.optimizer.type, params)
         chain = []
-        if cfg.gradient_compression.enabled:
-            # error-feedback compressed grads (1-bit-optimizer analog,
-            # runtime/compression.py) — BEFORE clipping so the clip sees the
-            # signal the optimizer will consume
+        # error-feedback compressed grads (runtime/compression.py) — BEFORE
+        # clipping so the clip sees the signal the optimizer will consume.
+        # Requested either via the gradient_compression block or by a 1-bit
+        # optimizer NAME (reference fp16/onebit/); one stage either way, with
+        # the block's dtype as the single knob
+        wants_onebit = (client_optimizer is None
+                        and optimizers.is_onebit(cfg.optimizer.type))
+        if cfg.gradient_compression.enabled or wants_onebit:
             from deepspeed_tpu.runtime.compression import compress_gradients
-            chain.append(compress_gradients(cfg.gradient_compression.dtype))
+            dtype = (cfg.gradient_compression.dtype
+                     if cfg.gradient_compression.enabled else "int8")
+            chain.append(compress_gradients(dtype))
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
             chain.append(optax.clip_by_global_norm(cfg.gradient_clipping))
         chain.append(inner)
@@ -937,14 +962,19 @@ class DeepSpeedTPUEngine:
     # ------------------------------------------------------------------ ckpt
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
-                        client_state: Optional[dict] = None):
+                        client_state: Optional[dict] = None,
+                        async_save: bool = False):
         """reference engine.save_checkpoint (engine.py:3056): sharded save via
-        orbax; every process participates (global-view jax.Arrays)."""
+        orbax; every process participates (global-view jax.Arrays).
+        ``async_save=True`` returns once device arrays are snapshotted and
+        streams the write in the background (call
+        ``deepspeed_tpu.checkpoint.wait_pending()`` before exiting)."""
         from deepspeed_tpu.checkpoint import save_train_state
         tag = tag or f"global_step{self.global_steps}"
         save_train_state(save_dir, tag, self.state,
                          client_state=dict(client_state or {},
-                                           global_steps=self.global_steps))
+                                           global_steps=self.global_steps),
+                         block=not async_save)
         if self.offloading and jax.process_index() == 0:
             # host-resident masters/moments ride alongside the orbax tree
             # (reference: _save_zero_checkpoint per-rank optimizer shards)

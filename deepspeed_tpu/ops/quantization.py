@@ -49,7 +49,7 @@ def quantize_blockwise(x, *, bits: int = 8,
     if bits not in (2, 4, 8):
         raise ValueError(f"bits must be 2, 4, or 8, got {bits}")
     orig_shape, orig_dtype = x.shape, x.dtype
-    flat, n = _pad_to_blocks(x.reshape(-1).astype(jnp.float32), block_size)
+    flat, _ = _pad_to_blocks(x.reshape(-1).astype(jnp.float32), block_size)
     blocks = flat.reshape(-1, block_size)
     qmax = float(2 ** (bits - 1) - 1)
     absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
@@ -238,3 +238,41 @@ def make_param_store(params, *, bits: int = 8, block_size: int = 128):
         return jax.tree_util.tree_unflatten(treedef, out)
 
     return stored, materialize
+
+
+# ------------------------------------------------------------- fp8 (FP6-LLM)
+_FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+
+
+def quantize_fp8(x, *, fmt: str = "e4m3",
+                 block_size: int = 256) -> QuantizedBlocks:
+    """Blockwise-scaled fp8 quantization — the FP-quantizer analog
+    (reference csrc/fp_quantizer/fp_quantize.cu: FP6/FP8/FP12 bit-packed
+    formats for weight storage).  On TPU the natural targets are the NATIVE
+    XLA fp8 dtypes (float8_e4m3fn / float8_e5m2); each block carries one fp32
+    scale so the fp8 dynamic range is centered on the block's magnitude.
+
+    values dtype is jnp.float8_*; fp8 blocks dequantize with
+    ``dequantize_fp8`` (the int path keeps ``dequantize_blockwise``)."""
+    if fmt not in _FP8_MAX:
+        raise ValueError(f"fmt must be one of {sorted(_FP8_MAX)}, got {fmt!r}")
+    dt = jnp.float8_e4m3fn if fmt == "e4m3" else jnp.float8_e5m2
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat, _ = _pad_to_blocks(x.reshape(-1).astype(jnp.float32), block_size)
+    blocks = flat.reshape(-1, block_size)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scales = absmax / _FP8_MAX[fmt]
+    inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
+    q = (blocks * inv).astype(dt)
+    return QuantizedBlocks(values=q, scales=scales, shape=orig_shape,
+                           dtype=orig_dtype, bits=8, block_size=block_size)
+
+
+def dequantize_fp8(qb: QuantizedBlocks) -> jax.Array:
+    # fp8 values cast-to-fp32 ARE their numeric values, so the generic
+    # astype-multiply-trim path applies unchanged (bits=8 ⇒ no nibble unpack)
+    return dequantize_blockwise(qb)
+
+
+def quantize_dequantize_fp8(x, *, fmt: str = "e4m3", block_size: int = 256):
+    return dequantize_fp8(quantize_fp8(x, fmt=fmt, block_size=block_size))

@@ -55,11 +55,17 @@ DEFAULT_RULES = (
 
 
 def rules_for_stage(zero_stage: int, base: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+                    fsdp_axes: Tuple[str, ...] = ("fsdp",),
                     ) -> Tuple[Tuple[str, Any], ...]:
+    """fsdp_axes widens the ZeRO shard target: ("fsdp",) is plain ZeRO;
+    ("fsdp", "dp") is the hpZ/full-world placement (optimizer state sharded
+    across every chip while params keep the intra-group axis — reference
+    zero_hpz_partition_size, runtime/zero/partition_parameters.py:1653)."""
+    fsdp = fsdp_axes[0] if len(fsdp_axes) == 1 else tuple(fsdp_axes)
     out = []
     for name, axis in base:
         if name == "embed" and zero_stage >= 3:
-            axis = "fsdp"
+            axis = fsdp
         out.append((name, axis))
     return tuple(out)
 
@@ -90,12 +96,16 @@ def logical_to_mesh_pspec(logical_axes: Sequence[Optional[str]],
 
 
 def _heuristic_fsdp_pspec(shape: Sequence[int], mesh: Mesh,
-                          existing: Optional[P] = None) -> P:
-    """Shard the largest divisible dim over 'fsdp' (the shape-only fallback when a
-    param carries no logical metadata) — the analog of the reference's flat-buffer
-    round-robin partitioning (stage_1_and_2.py:646), but per-tensor and even.
+                          existing: Optional[P] = None,
+                          fsdp_axes: Tuple[str, ...] = ("fsdp",)) -> P:
+    """Shard the largest divisible dim over the fsdp axes (the shape-only
+    fallback when a param carries no logical metadata) — the analog of the
+    reference's flat-buffer round-robin partitioning (stage_1_and_2.py:646),
+    but per-tensor and even.
     """
-    n = mesh.shape.get("fsdp", 1)
+    n = 1
+    for a in fsdp_axes:
+        n *= mesh.shape.get(a, 1)
     spec = list(existing) if existing is not None else [None] * len(shape)
     while len(spec) < len(shape):
         spec.append(None)
@@ -109,7 +119,7 @@ def _heuristic_fsdp_pspec(shape: Sequence[int], mesh: Mesh,
     if not candidates:
         return P(*spec)
     _, idx = max(candidates)
-    spec[idx] = "fsdp"
+    spec[idx] = fsdp_axes[0] if len(fsdp_axes) == 1 else tuple(fsdp_axes)
     return P(*spec)
 
 
@@ -122,14 +132,15 @@ def _leaf_logical_axes(leaf) -> Optional[Tuple[Optional[str], ...]]:
 
 
 def infer_pspec(leaf, mesh: Mesh, zero_stage: int, sharded: bool,
-                rules: Optional[Sequence[Tuple[str, Any]]] = None) -> P:
+                rules: Optional[Sequence[Tuple[str, Any]]] = None,
+                fsdp_axes: Tuple[str, ...] = ("fsdp",)) -> P:
     """PartitionSpec for one param/state leaf.
 
     sharded=True → apply fsdp sharding (params at stage 3; optimizer state at
     stage ≥ 1).  TP/EP axes from logical metadata always apply.
     """
     rules = rules_for_stage(zero_stage if sharded else 0,
-                            rules or DEFAULT_RULES)
+                            rules or DEFAULT_RULES, fsdp_axes=fsdp_axes)
     shape = leaf.shape
     if len(shape) == 0:
         return P()
@@ -137,7 +148,7 @@ def infer_pspec(leaf, mesh: Mesh, zero_stage: int, sharded: bool,
     spec = (logical_to_mesh_pspec(axes, rules, mesh, shape)
             if axes is not None else P(*([None] * len(shape))))
     if sharded:
-        spec = _heuristic_fsdp_pspec(shape, mesh, spec)
+        spec = _heuristic_fsdp_pspec(shape, mesh, spec, fsdp_axes=fsdp_axes)
     return spec
 
 
@@ -152,18 +163,20 @@ def param_shardings(abstract_params, mesh: Mesh, zero_stage: int,
 
 
 def state_leaf_shardings(abstract_params, mesh: Mesh, zero_stage: int,
-                         rules: Optional[Sequence[Tuple[str, Any]]] = None):
+                         rules: Optional[Sequence[Tuple[str, Any]]] = None,
+                         fsdp_axes: Tuple[str, ...] = ("fsdp",)):
     """NamedSharding tree for param-shaped optimizer state (sharded iff stage ≥ 1)."""
     def fn(leaf):
         spec = infer_pspec(leaf, mesh, zero_stage, sharded=zero_stage >= 1,
-                           rules=rules)
+                           rules=rules, fsdp_axes=fsdp_axes)
         return NamedSharding(mesh, spec)
     return jax.tree_util.tree_map(fn, abstract_params)
 
 
 def opt_state_shardings(abstract_opt_state, abstract_params, mesh: Mesh,
                         zero_stage: int,
-                        rules: Optional[Sequence[Tuple[str, Any]]] = None):
+                        rules: Optional[Sequence[Tuple[str, Any]]] = None,
+                        fsdp_axes: Tuple[str, ...] = ("fsdp",)):
     """Sharding tree for a full optax state.
 
     Optax states are pytrees whose nodes either mirror the param tree (mu, nu,
@@ -173,7 +186,8 @@ def opt_state_shardings(abstract_opt_state, abstract_params, mesh: Mesh,
     (stage_1_and_2.py single_partition_of_fp32_groups).
     """
     pstruct = jax.tree_util.tree_structure(abstract_params)
-    mirror_shardings = state_leaf_shardings(abstract_params, mesh, zero_stage, rules)
+    mirror_shardings = state_leaf_shardings(abstract_params, mesh, zero_stage,
+                                            rules, fsdp_axes=fsdp_axes)
     param_is_leaf = pstruct.num_leaves == 1 and jax.tree_util.tree_structure(
         jax.tree_util.tree_leaves(abstract_params)[0]) == pstruct
 

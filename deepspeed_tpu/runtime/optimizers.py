@@ -32,8 +32,8 @@ _CANON = {
     "fusedadam": "adamw",       # fused == XLA-fused here
     "lamb": "lamb",
     "fusedlamb": "lamb",
-    "onebitadam": "adam",       # see module docstring: compression handled at comm tier
-    "onebitlamb": "lamb",
+    "onebitadam": "adam",       # engine chains error-feedback compression
+    "onebitlamb": "lamb",       # for these names (see is_onebit)
     "zerooneadam": "adam",
     "lion": "lion",
     "fusedlion": "lion",
@@ -104,3 +104,11 @@ def build_optimizer(name: str, params: Optional[Dict[str, Any]] = None,
 
     resolved = dict(lr=lr, weight_decay=weight_decay, betas=betas, eps=eps, **params)
     return tx, resolved
+
+
+def is_onebit(name: str) -> bool:
+    """1-bit family (reference runtime/fp16/onebit/): the engine chains the
+    error-feedback compression stage (runtime/compression.py) for these names
+    — build_optimizer itself returns the plain base optimizer so the
+    compression knob lives in ONE place (the gradient_compression block)."""
+    return name.lower().replace("_", "").startswith(("onebit", "zeroone"))

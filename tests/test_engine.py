@@ -194,3 +194,72 @@ class TestMiCS:
                     "zero_optimization": {"stage": 2, "mics_shard_size": 4},
                 }, example_batch={"input_ids": rng.integers(
                     0, 64, size=(8, 16)).astype(np.int32)})
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_then_load(self, devices, rng, tmp_path):
+        """async_save returns immediately; wait_pending commits; 'latest'
+        only appears once the checkpoint is complete."""
+        import deepspeed_tpu.checkpoint as ckpt
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=16)
+        pool = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "mesh": {"dp": 1}, "steps_per_print": 0,
+            }, example_batch={"input_ids": pool})
+        engine.train_batch({"input_ids": pool})
+        tag = engine.save_checkpoint(str(tmp_path), async_save=True)
+        # training continues while the write streams
+        engine.train_batch({"input_ids": pool})
+        ckpt.wait_pending()
+        assert ckpt.latest_tag(str(tmp_path)) == tag
+        loaded_tag, cs = engine.load_checkpoint(str(tmp_path))
+        assert loaded_tag == tag
+        assert cs["global_steps"] == 1
+
+
+class TestHpZ:
+    """ZeRO++ hpZ (reference zero_hpz_partition_size): params shard within
+    the fsdp subgroup only, optimizer state/grads over the full world."""
+
+    def test_shardings_and_training(self, devices, rng):
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        pool = rng.integers(0, 128, size=(8, 32)).astype(np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3,
+                                      "zero_hpz_partition_size": 4},
+                "mesh": {"fsdp": 4, "dp": -1},
+                "steps_per_print": 0,
+            }, example_batch={"input_ids": pool})
+        pspecs = [str(s.spec) for s in
+                  jax.tree_util.tree_leaves(engine.param_shardings)]
+        ospecs = [str(s.spec) for s in
+                  jax.tree_util.tree_leaves(engine.opt_shardings)]
+        # params: subgroup (fsdp) only — never dp
+        assert any("fsdp" in s for s in pspecs)
+        assert not any("'dp'" in s for s in ospecs[0:0] + pspecs)
+        # optimizer state: full world — fsdp AND dp together on some leaf
+        assert any("fsdp" in s and "'dp'" in s for s in ospecs), ospecs[:5]
+        m = engine.train_batch({"input_ids": pool})
+        assert np.isfinite(float(m.loss))
+
+    def test_requires_matching_mesh(self, rng):
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=16)
+        with pytest.raises(ValueError, match="fsdp mesh"):
+            deepspeed_tpu.initialize(
+                model=GPT(cfg), config={
+                    "train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3,
+                                          "zero_hpz_partition_size": 2},
+                    "mesh": {"fsdp": 4, "dp": -1},
+                }, example_batch={"input_ids": rng.integers(
+                    0, 64, (8, 16)).astype(np.int32)})
