@@ -1,0 +1,4 @@
+"""Data stack — memory-mapped indexed datasets + batch assembly."""
+
+from deepspeed_tpu.data.indexed_dataset import (  # noqa: F401
+    MMapIndexedDataset, TokenBatchDataset, write_indexed_dataset)
