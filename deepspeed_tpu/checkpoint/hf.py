@@ -29,12 +29,30 @@ import numpy as np
 from deepspeed_tpu.utils.logging import log_dist
 
 # architectures served by the GPT-family tree (reference zoo:
-# inference/v2/model_implementations/{llama_v2,mistral,qwen_v2,...},
-# module_inject/containers/gpt2.py)
+# inference/v2/model_implementations/{llama_v2,mistral,mixtral,qwen_v2,opt,
+# phi,falcon}, module_inject/containers/{gpt2,opt}.py)
 _LLAMA_LIKE = {"LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
                "MixtralForCausalLM"}
 _GPT2_LIKE = {"GPT2LMHeadModel"}
-SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE)
+_OPT_LIKE = {"OPTForCausalLM"}
+_PHI_LIKE = {"PhiForCausalLM"}
+_FALCON_LIKE = {"FalconForCausalLM"}
+SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
+                                 | _PHI_LIKE | _FALCON_LIKE)
+
+
+# HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
+# "gelu_new"/"gelu_pytorch_tanh" are the tanh approximation)
+_HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
+           "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+
+
+def _map_activation(arch: str, name: str) -> str:
+    try:
+        return _HF_ACT[name]
+    except KeyError:
+        raise ValueError(f"{arch}: activation {name!r} is not implemented; "
+                         f"supported: {sorted(_HF_ACT)}") from None
 
 
 def _read_json(path: str) -> Dict[str, Any]:
@@ -138,6 +156,116 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             tie_embeddings=True,
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
             qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _OPT_LIKE:
+        # reference module_inject/containers/opt.py (HFOPTLayerPolicy):
+        # learned positions (offset-2 table, sliced at load), LayerNorm,
+        # ReLU MLP, biases everywhere, tied embeddings
+        hidden = hf["hidden_size"]
+        if hf.get("word_embed_proj_dim", hidden) != hidden:
+            raise ValueError(
+                f"{arch}: word_embed_proj_dim != hidden_size (opt-350m-style "
+                "embedding projections) is not implemented")
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError(
+                f"{arch}: do_layer_norm_before=false (post-norm opt-350m) "
+                "is not implemented; logits would be silently wrong")
+        if not hf.get("enable_bias", True) or not hf.get(
+                "layer_norm_elementwise_affine", True):
+            raise ValueError(f"{arch}: enable_bias/layer_norm_elementwise_"
+                             "affine=false variants are not implemented")
+        act = _map_activation(arch, hf.get("activation_function", "relu"))
+        msl = hf.get("max_position_embeddings", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            head_dim=hidden // hf["num_attention_heads"],
+            hidden_size=hidden,
+            mlp_dim_override=hf["ffn_dim"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=False, use_rmsnorm=False, gated_mlp=False,
+            activation=act,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            norm_eps=1e-5,
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _PHI_LIKE:
+        # reference inference/v2/model_implementations/phi: parallel
+        # attention+MLP off one shared LayerNorm, partial rotary, biased
+        # projections and lm_head
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
+        if hf.get("qk_layernorm"):
+            raise ValueError(f"{arch}: qk_layernorm=true is not implemented")
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        msl = hf.get("max_position_embeddings", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf["intermediate_size"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=False, gated_mlp=False,
+            activation=_map_activation(arch, hf.get("hidden_act",
+                                                    "gelu_new")),
+            parallel_block=True, parallel_norms=1,
+            rope_pct=float(hf.get("partial_rotary_factor", 0.5)),
+            num_kv_heads=hf.get("num_key_value_heads") or heads,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            unembed_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _FALCON_LIKE:
+        # reference inference/v2/model_implementations/falcon: rotary + MQA/
+        # GQA, LayerNorm, bias-free projections, parallel attention (7b: one
+        # shared input norm; 40b new_decoder_architecture: ln_attn + ln_mlp)
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
+        if hf.get("alibi"):
+            raise ValueError(f"{arch}: alibi position encoding is not "
+                             "implemented (rotary falcon variants only)")
+        if hf.get("bias"):
+            raise ValueError(f"{arch}: bias=true (falcon-rw) is not "
+                             "implemented")
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        new_arch = bool(hf.get("new_decoder_architecture", False))
+        if new_arch:
+            # HF FalconConfig defaults num_kv_heads to num_attention_heads
+            nkv = hf.get("num_kv_heads") or heads
+        elif hf.get("multi_query", True):
+            nkv = 1
+        else:
+            nkv = heads
+        parallel = bool(hf.get("parallel_attn", True))
+        # falcon-40b pairs ln_attn/ln_mlp; falcon-11B (num_ln_in_parallel_attn
+        # =1) shares one input_layernorm like the 7b layout
+        num_ln = hf.get("num_ln_in_parallel_attn")
+        two_norms = new_arch and (num_ln is None or num_ln == 2)
+        msl = hf.get("max_position_embeddings", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf.get("ffn_hidden_size") or 4 * hidden,
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=False, gated_mlp=False,
+            activation=_map_activation(arch, hf.get("activation", "gelu")),
+            parallel_block=parallel,
+            parallel_norms=2 if (parallel and two_norms) else 1,
+            num_kv_heads=nkv,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
             dtype=dtype or jnp.bfloat16,
         )
     raise ValueError(
@@ -293,6 +421,156 @@ def _gpt2_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return {"backbone": bb}
 
 
+def _opt_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """OPT → flax tree (reference module_inject/containers/opt.py maps the
+    same q/k/v/out + fc1/fc2 + twin-LayerNorm layout).  The learned position
+    table carries OPT's +2 offset in rows; slicing it off here lets the model
+    keep plain arange positions."""
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def g(name):
+        return r.get("model." + name if r.has("model." + name) else name)
+
+    bb: Dict[str, Any] = {
+        "wte": g("decoder.embed_tokens.weight"),
+        "wpe": g("decoder.embed_positions.weight")[2:2 + cfg.max_seq_len],
+        "final_norm": {"scale": g("decoder.final_layer_norm.weight"),
+                       "bias": g("decoder.final_layer_norm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"decoder.layers.{i}."
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": g(p + "self_attn.q_proj.weight").T.reshape(H, nh, hd),
+                "wk": g(p + "self_attn.k_proj.weight").T.reshape(H, nh, hd),
+                "wv": g(p + "self_attn.v_proj.weight").T.reshape(H, nh, hd),
+                "bq": g(p + "self_attn.q_proj.bias").reshape(nh, hd),
+                "bk": g(p + "self_attn.k_proj.bias").reshape(nh, hd),
+                "bv": g(p + "self_attn.v_proj.bias").reshape(nh, hd),
+                "wo": g(p + "self_attn.out_proj.weight").T.reshape(nh, hd, H),
+                "bo": g(p + "self_attn.out_proj.bias"),
+            },
+            "Norm_0": {"scale": g(p + "self_attn_layer_norm.weight"),
+                       "bias": g(p + "self_attn_layer_norm.bias")},
+            "Norm_1": {"scale": g(p + "final_layer_norm.weight"),
+                       "bias": g(p + "final_layer_norm.bias")},
+            "MLP_0": {
+                "wi": g(p + "fc1.weight").T,
+                "bi": g(p + "fc1.bias"),
+                "wo": g(p + "fc2.weight").T,
+                "bo": g(p + "fc2.bias"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    return tree
+
+
+def _phi_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """Phi → flax tree (reference inference/v2/model_implementations/phi):
+    parallel attention+MLP sharing one input LayerNorm, biased projections,
+    biased untied lm_head."""
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+
+    bb: Dict[str, Any] = {
+        "wte": r.get("model.embed_tokens.weight"),
+        "final_norm": {"scale": r.get("model.final_layernorm.weight"),
+                       "bias": r.get("model.final_layernorm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": r.get(p + "self_attn.q_proj.weight").T.reshape(H, nh,
+                                                                     hd),
+                "wk": r.get(p + "self_attn.k_proj.weight").T.reshape(H, nkv,
+                                                                     hd),
+                "wv": r.get(p + "self_attn.v_proj.weight").T.reshape(H, nkv,
+                                                                     hd),
+                "bq": r.get(p + "self_attn.q_proj.bias").reshape(nh, hd),
+                "bk": r.get(p + "self_attn.k_proj.bias").reshape(nkv, hd),
+                "bv": r.get(p + "self_attn.v_proj.bias").reshape(nkv, hd),
+                "wo": r.get(p + "self_attn.dense.weight").T.reshape(nh, hd,
+                                                                    H),
+                "bo": r.get(p + "self_attn.dense.bias"),
+            },
+            "Norm_0": {"scale": r.get(p + "input_layernorm.weight"),
+                       "bias": r.get(p + "input_layernorm.bias")},
+            "MLP_0": {
+                "wi": r.get(p + "mlp.fc1.weight").T,
+                "bi": r.get(p + "mlp.fc1.bias"),
+                "wo": r.get(p + "mlp.fc2.weight").T,
+                "bo": r.get(p + "mlp.fc2.bias"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    if cfg.unembed_bias:
+        tree["lm_head_bias"] = (r.get("lm_head.bias")
+                                if r.has("lm_head.bias")
+                                else np.zeros(cfg.vocab_size, np.float32))
+    return tree
+
+
+def _falcon_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """Falcon → flax tree (reference inference/v2/model_implementations/
+    falcon).  The fused query_key_value weight is grouped kv-major:
+    [nkv, g+2, hd, H] with g query heads then one k and one v row per group —
+    matching the model's group-major GQA head order."""
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+    g_per = nh // nkv
+
+    bb: Dict[str, Any] = {
+        "wte": r.get("transformer.word_embeddings.weight"),
+        "final_norm": {"scale": r.get("transformer.ln_f.weight"),
+                       "bias": r.get("transformer.ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        w = r.get(p + "self_attention.query_key_value.weight")   # [out, H]
+        # grouped kv-major fused layout; nkv == nh degenerates to the
+        # falcon-rw interleaved [nh, 3, hd] layout (g_per == 1)
+        w4 = w.reshape(nkv, g_per + 2, hd, H)
+        wq_ = w4[:, :g_per].reshape(nh, hd, H)
+        wk_, wv_ = w4[:, g_per], w4[:, g_per + 1]                # [nkv, hd, H]
+        att = {
+            "wq": np.transpose(wq_, (2, 0, 1)),
+            "wk": np.transpose(wk_, (2, 0, 1)),
+            "wv": np.transpose(wv_, (2, 0, 1)),
+            "wo": r.get(p + "self_attention.dense.weight").T.reshape(nh, hd,
+                                                                     H),
+        }
+        blk = {
+            "Attention_0": att,
+            "MLP_0": {"wi": r.get(p + "mlp.dense_h_to_4h.weight").T,
+                      "wo": r.get(p + "mlp.dense_4h_to_h.weight").T},
+        }
+        if cfg.parallel_block and cfg.parallel_norms == 2:
+            blk["Norm_0"] = {"scale": r.get(p + "ln_attn.weight"),
+                             "bias": r.get(p + "ln_attn.bias")}
+            blk["Norm_1"] = {"scale": r.get(p + "ln_mlp.weight"),
+                             "bias": r.get(p + "ln_mlp.bias")}
+        else:
+            blk["Norm_0"] = {"scale": r.get(p + "input_layernorm.weight"),
+                             "bias": r.get(p + "input_layernorm.bias")}
+            if not cfg.parallel_block:
+                blk["Norm_1"] = {
+                    "scale": r.get(p + "post_attention_layernorm.weight"),
+                    "bias": r.get(p + "post_attention_layernorm.bias")}
+        bb[f"block_{i}"] = blk
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    return tree
+
+
 def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
                        dtype=None) -> Tuple[Any, Dict[str, Any]]:
     """Load an HF model directory → (GPTConfig, flax params tree).
@@ -303,7 +581,16 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
     cfg = config_from_hf(model_path, max_seq_len=max_seq_len, dtype=dtype)
     r = _ShardReader(model_path)
     arch = _arch_of(_read_json(os.path.join(model_path, "config.json")))
-    tree = (_gpt2_tree if arch in _GPT2_LIKE else _llama_tree)(r, cfg)
+    if arch in _GPT2_LIKE:
+        tree = _gpt2_tree(r, cfg)
+    elif arch in _OPT_LIKE:
+        tree = _opt_tree(r, cfg)
+    elif arch in _PHI_LIKE:
+        tree = _phi_tree(r, cfg)
+    elif arch in _FALCON_LIKE:
+        tree = _falcon_tree(r, cfg)
+    else:
+        tree = _llama_tree(r, cfg)
     n = sum(int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(tree))
     log_dist(f"loaded HF checkpoint {model_path} ({arch}): {n/1e6:.1f}M params",

@@ -24,7 +24,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.gpt import GPTConfig, rope
+from deepspeed_tpu.models.gpt import GPTConfig, mlp_activation, rope
 
 
 class PagedKVCache(NamedTuple):
@@ -61,11 +61,24 @@ def _mlp(p, x, cfg):
     if cfg.gated_mlp:
         h = jax.nn.silu(x @ p["wg"].astype(x.dtype)) * h
     else:
-        h = jax.nn.gelu(h)
+        h = mlp_activation(cfg.activation)(h)
     y = h @ p["wo"].astype(x.dtype)
     if cfg.mlp_bias:
         y = y + p["bo"].astype(x.dtype)
     return y
+
+
+def _block_residual(blk, x, h, attn_delta, cfg):
+    """Close out one block given the normed input ``h`` and the attention
+    branch output: sequential (x+attn, then MLP on a fresh norm) or falcon/phi
+    parallel residual (attn and MLP both read the shared/paired input norms) —
+    the single source of truth for BOTH the ragged prefill and paged decode
+    loops."""
+    if cfg.parallel_block:
+        h_mlp = _norm(blk["Norm_1"], x, cfg) if cfg.parallel_norms == 2 else h
+        return x + attn_delta + _ffn(blk, h_mlp, cfg)
+    x = x + attn_delta
+    return x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
 
 
 def _ffn(blk, x, cfg):
@@ -159,7 +172,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         if cfg.use_rope:
             # rope() takes [B, T, n, d] + positions [B, T]
             q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim,
-                        base=cfg.rope_theta)
+                        base=cfg.rope_theta, rope_pct=cfg.rope_pct)
             q, k = q[0], k[0]
 
         # ---- paged KV append (reference linear_blocked_kv_rotary) ----
@@ -192,10 +205,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
                                        causal=False, mask=mask)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
-        x = x + _attn_out(ap, o, cfg, "nkd,kdh->nh")
-
-        # ---- MLP / MoE ----
-        x = x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
+        attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")
+        x = _block_residual(blk, x, h, attn_delta, cfg)
 
     x = _norm(bb["final_norm"], x, cfg)
 
@@ -209,6 +220,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     else:
         unembed = params["lm_head"].astype(dtype)
     logits = (rows @ unembed).astype(jnp.float32)            # [S, V]
+    if cfg.unembed_bias:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits, PagedKVCache(k=flat_k_all.reshape(cache.k.shape),
                                 v=flat_v_all.reshape(cache.v.shape))
 
@@ -247,7 +260,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         q, k, v = _qkv(ap, h, cfg, "sh,hkd->skd")
         if cfg.use_rope:
             q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd,
-                        base=cfg.rope_theta)
+                        base=cfg.rope_theta, rope_pct=cfg.rope_pct)
             q, k = q[:, 0], k[:, 0]
 
         page_li = jnp.where(active, li * NB + page, big)
@@ -262,8 +275,8 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len,
                                 mesh=mesh)
         o = o.reshape(S, nh, hd)
-        x = x + _attn_out(ap, o, cfg, "skd,kdh->sh")
-        x = x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
+        attn_delta = _attn_out(ap, o, cfg, "skd,kdh->sh")
+        x = _block_residual(blk, x, h, attn_delta, cfg)
 
     x = _norm(bb["final_norm"], x, cfg)
     if cfg.tie_embeddings:
@@ -271,6 +284,8 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     else:
         unembed = params["lm_head"].astype(dtype)
     logits = (x @ unembed).astype(jnp.float32)                # [S, V]
+    if cfg.unembed_bias:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits, flat_k_all, flat_v_all
 
 

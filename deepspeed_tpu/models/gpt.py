@@ -74,6 +74,14 @@ class GPTConfig:
     qkv_bias: bool = False
     attn_out_bias: bool = False
     mlp_bias: bool = False
+    # architecture variants for the wider HF zoo (reference zoo:
+    # module_inject/containers/opt.py, inference/v2/model_implementations/
+    # {phi,falcon}):
+    activation: str = "gelu"            # non-gated MLP: gelu|gelu_exact|relu
+    parallel_block: bool = False        # x + attn(n(x)) + mlp(n(x)) (falcon/phi)
+    parallel_norms: int = 1             # 1 = shared input norm; 2 = ln_attn+ln_mlp
+    rope_pct: float = 1.0               # partial rotary (phi partial_rotary_factor)
+    unembed_bias: bool = False          # lm_head bias (phi)
     # random-LTD (data_pipeline/random_ltd.py): layers that run on a kept
     # token subset when the batch carries "random_ltd_idx"
     random_ltd_layer_ids: tuple = ()
@@ -158,22 +166,48 @@ def _part(init, names):
     return nn.with_partitioning(init, names)
 
 
-def rope(q, k, positions, head_dim, base=10000.0):
+def rotary_dim(head_dim: int, rope_pct: float) -> int:
+    """Rotated prefix width for partial rotary (phi partial_rotary_factor),
+    rounded down to even so the half-split convention holds."""
+    rot = head_dim if rope_pct >= 1.0 else int(head_dim * rope_pct)
+    return rot - (rot % 2)
+
+
+def rope(q, k, positions, head_dim, base=10000.0, rope_pct=1.0):
     """Rotary position embedding (reference CUDA kernel:
     csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu — on TPU a few
-    elementwise ops XLA fuses into the attention matmuls)."""
-    half = head_dim // 2
+    elementwise ops XLA fuses into the attention matmuls).  rope_pct < 1
+    rotates only the first ``rotary_dim`` channels (phi-style partial rotary);
+    the remainder passes through."""
+    rot = rotary_dim(head_dim, rope_pct)
+    half = rot // 2
     freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[..., None].astype(jnp.float32) * freq  # [B,T,half]
     sin, cos = jnp.sin(angles), jnp.cos(angles)
 
-    def rot(x):
-        x1, x2 = x[..., :half], x[..., half:]
+    def rotfn(x):
+        x1, x2 = x[..., :half], x[..., half:rot]
         s = sin[:, :, None, :].astype(x.dtype)
         c = cos[:, :, None, :].astype(x.dtype)
-        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        parts = [x1 * c - x2 * s, x2 * c + x1 * s]
+        if rot < head_dim:
+            parts.append(x[..., rot:])
+        return jnp.concatenate(parts, axis=-1)
 
-    return rot(q), rot(k)
+    return rotfn(q), rotfn(k)
+
+
+def mlp_activation(name: str):
+    """Non-gated MLP activation by HF ``activation_function``/``hidden_act``
+    name: gpt2/phi use tanh-approx gelu ("gelu_new"), falcon exact-erf gelu,
+    OPT relu (reference containers set these per policy)."""
+    try:
+        return {"gelu": nn.gelu,
+                "gelu_exact": lambda x: nn.gelu(x, approximate=False),
+                "relu": nn.relu}[name]
+    except KeyError:
+        raise ValueError(f"unknown MLP activation {name!r}; expected "
+                         "gelu|gelu_exact|relu") from None
 
 
 class Norm(nn.Module):
@@ -252,7 +286,8 @@ class Attention(nn.Module):
                                (nkv, hd), c.param_dtype).astype(x.dtype)
 
         if c.use_rope:
-            q, k = rope(q, k, positions, hd, base=c.rope_theta)
+            q, k = rope(q, k, positions, hd, base=c.rope_theta,
+                        rope_pct=c.rope_pct)
 
         if use_cache:
             # static KV cache in a flax "cache" collection (reference:
@@ -333,7 +368,7 @@ class MLP(nn.Module):
                             (H, M), c.param_dtype)
             h = nn.silu(x @ wg.astype(x.dtype)) * h
         else:
-            h = nn.gelu(h)
+            h = mlp_activation(c.activation)(h)
         if c.dropout > 0 and not deterministic:
             h = nn.Dropout(rate=c.dropout)(h, deterministic=False)
         y = h @ wo.astype(x.dtype)
@@ -353,6 +388,21 @@ class Block(nn.Module):
                  use_cache: bool = False, kv_mask=None, start_index=0,
                  kv_positions=None):
         c = self.cfg
+        if c.parallel_block:
+            # falcon/phi-style parallel residual: attention and MLP both read
+            # the SAME residual input (one shared input norm, or falcon-40b's
+            # ln_attn + ln_mlp pair) and their outputs sum into one residual
+            # add (reference inference/v2/model_implementations/falcon,
+            # module_inject/containers/ — parallel_attn semantics).
+            if self.is_moe:
+                raise ValueError("parallel_block + MoE is not a supported "
+                                 "architecture combination")
+            h_attn = Norm(c)(x)                       # Norm_0
+            h_mlp = Norm(c)(x) if c.parallel_norms == 2 else h_attn  # Norm_1
+            a = Attention(c, mesh=self.mesh)(h_attn, positions, deterministic,
+                                             use_cache, kv_mask, start_index,
+                                             kv_positions)
+            return x + a + MLP(c)(h_mlp, deterministic), jnp.float32(0.0)
         x = x + Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
                                              deterministic, use_cache,
                                              kv_mask, start_index,
@@ -487,9 +537,14 @@ class GPT(nn.Module):
                                  (c.hidden_size, c.vocab_size),
                                  c.param_dtype).astype(x.dtype)
         labels, mask = shift_labels(batch, input_ids)
+        lm_bias = (self.param("lm_head_bias",
+                              _part(nn.initializers.zeros, ("vocab",)),
+                              (c.vocab_size,), c.param_dtype)
+                   if c.unembed_bias else None)
         from deepspeed_tpu.ops import lm_cross_entropy
         loss = lm_cross_entropy(x, unembed, labels, mask,
-                                chunk_size=self._loss_chunk() or None)
+                                chunk_size=self._loss_chunk() or None,
+                                bias=lm_bias)
         if c.num_experts > 0:
             loss = loss + c.moe_aux_coef * moe_aux
         return loss
@@ -520,7 +575,12 @@ class GPTLogits(nn.Module):
                                  _part(_kernel_init(), ("embed", "vocab")),
                                  (c.hidden_size, c.vocab_size),
                                  c.param_dtype).astype(x.dtype)
-        return (x @ unembed).astype(jnp.float32)
+        logits = (x @ unembed).astype(jnp.float32)
+        if c.unembed_bias:
+            logits = logits + self.param(
+                "lm_head_bias", _part(nn.initializers.zeros, ("vocab",)),
+                (c.vocab_size,), c.param_dtype).astype(jnp.float32)
+        return logits
 
 
 class GPTChunkedLoss(GPT):
@@ -533,13 +593,16 @@ class GPTChunkedLoss(GPT):
 
 def count_params(cfg: GPTConfig) -> int:
     H, M, V = cfg.hidden_size, cfg.mlp_dim, cfg.vocab_size
+    norms = 1 if (cfg.parallel_block and cfg.parallel_norms == 1) else 2
     per_layer = (cfg.num_heads * cfg.head_dim * H * 2          # wq, wo
                  + cfg.kv_heads * cfg.head_dim * H * 2         # wk, wv
                  + H * M * (3 if cfg.gated_mlp else 2)         # mlp
-                 + H * (2 if cfg.use_rmsnorm else 4))          # norms
+                 + H * norms * (1 if cfg.use_rmsnorm else 2))
     total = per_layer * cfg.num_layers + V * H + H
     if not cfg.use_rope:
         total += cfg.max_seq_len * H
     if not cfg.tie_embeddings:
         total += V * H
+    if cfg.unembed_bias:
+        total += V
     return total

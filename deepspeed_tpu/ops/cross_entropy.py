@@ -19,29 +19,33 @@ import jax
 import jax.numpy as jnp
 
 
-def _chunk_loss(x, w, labels, mask):
+def _chunk_loss(x, w, labels, mask, bias=None):
     """Sum NLL over one flat chunk of tokens.  x:[C,H] w:[H,V] labels/mask:[C]."""
     logits = (x @ w).astype(jnp.float32)            # [C, V]
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)         # [C]
     ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
     return jnp.sum((lse - ll) * mask)
 
 
-def masked_nll_sum(x, unembed, labels, mask):
+def masked_nll_sum(x, unembed, labels, mask, bias=None):
     """Sum of masked token NLLs of ``x @ unembed`` (no mean) — the shared loss
     body for callers that aggregate their own denominator across microbatches
     (pipe/module.py's per-microbatch scan).  x: [..., H]; labels/mask: [...]."""
     h = x.shape[-1]
     return _chunk_loss(x.reshape(-1, h), unembed,
                        labels.reshape(-1).astype(jnp.int32),
-                       mask.reshape(-1).astype(jnp.float32))
+                       mask.reshape(-1).astype(jnp.float32), bias)
 
 
 def lm_cross_entropy(x, unembed, labels, mask,
-                     chunk_size: Optional[int] = 512):
-    """Mean masked cross entropy of ``x @ unembed`` against ``labels``.
+                     chunk_size: Optional[int] = 512, bias=None):
+    """Mean masked cross entropy of ``x @ unembed (+ bias)`` against
+    ``labels``.
 
-    x: [B, T, H] hidden states; unembed: [H, V]; labels/mask: [B, T].
+    x: [B, T, H] hidden states; unembed: [H, V]; labels/mask: [B, T];
+    bias: optional [V] unembed bias (phi-style lm_head).
     ``chunk_size=None`` computes the loss in one shot (ground truth path).
     """
     b, t, h = x.shape
@@ -52,7 +56,7 @@ def lm_cross_entropy(x, unembed, labels, mask,
     denom = jnp.maximum(jnp.sum(mf), 1.0)
 
     if not chunk_size or chunk_size >= n:
-        return _chunk_loss(xf, unembed, lf, mf) / denom
+        return _chunk_loss(xf, unembed, lf, mf, bias) / denom
 
     c = int(chunk_size)
     pad = (-n) % c
@@ -69,7 +73,7 @@ def lm_cross_entropy(x, unembed, labels, mask,
 
     def body(total, inputs):
         xi, li, mi = inputs
-        return total + chunk_fn(xi, unembed, li, mi), None
+        return total + chunk_fn(xi, unembed, li, mi, bias), None
 
     total, _ = jax.lax.scan(body, jnp.float32(0.0), (xc, lc, mc))
     return total / denom

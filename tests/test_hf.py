@@ -140,6 +140,110 @@ class TestGPT2:
         _check(path, model, rng, 128)
 
 
+class TestOptPhiFalcon:
+    """The non-llama zoo rows (reference module_inject/containers/opt.py,
+    inference/v2/model_implementations/{phi,falcon}): learned-position ReLU
+    OPT, parallel-residual partial-rotary Phi, parallel-residual MQA/GQA
+    Falcon."""
+
+    def test_opt_logits_match(self, tmp_models, rng):
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=64, ffn_dim=192,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, word_embed_proj_dim=64,
+            do_layer_norm_before=True)
+        torch.manual_seed(4)
+        model = transformers.OPTForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "opt")
+        _check(path, model, rng, 128)
+
+    def test_opt_rejects_post_norm_and_proj(self, tmp_models):
+        path = os.path.join(tmp_models, "opt350")
+        os.makedirs(path, exist_ok=True)
+        base = dict(architectures=["OPTForCausalLM"], hidden_size=64,
+                    vocab_size=128, ffn_dim=192, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({**base, "do_layer_norm_before": False}, f)
+        with pytest.raises(ValueError, match="do_layer_norm_before"):
+            config_from_hf(path)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({**base, "word_embed_proj_dim": 32}, f)
+        with pytest.raises(ValueError, match="word_embed_proj_dim"):
+            config_from_hf(path)
+
+    def test_phi_logits_match(self, tmp_models, rng):
+        cfg = transformers.PhiConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=192,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            partial_rotary_factor=0.5, rope_theta=10000.0,
+            tie_word_embeddings=False)
+        torch.manual_seed(5)
+        model = transformers.PhiForCausalLM(cfg).eval()
+        # exercise the lm_head bias mapping
+        with torch.no_grad():
+            model.lm_head.bias.normal_(0, 0.05)
+        path = _save(tmp_models, model, "phi")
+        _check(path, model, rng, 128)
+
+    def test_phi_config_mapping(self, tmp_models):
+        cfg = config_from_hf(os.path.join(tmp_models, "phi"))
+        assert cfg.parallel_block and cfg.parallel_norms == 1
+        assert cfg.rope_pct == 0.5 and cfg.unembed_bias
+        assert cfg.qkv_bias and not cfg.use_rmsnorm
+
+    def test_falcon7b_style_logits_match(self, tmp_models, rng):
+        """multi_query=True (nkv=1), parallel_attn, shared input norm."""
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, new_decoder_architecture=False,
+            multi_query=True, parallel_attn=True, bias=False, alibi=False,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(6)
+        model = transformers.FalconForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "falcon7b")
+        _check(path, model, rng, 128)
+
+    def test_falcon40b_style_logits_match(self, tmp_models, rng):
+        """new_decoder_architecture: GQA groups + ln_attn/ln_mlp pair."""
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=2,
+            new_decoder_architecture=True, parallel_attn=True, bias=False,
+            alibi=False, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        torch.manual_seed(7)
+        model = transformers.FalconForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "falcon40b")
+        _check(path, model, rng, 128)
+
+    def test_falcon11b_style_logits_match(self, tmp_models, rng):
+        """new_decoder_architecture + num_ln_in_parallel_attn=1 (falcon-11B):
+        GQA grouped qkv but one shared input_layernorm."""
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=2,
+            new_decoder_architecture=True, num_ln_in_parallel_attn=1,
+            parallel_attn=True, bias=False, alibi=False,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(8)
+        model = transformers.FalconForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "falcon11b")
+        _check(path, model, rng, 128)
+
+    def test_falcon_rejects_alibi(self, tmp_models):
+        path = os.path.join(tmp_models, "falcon_rw")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump({"architectures": ["FalconForCausalLM"],
+                       "hidden_size": 64, "vocab_size": 128,
+                       "num_hidden_layers": 2, "num_attention_heads": 4,
+                       "alibi": True}, f)
+        with pytest.raises(ValueError, match="alibi"):
+            config_from_hf(path)
+
+
 class TestV2Serving:
     def test_v2_engine_serves_hf_checkpoint(self, tmp_models, rng):
         """Greedy tokens from the ragged engine == HF greedy generate."""
@@ -160,13 +264,39 @@ class TestV2Serving:
         got = eng.generate([prompt[0]], max_new_tokens=8)[0]
         np.testing.assert_array_equal(got, want)
 
+    def test_v2_engine_serves_parallel_block_arch(self, tmp_models, rng):
+        """Falcon-style parallel residual through the ragged engine (prefill
+        scatter + paged decode) == HF greedy generate."""
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, new_decoder_architecture=False,
+            multi_query=True, parallel_attn=True, bias=False, alibi=False,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(6)
+        model = transformers.FalconForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "falcon7b")
+        prompt = rng.integers(0, 128, (1, 9)).astype(np.int32)
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                do_sample=False).numpy()[0, 9:]
+        eng = InferenceEngineV2(
+            path, {"dtype": "fp32",
+                   "state_manager": {"max_tracked_sequences": 2,
+                                     "kv_block_size": 8},
+                   "generation": {"do_sample": False}})
+        got = eng.generate([prompt[0]], max_new_tokens=6)[0]
+        np.testing.assert_array_equal(got, want)
+
 
 class TestErrors:
     def test_unsupported_architecture(self, tmp_models):
         path = os.path.join(tmp_models, "weird")
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump({"architectures": ["FalconForCausalLM"]}, f)
+            json.dump({"architectures": ["BloomForCausalLM"]}, f)
         with pytest.raises(ValueError, match="unsupported HF architecture"):
             config_from_hf(path)
 
