@@ -30,6 +30,16 @@ import orbax.checkpoint as ocp
 
 LATEST_FILE = "latest"
 
+
+def __getattr__(name):
+    # lazy: universal-checkpoint helpers (checkpoint/universal.py) without
+    # importing torch/optax at package import
+    if name in ("export_universal", "load_universal", "apply_universal",
+                "export_universal_offload"):
+        from deepspeed_tpu.checkpoint import universal
+        return getattr(universal, name)
+    raise AttributeError(name)
+
 # one long-lived async checkpointer (orbax guidance; a fresh instance per save
 # would serialize on its own setup) + a waiter thread for deferred metadata
 _CKPTR: Optional[ocp.StandardCheckpointer] = None

@@ -1,0 +1,410 @@
+"""Universal checkpointing — per-parameter fp32 fragment export/import.
+
+Reference: checkpoint/ds_to_universal.py (shard extract/merge pipeline into
+``zero/<param_name>/fp32.pt`` fragment dirs), checkpoint/universal_checkpoint.py
+(load_hp_checkpoint_state), utils/zero_to_fp32.py (offline consolidation).
+
+The TPU engine's orbax checkpoints already reshard freely on load (named
+shardings), so the reference's *topology* motivation disappears — what this
+module adds is the other half of "universal": a framework-neutral on-disk
+layout that
+
+- any tool can read without orbax/jax (one little-endian ``.npy`` per tensor),
+- carries TRUE fp32 master weights + optimizer moments (not the bf16 params),
+- and can ingest reference-style torch fragments (``fp32.pt``) for
+  cross-framework migration.
+
+Layout (mirrors ds_to_universal's output shape)::
+
+    out_dir/
+      meta.json                      # step, format tag, param manifest
+      zero/
+        <dotted.param.path>/         # e.g. backbone.block_0.Attention_0.wq
+          fp32.npy                   # master weights (fp32)
+          exp_avg.npy                # Adam first moment, when present
+          exp_avg_sq.npy             # Adam second moment, when present
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+FORMAT = "deepspeed_tpu_universal/1"
+_FRAGMENT_KEYS = ("fp32", "exp_avg", "exp_avg_sq")
+
+
+# ---------------------------------------------------------------------------
+# generic pytree surgery: find / rewrite optimizer sub-states by type
+# ---------------------------------------------------------------------------
+
+def _is_namedtuple(x) -> bool:
+    return isinstance(x, tuple) and hasattr(x, "_fields")
+
+
+def _find_nodes(node, pred, out):
+    """Collect all sub-nodes matching ``pred`` (no descent into matches)."""
+    if pred(node):
+        out.append(node)
+        return out
+    if _is_namedtuple(node):
+        for f in node._fields:
+            _find_nodes(getattr(node, f), pred, out)
+    elif isinstance(node, (tuple, list)):
+        for x in node:
+            _find_nodes(x, pred, out)
+    elif isinstance(node, dict):
+        for x in node.values():
+            _find_nodes(x, pred, out)
+    return out
+
+
+def _rewrite_nodes(node, visit):
+    """Rebuild the tree, replacing any node where ``visit`` returns non-None."""
+    new = visit(node)
+    if new is not None:
+        return new
+    if _is_namedtuple(node):
+        return type(node)(*[_rewrite_nodes(getattr(node, f), visit)
+                            for f in node._fields])
+    if isinstance(node, tuple):
+        return tuple(_rewrite_nodes(x, visit) for x in node)
+    if isinstance(node, list):
+        return [_rewrite_nodes(x, visit) for x in node]
+    if isinstance(node, dict):
+        return {k: _rewrite_nodes(v, visit) for k, v in node.items()}
+    return node
+
+
+def _adam_states(opt_state):
+    """ScaleByAdamState nodes — typed (live engine state) or the dict form an
+    orbax restore-without-target produces."""
+    import optax
+
+    def pred(n):
+        return (isinstance(n, optax.ScaleByAdamState)
+                or (isinstance(n, dict) and set(n) == {"count", "mu", "nu"}))
+
+    return [{"mu": n["mu"], "nu": n["nu"]} if isinstance(n, dict)
+            else {"mu": n.mu, "nu": n.nu}
+            for n in _find_nodes(opt_state, pred, [])]
+
+
+def _master_states(opt_state):
+    from deepspeed_tpu.runtime.zero import MasterWeightsState
+
+    def pred(n):
+        return (isinstance(n, MasterWeightsState)
+                or (isinstance(n, dict) and set(n) == {"master", "inner"}))
+
+    return [{"master": n["master"]} if isinstance(n, dict)
+            else {"master": n.master}
+            for n in _find_nodes(opt_state, pred, [])]
+
+
+# ---------------------------------------------------------------------------
+# path helpers
+# ---------------------------------------------------------------------------
+
+def _flatten_params(params) -> Dict[str, Any]:
+    """Nested dict tree → {"a.b.c": leaf} with deterministic dotted paths."""
+    flat = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], prefix + (str(k),))
+        else:
+            flat[".".join(prefix)] = node
+
+    walk(params, ())
+    return flat
+
+
+def _unflatten_params(flat: Dict[str, Any]) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        parts = path.split(".")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+def export_universal(state, out_dir: str, *, step: Optional[int] = None
+                     ) -> str:
+    """Write a TrainState (or any (params, opt_state) carrier) as universal
+    fp32 fragments.
+
+    state: engine ``TrainState`` (device or host arrays).  Master weights are
+    taken from the optimizer's ``MasterWeightsState`` when present (true fp32
+    masters, reference _create_fp32_partitions), else params are upcast.
+    """
+    params = state.params
+    opt_state = state.opt_state
+    flat = _flatten_params(params)
+    paths = list(flat)
+
+    masters = _master_states(opt_state)
+    master_flat = _flatten_params(masters[0]["master"]) if masters else flat
+    adams = _adam_states(opt_state)
+    mu_flat = _flatten_params(adams[0]["mu"]) if adams else None
+    nu_flat = _flatten_params(adams[0]["nu"]) if adams else None
+
+    zdir = os.path.join(out_dir, "zero")
+    os.makedirs(zdir, exist_ok=True)
+    manifest = {}
+    for p in paths:
+        d = os.path.join(zdir, p)
+        os.makedirs(d, exist_ok=True)
+        w = np.asarray(jax.device_get(master_flat[p]))
+        # bf16 needs the explicit dtype compare — numpy's kind for ml_dtypes
+        # bfloat16 is not "f"
+        if w.dtype != np.float32 and (w.dtype.kind == "f"
+                                      or w.dtype == jax.numpy.bfloat16):
+            w = w.astype(np.float32)
+        np.save(os.path.join(d, "fp32.npy"), w)
+        if mu_flat is not None:
+            np.save(os.path.join(d, "exp_avg.npy"),
+                    np.asarray(jax.device_get(mu_flat[p]), np.float32))
+            np.save(os.path.join(d, "exp_avg_sq.npy"),
+                    np.asarray(jax.device_get(nu_flat[p]), np.float32))
+        manifest[p] = {"shape": list(w.shape), "dtype": "float32",
+                       "has_moments": mu_flat is not None}
+
+    if step is None:
+        step = int(jax.device_get(state.step)) if hasattr(state, "step") else 0
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump({"format": FORMAT, "step": int(step),
+                   "params": manifest}, f, indent=1)
+    return out_dir
+
+
+# ---------------------------------------------------------------------------
+# import
+# ---------------------------------------------------------------------------
+
+def _read_fragment(d: str, key: str):
+    """Read one tensor fragment — native ``.npy``, or reference-style torch
+    ``.pt`` (checkpoint/ds_to_universal.py writes fp32.pt/exp_avg.pt/...)."""
+    npy = os.path.join(d, key + ".npy")
+    if os.path.exists(npy):
+        return np.load(npy)
+    pt = os.path.join(d, key + ".pt")
+    if os.path.exists(pt):
+        import torch
+        t = torch.load(pt, map_location="cpu", weights_only=True)
+        return t.detach().to(torch.float32).numpy()
+    return None
+
+
+def load_universal(universal_dir: str,
+                   name_map: Optional[Callable[[str], Optional[str]]] = None,
+                   ) -> Tuple[Dict[str, Dict[str, np.ndarray]], dict]:
+    """Read a universal dir → ({dotted_path: {fp32, exp_avg?, exp_avg_sq?}},
+    meta).  ``name_map`` renames fragment dirs (e.g. torch module names from a
+    reference-produced checkpoint → flax paths); returning None skips one."""
+    zdir = os.path.join(universal_dir, "zero")
+    if not os.path.isdir(zdir):
+        raise FileNotFoundError(f"{universal_dir}: no zero/ fragment dir "
+                                "(not a universal checkpoint)")
+    frags: Dict[str, Dict[str, np.ndarray]] = {}
+    for name in sorted(os.listdir(zdir)):
+        d = os.path.join(zdir, name)
+        if not os.path.isdir(d):
+            continue
+        path = name_map(name) if name_map else name
+        if path is None:
+            continue
+        entry = {}
+        for key in _FRAGMENT_KEYS:
+            arr = _read_fragment(d, key)
+            if arr is not None:
+                entry[key] = arr
+        if "fp32" not in entry:
+            raise FileNotFoundError(f"{d}: no fp32 fragment (.npy or .pt)")
+        frags[path] = entry
+    meta = {}
+    mpath = os.path.join(universal_dir, "meta.json")
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            meta = json.load(f)
+    return frags, meta
+
+
+def apply_universal(state, frags: Dict[str, Dict[str, np.ndarray]],
+                    *, strict: bool = True, step: Optional[int] = None):
+    """Return a new TrainState with params / masters / Adam moments replaced
+    by the fragments (host arrays — caller device_puts with its shardings).
+
+    The fragment set must cover the param tree exactly under ``strict``
+    (reference universal_checkpoint.load_hp_checkpoint_state does the same
+    per-fragment existence check).  ``step`` also resets the Adam bias-
+    correction count — restored mature moments must not be re-bias-corrected
+    as if at step 0.
+    """
+    import optax
+
+    from deepspeed_tpu.runtime.zero import MasterWeightsState
+
+    flat = _flatten_params(state.params)
+    missing = [p for p in flat if p not in frags]
+    extra = [p for p in frags if p not in flat]
+    if strict and (missing or extra):
+        raise ValueError(
+            f"universal checkpoint does not match the model: missing "
+            f"{missing[:4]}{'...' if len(missing) > 4 else ''}, unexpected "
+            f"{extra[:4]}{'...' if len(extra) > 4 else ''}")
+
+    def cast_like(arr, like):
+        return np.asarray(arr).astype(np.asarray(like).dtype) \
+            if hasattr(like, "dtype") else arr
+
+    new_params = _unflatten_params(
+        {p: cast_like(frags[p]["fp32"], flat[p]) if p in frags else flat[p]
+         for p in flat})
+
+    have_moments = any("exp_avg" in frags.get(p, {}) for p in flat)
+
+    def visit(node):
+        if isinstance(node, MasterWeightsState):
+            flat_master = _flatten_params(node.master)
+            m = _unflatten_params(
+                {p: np.asarray(frags[p]["fp32"], np.float32)
+                 if p in frags else flat_master[p] for p in flat})
+            return MasterWeightsState(
+                master=m, inner=_rewrite_nodes(node.inner, visit))
+        if isinstance(node, optax.ScaleByAdamState) and have_moments:
+            flat_mu = _flatten_params(node.mu)
+            flat_nu = _flatten_params(node.nu)
+
+            def moment(p, key, fallback):
+                f = frags.get(p)
+                if f is not None and key in f:
+                    return np.asarray(f[key], np.float32)
+                return fallback[p]       # moment-less leaf (e.g. int param)
+
+            mu = _unflatten_params(
+                {p: moment(p, "exp_avg", flat_mu) for p in flat})
+            nu = _unflatten_params(
+                {p: moment(p, "exp_avg_sq", flat_nu) for p in flat})
+            count = (node.count if step is None
+                     else np.asarray(step, np.asarray(node.count).dtype))
+            return optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+        return None
+
+    new_opt = _rewrite_nodes(state.opt_state, visit)
+    return state._replace(params=new_params, opt_state=new_opt)
+
+
+def export_universal_offload(params, offload_opt, out_dir: str, *,
+                             step: int = 0) -> str:
+    """Export when the masters/moments live host-side in the ZeRO-Offload
+    optimizer (runtime/offload.py OffloadAdam) — the reference's
+    ds_to_universal likewise pulls fp32 state out of the swap tier."""
+    flat = _flatten_params(params)
+    sd = offload_opt.state_dict()
+    zdir = os.path.join(out_dir, "zero")
+    os.makedirs(zdir, exist_ok=True)
+    manifest = {}
+    for path, leaf in flat.items():
+        key = path.replace(".", "/")         # offload keys are "/"-joined
+        d = os.path.join(zdir, path)
+        os.makedirs(d, exist_ok=True)
+        shape = np.asarray(leaf).shape
+        if f"{key}::master" in sd:
+            np.save(os.path.join(d, "fp32.npy"),
+                    np.asarray(sd[f"{key}::master"],
+                               np.float32).reshape(shape))
+            np.save(os.path.join(d, "exp_avg.npy"),
+                    np.asarray(sd[f"{key}::m"], np.float32).reshape(shape))
+            np.save(os.path.join(d, "exp_avg_sq.npy"),
+                    np.asarray(sd[f"{key}::v"], np.float32).reshape(shape))
+            has_m = True
+        else:                                 # non-trainable leaf
+            np.save(os.path.join(d, "fp32.npy"), np.asarray(leaf))
+            has_m = False
+        manifest[path] = {"shape": list(shape), "dtype": "float32",
+                          "has_moments": has_m}
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump({"format": FORMAT, "step": int(step),
+                   "params": manifest}, f, indent=1)
+    return out_dir
+
+
+def offload_state_dict_from_fragments(params,
+                                      frags: Dict[str, Dict[str, np.ndarray]],
+                                      step: int) -> Dict[str, Any]:
+    """Build an OffloadAdam ``load_state_dict`` payload from fragments."""
+    sd: Dict[str, Any] = {"step_count": int(step)}
+    for path in _flatten_params(params):
+        if path not in frags or "exp_avg" not in frags[path]:
+            continue
+        key = path.replace(".", "/")
+        sd[f"{key}::master"] = frags[path]["fp32"].ravel()
+        sd[f"{key}::m"] = frags[path]["exp_avg"].ravel()
+        sd[f"{key}::v"] = frags[path]["exp_avg_sq"].ravel()
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# CLI (reference: ds_to_universal.py script)
+# ---------------------------------------------------------------------------
+
+def _cli(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.checkpoint.universal",
+        description="Export an engine checkpoint to universal fp32 fragments "
+                    "(reference checkpoint/ds_to_universal.py)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ex = sub.add_parser("export", help="orbax checkpoint dir -> universal dir")
+    ex.add_argument("ckpt_dir")
+    ex.add_argument("out_dir")
+    ex.add_argument("--tag", default=None)
+    ins = sub.add_parser("inspect", help="print a universal dir's manifest")
+    ins.add_argument("universal_dir")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "export":
+        from deepspeed_tpu.checkpoint import latest_tag
+        import orbax.checkpoint as ocp
+        tag = args.tag or latest_tag(args.ckpt_dir)
+        if tag is None:
+            print(f"no 'latest' file in {args.ckpt_dir}; pass --tag")
+            return 1
+        path = os.path.join(os.path.abspath(args.ckpt_dir), tag, "state")
+        state = ocp.StandardCheckpointer().restore(path)
+
+        class _Carrier:
+            pass
+
+        c = _Carrier()
+        c.params = state["params"]
+        c.opt_state = state["opt_state"]
+        c.step = state.get("step", 0)
+        export_universal(c, args.out_dir)
+        print(f"exported {args.ckpt_dir}@{tag} -> {args.out_dir}")
+        return 0
+    frags, meta = load_universal(args.universal_dir)
+    print(json.dumps({"format": meta.get("format"),
+                      "step": meta.get("step"),
+                      "num_params": len(frags),
+                      "total_elems": int(sum(f["fp32"].size
+                                             for f in frags.values()))},
+                     indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_cli())

@@ -983,6 +983,41 @@ class DeepSpeedTPUEngine:
                      **self.offload_opt.state_dict())
         return tag
 
+    def export_universal_checkpoint(self, out_dir: str) -> str:
+        """reference checkpoint/ds_to_universal.py: dump per-parameter fp32
+        fragments (+ Adam moments) in a framework-neutral layout any topology
+        or toolchain can ingest."""
+        from deepspeed_tpu.checkpoint import universal as _u
+        if self.offloading:
+            return _u.export_universal_offload(
+                jax.device_get(self.state.params), self.offload_opt, out_dir,
+                step=self.global_steps)
+        return _u.export_universal(jax.device_get(self.state), out_dir)
+
+    def load_universal_checkpoint(self, universal_dir: str, *,
+                                  strict: bool = True) -> dict:
+        """reference checkpoint/universal_checkpoint.py
+        load_hp_checkpoint_state: install fp32 fragments into this engine's
+        params / masters / Adam moments regardless of the mesh, ZeRO stage,
+        or framework that produced them (torch ``fp32.pt`` fragments load
+        too)."""
+        from deepspeed_tpu.checkpoint.universal import (
+            apply_universal, load_universal,
+            offload_state_dict_from_fragments)
+        frags, meta = load_universal(universal_dir)
+        host = jax.device_get(self.state)
+        step = int(meta.get("step", int(np.asarray(host.step))))
+        new = apply_universal(host, frags, strict=strict, step=step)
+        new = new._replace(step=jnp.asarray(step, np.asarray(host.step).dtype))
+        self.state = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), new, self.state_shardings)
+        self.global_steps = step
+        if self.offloading:
+            sd = offload_state_dict_from_fragments(host.params, frags, step)
+            if len(sd) > 1:
+                self.offload_opt.load_state_dict(sd)
+        return meta
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         """reference engine.load_checkpoint (engine.py:2710); resharding on load
         comes free from named shardings (the reference needs universal-checkpoint

@@ -1,0 +1,182 @@
+"""Universal checkpointing — fragment export/import across topologies and
+frameworks (reference checkpoint/ds_to_universal.py + universal_checkpoint.py
+tests/unit/checkpoint/test_universal_checkpoint.py)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.universal import (_cli, apply_universal,
+                                                load_universal)
+from deepspeed_tpu.models import GPT, GPTConfig
+
+VOCAB, SEQ = 64, 16
+
+
+def _data(n_batches, global_bs, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    for _ in range(n_batches):
+        idx = rng.integers(0, len(pool), size=(global_bs,))
+        yield {"input_ids": pool[idx]}
+
+
+def _build(zero_stage, mesh_kw, micro_batch=2):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage},
+        "bf16": {"enabled": True},
+        "mesh": mesh_kw,
+        "steps_per_print": 0,
+    }
+    model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+    example = {"input_ids": np.zeros((micro_batch, SEQ), np.int32)}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, example_batch=example)
+    return engine
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(tree))]
+
+
+class TestUniversalRoundtrip:
+    def test_cross_topology_cross_stage(self, devices, tmp_path):
+        """zero-2 dp=8 → fragments → zero-3 fsdp=8: params, fp32 masters and
+        Adam moments all survive the retargeting (the reference needs the
+        whole ds_to_universal merge pipeline for exactly this)."""
+        src = _build(2, {"dp": 8})
+        for b in _data(5, src.train_batch_size):
+            src.train_batch(b)
+        udir = str(tmp_path / "universal")
+        src.export_universal_checkpoint(udir)
+
+        dst = _build(3, {"dp": 1, "fsdp": 8})
+        meta = dst.load_universal_checkpoint(udir)
+        assert meta["step"] == 5 and dst.global_steps == 5
+
+        # dst params are cast(fp32 master) exactly; src's live bf16 params may
+        # sit one ulp off the master (delta-apply rounding), so compare dst
+        # against the master — the authoritative value
+        from deepspeed_tpu.checkpoint.universal import (_adam_states,
+                                                        _master_states)
+        src_master = _master_states(jax.device_get(src.state.opt_state))
+        for m, b in zip(_leaves(src_master[0]["master"]),
+                        _leaves(dst.state.params)):
+            np.testing.assert_array_equal(m.astype(b.dtype), b)
+        sm = src_master
+        dm = _master_states(jax.device_get(dst.state.opt_state))
+        for a, b in zip(_leaves(sm[0]["master"]), _leaves(dm[0]["master"])):
+            np.testing.assert_array_equal(a, b)
+        sa = _adam_states(jax.device_get(src.state.opt_state))
+        da = _adam_states(jax.device_get(dst.state.opt_state))
+        for a, b in zip(_leaves(sa[0]["mu"]), _leaves(da[0]["mu"])):
+            np.testing.assert_array_equal(a, b)
+
+        # the retargeted engine continues training bit-compatibly: one more
+        # identical batch produces the same loss on both engines
+        batch = next(_data(1, src.train_batch_size, seed=7))
+        la = float(src.train_batch(batch).loss)
+        lb = float(dst.train_batch(batch).loss)
+        assert abs(la - lb) < 5e-3, (la, lb)
+
+    def test_strict_mismatch_raises(self, devices, tmp_path):
+        src = _build(2, {"dp": 8})
+        udir = str(tmp_path / "u")
+        src.export_universal_checkpoint(udir)
+        frags, _ = load_universal(udir)
+        frags.pop(sorted(frags)[0])
+        with pytest.raises(ValueError, match="does not match"):
+            apply_universal(jax.device_get(src.state), frags)
+
+    def test_torch_pt_fragments_load(self, devices, tmp_path):
+        """Cross-framework leg: reference-style ``fp32.pt`` torch fragments
+        are ingested transparently (ds_to_universal.py output format)."""
+        torch = pytest.importorskip("torch")
+        src = _build(2, {"dp": 8})
+        for b in _data(2, src.train_batch_size):
+            src.train_batch(b)
+        udir = str(tmp_path / "u")
+        src.export_universal_checkpoint(udir)
+
+        # rewrite every fragment as torch .pt, removing the .npy
+        zdir = os.path.join(udir, "zero")
+        for name in os.listdir(zdir):
+            d = os.path.join(zdir, name)
+            for key in ("fp32", "exp_avg", "exp_avg_sq"):
+                p = os.path.join(d, key + ".npy")
+                if os.path.exists(p):
+                    torch.save(torch.from_numpy(np.load(p)),
+                               os.path.join(d, key + ".pt"))
+                    os.remove(p)
+
+        dst = _build(2, {"dp": 8})
+        dst.load_universal_checkpoint(udir)
+        from deepspeed_tpu.checkpoint.universal import _master_states
+        src_master = _master_states(jax.device_get(src.state.opt_state))
+        for m, b in zip(_leaves(src_master[0]["master"]),
+                        _leaves(dst.state.params)):
+            np.testing.assert_array_equal(m.astype(b.dtype), b)
+
+    def test_cli_export_from_orbax(self, devices, tmp_path):
+        """ds_to_universal-style offline conversion: engine orbax checkpoint
+        → CLI export → fragments match the live state."""
+        src = _build(2, {"dp": 8})
+        for b in _data(2, src.train_batch_size):
+            src.train_batch(b)
+        ckpt = str(tmp_path / "ckpt")
+        src.save_checkpoint(ckpt)
+        out = str(tmp_path / "universal")
+        assert _cli(["export", ckpt, out]) == 0
+        frags, meta = load_universal(out)
+        from deepspeed_tpu.checkpoint.universal import (_flatten_params,
+                                                        _master_states)
+        masters = _master_states(jax.device_get(src.state.opt_state))
+        flat_masters = {p: np.asarray(v) for p, v in _flatten_params(
+            masters[0]["master"]).items()}
+        assert set(frags) == set(flat_masters)
+        for p, want in flat_masters.items():
+            np.testing.assert_array_equal(frags[p]["fp32"],
+                                          want.astype(np.float32))
+
+
+class TestUniversalOffload:
+    def test_offload_roundtrip(self, devices, tmp_path):
+        """ZeRO-Offload engines export host-resident masters/moments and
+        reload them (reference: ds_to_universal over the swap tier)."""
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2,
+                                  "offload_optimizer": {"device": "cpu"}},
+            "bf16": {"enabled": True},
+            "mesh": {"dp": 8},
+            "steps_per_print": 0,
+        }
+        model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+        example = {"input_ids": np.zeros((2, SEQ), np.int32)}
+        src, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, example_batch=example)
+        for b in _data(3, src.train_batch_size):
+            src.train_batch(b)
+        udir = str(tmp_path / "u")
+        src.export_universal_checkpoint(udir)
+        frags, meta = load_universal(udir)
+        assert meta["step"] == 3
+        assert all("exp_avg" in f for f in frags.values())
+
+        dst, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg, example_batch=example)
+        dst.load_universal_checkpoint(udir)
+        a = dst.offload_opt.state_dict()
+        b = src.offload_opt.state_dict()
+        assert a["step_count"] == b["step_count"]
+        for k in b:
+            if k == "step_count":
+                continue
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
