@@ -152,6 +152,16 @@ class RandomLTDConfig(DeepSpeedConfigModel):
     schedule_config: dict = Field(default_factory=dict)
 
 
+class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
+    """reference: runtime/progressive_layer_drop.py (PLD, arXiv 2010.13369) —
+    theta(t) = (1-theta)*exp(-gamma*t) + theta; layer l keeps its sublayers
+    with prob 1 - (l/L)*(1-theta(t))."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class HybridEngineConfig(DeepSpeedConfigModel):
     """reference: inference/config.py DeepSpeedHybridEngineConfig (consumed by
     runtime/hybrid_engine.py via deepspeed.initialize)."""
@@ -264,6 +274,8 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(
         default_factory=HybridEngineConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = Field(
+        default_factory=ProgressiveLayerDropConfig)
     # reference deepspeed/compression/ config block (weight_quantization
     # groups; consumed by compression/basic.py via the engine loss hook)
     compression_training: Optional[dict] = None

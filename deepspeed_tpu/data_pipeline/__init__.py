@@ -7,3 +7,6 @@ from deepspeed_tpu.data_pipeline.sampler import (  # noqa: F401
     CurriculumDataSampler, truncate_to_difficulty)
 from deepspeed_tpu.data_pipeline.random_ltd import (  # noqa: F401
     RandomLTDScheduler, random_ltd_block_indices)
+from deepspeed_tpu.data_pipeline.analyzer import (  # noqa: F401
+    DataAnalyzer, load_sample_to_metric, metric_seqlen, metric_vocab_counts,
+    metric_vocab_rarity)

@@ -1,0 +1,209 @@
+"""Offline data analyzer — map-reduce metric computation over a dataset.
+
+Reference: runtime/data_pipeline/data_sampling/data_analyzer.py (DataAnalyzer
+``run_map``/``run_reduce`` over worker×thread shards, writing sample→metric
+and metric→sample index files consumed by DeepSpeedDataSampler) and
+DistributedDataAnalyzer (:455, the torch.distributed variant).
+
+TPU-native shape: metric computation is host-side numpy (there is no reason
+to burn chip time on seqlen counting), parallelized with a thread pool per
+worker and sharded across workers by ``worker_id/num_workers`` exactly like
+the reference's launcher-spawned workers.  Outputs are plain ``.npy``/``.json``
+files the curriculum sampler (sampler.py CurriculumDataSampler) reads —
+the role of the reference's indexed-dataset metric files.
+
+Two metric types (reference data_analyzer.py update_metric_results):
+
+- ``single_value_per_sample``: f(sample) → scalar; reduce emits
+  ``<metric>/sample_to_metric.npy`` ([N] values, the sampler's difficulty
+  array), ``<metric>/metric_to_sample.json`` (value → sample indices), and
+  ``<metric>/sample_index_sorted.npy`` (indices sorted by value).
+- ``accumulate_value_over_samples``: f(sample) → vector accumulated over the
+  dataset (e.g. vocab counts for the rarity curriculum); reduce emits
+  ``<metric>/metric_value.npy``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+SINGLE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value_over_samples"
+
+
+class DataAnalyzer:
+    """Map-reduce metric analysis over ``dataset`` (anything indexable).
+
+    metric_functions map a SAMPLE (``dataset[i]``) to a scalar (SINGLE) or a
+    vector (ACCUMULATE).  ``num_workers``/``worker_id`` shard the map phase
+    across independent processes (each writes its own files under
+    ``save_path/worker_<id>``); ``run_reduce`` on any one host merges.
+    """
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable[[Any], Any]],
+                 metric_types: Optional[Sequence[str]] = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1, worker_id: int = 0,
+                 num_threads: int = 4):
+        if len(metric_names) != len(metric_functions):
+            raise ValueError("metric_names and metric_functions must align")
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or [SINGLE] * len(metric_names))
+        for t in self.metric_types:
+            if t not in (SINGLE, ACCUMULATE):
+                raise ValueError(f"unknown metric type {t!r}")
+        self.save_path = save_path
+        self.num_workers = int(num_workers)
+        self.worker_id = int(worker_id)
+        self.num_threads = max(1, int(num_threads))
+
+    # ---- map ----------------------------------------------------------
+
+    def _shard_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        return np.arange(self.worker_id, n, self.num_workers)
+
+    def run_map(self) -> str:
+        """Compute this worker's shard; write per-metric partials."""
+        idx = self._shard_indices()
+        wdir = os.path.join(self.save_path, f"worker_{self.worker_id}")
+        os.makedirs(wdir, exist_ok=True)
+
+        def one_metric(mi: int):
+            name, fn = self.metric_names[mi], self.metric_functions[mi]
+            mtype = self.metric_types[mi]
+            if mtype == SINGLE:
+                vals = np.empty(len(idx), np.float64)
+
+                def chunk(lo_hi):
+                    lo, hi = lo_hi
+                    for j in range(lo, hi):
+                        vals[j] = float(fn(self.dataset[int(idx[j])]))
+
+                bounds = np.linspace(0, len(idx), self.num_threads + 1,
+                                     dtype=int)
+                with ThreadPoolExecutor(self.num_threads) as ex:
+                    list(ex.map(chunk, zip(bounds[:-1], bounds[1:])))
+                np.save(os.path.join(wdir, f"{name}.values.npy"), vals)
+            else:
+                total = None
+                for i in idx:
+                    v = np.asarray(fn(self.dataset[int(i)]), np.float64)
+                    total = v if total is None else total + v
+                if total is None:
+                    total = np.zeros(0, np.float64)
+                np.save(os.path.join(wdir, f"{name}.accum.npy"), total)
+
+        for mi in range(len(self.metric_names)):
+            one_metric(mi)
+        np.save(os.path.join(wdir, "indices.npy"), idx)
+        return wdir
+
+    # ---- reduce -------------------------------------------------------
+
+    def run_reduce(self) -> Dict[str, str]:
+        """Merge all workers' partials into the final index files
+        (reference merge_map_results)."""
+        n = len(self.dataset)
+        out: Dict[str, str] = {}
+        shards = []
+        for w in range(self.num_workers):
+            wdir = os.path.join(self.save_path, f"worker_{w}")
+            ipath = os.path.join(wdir, "indices.npy")
+            if not os.path.exists(ipath):
+                raise FileNotFoundError(
+                    f"worker {w} map output missing ({ipath}); run run_map "
+                    f"on every worker before run_reduce")
+            shards.append((wdir, np.load(ipath)))
+
+        all_idx = np.sort(np.concatenate([i for _, i in shards])) \
+            if shards else np.zeros(0, int)
+        if all_idx.shape != (n,) or not (all_idx == np.arange(n)).all():
+            raise ValueError(
+                f"worker shards cover {all_idx.size}/{n} samples (duplicates "
+                f"or gaps) — run_reduce's num_workers must match the map "
+                f"phase's, and stale worker_* dirs must be cleared")
+
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            mdir = os.path.join(self.save_path, name)
+            os.makedirs(mdir, exist_ok=True)
+            if mtype == SINGLE:
+                vals = np.empty(n, np.float64)
+                for wdir, idx in shards:
+                    vals[idx] = np.load(
+                        os.path.join(wdir, f"{name}.values.npy"))
+                np.save(os.path.join(mdir, "sample_to_metric.npy"), vals)
+                order = np.argsort(vals, kind="stable")
+                np.save(os.path.join(mdir, "sample_index_sorted.npy"), order)
+                v2s: Dict[str, List[int]] = {}
+                for i in order:
+                    v2s.setdefault(repr(float(vals[i])), []).append(int(i))
+                with open(os.path.join(mdir, "metric_to_sample.json"),
+                          "w") as f:
+                    json.dump(v2s, f)
+            else:
+                total = None
+                for wdir, _ in shards:
+                    v = np.load(os.path.join(wdir, f"{name}.accum.npy"))
+                    total = v if total is None else total + v
+                np.save(os.path.join(mdir, "metric_value.npy"), total)
+            out[name] = mdir
+        return out
+
+    def run_map_reduce(self) -> Dict[str, str]:
+        if self.num_workers != 1:
+            raise ValueError(
+                "run_map_reduce is the single-process convenience; with "
+                "num_workers > 1 call run_map per worker then run_reduce "
+                "once (reference DataAnalyzer.run_map_reduce barrier)")
+        self.run_map()
+        return self.run_reduce()
+
+
+# ---------------------------------------------------------------------------
+# stock metrics (reference data_analyzer test metrics + curriculum recipes)
+# ---------------------------------------------------------------------------
+
+def metric_seqlen(sample) -> int:
+    """Token count of a sample ({"input_ids": ...} or raw array)."""
+    ids = sample["input_ids"] if isinstance(sample, dict) else sample
+    return int(np.asarray(ids).shape[-1])
+
+
+def metric_vocab_counts(vocab_size: int):
+    """ACCUMULATE metric: token histogram over the corpus."""
+
+    def fn(sample):
+        ids = sample["input_ids"] if isinstance(sample, dict) else sample
+        return np.bincount(np.asarray(ids).reshape(-1),
+                           minlength=vocab_size).astype(np.float64)
+
+    return fn
+
+
+def metric_vocab_rarity(vocab_counts: np.ndarray):
+    """SINGLE metric derived from a counts pass: mean -log p(token) — the
+    reference's vocabulary-rarity curriculum (data_sampling docs)."""
+    p = vocab_counts / max(vocab_counts.sum(), 1.0)
+    logp = -np.log(np.maximum(p, 1e-12))
+
+    def fn(sample):
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                         else sample).reshape(-1)
+        return float(logp[ids].mean()) if ids.size else 0.0
+
+    return fn
+
+
+def load_sample_to_metric(save_path: str, metric_name: str) -> np.ndarray:
+    """The difficulty array CurriculumDataSampler consumes."""
+    return np.load(os.path.join(save_path, metric_name,
+                                "sample_to_metric.npy"))

@@ -155,6 +155,22 @@ class DeepSpeedTPUEngine:
                     f"random_ltd_layer_ids mismatch: model cfg has "
                     f"{model_ids}, ds_config says {cfg_ids} — set them in "
                     f"ONE place")
+        # progressive layer drop (reference engine.progressive_layer_drop
+        # built at initialize() when the config block is enabled)
+        pld_cfg = config.progressive_layer_drop
+        if pld_cfg.enabled:
+            if getattr(model, "is_pipeline", False) or isinstance(model,
+                                                                  tuple):
+                raise ValueError(
+                    "progressive_layer_drop requires a flax LM that reads "
+                    "batch['pld_theta'] (models/gpt.py GPT); pipeline and "
+                    "duck-typed models would silently ignore it")
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.pld = ProgressiveLayerDrop(theta=pld_cfg.theta,
+                                            gamma=pld_cfg.gamma)
+        else:
+            self.pld = None
         # pipeline models consume all gas microbatches in one pipelined scan
         # (reference: PipelineEngine.train_batch owns the microbatch loop)
         self.gas_in_model = bool(getattr(model, "is_pipeline", False))
@@ -463,6 +479,11 @@ class DeepSpeedTPUEngine:
                     return p
                 return quantized_weight_gather(p, mesh, "fsdp", d)
             params = jax.tree_util.tree_map(gather, params, self._qwz_dims)
+        if self.pld is not None and step is not None:
+            # theta is a pure function of the step — computed in-graph, so
+            # PLD adds zero host↔device traffic (reference updates it on the
+            # host each step, progressive_layer_drop.py update_state)
+            batch = dict(batch, pld_theta=self.pld.theta_at(step))
         loss = self._apply_fn(params, batch, rng)
         return (loss * scale).astype(jnp.float32), loss
 
@@ -884,6 +905,10 @@ class DeepSpeedTPUEngine:
         """Console print + monitor fan-out + timer log + flops profile, at
         their configured cadences (reference engine.py:2264 _write_monitor,
         :1797 flops profiler hook, :145 EngineTimers)."""
+        if self.pld is not None:
+            # keep the host mirror in sync with the in-graph schedule so
+            # get_theta()/get_state() report the effective value
+            self.pld.update_state(self.global_steps)
         self._maybe_print(metrics)
         spp = self.config.steps_per_print
         at_cadence = spp and self.global_steps % spp == 0

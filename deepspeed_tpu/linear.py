@@ -127,3 +127,66 @@ def lora_trainable_mask(params) -> Any:
         frozen = any(k == "weight" for k in keys)
         marks.append(not frozen)
     return jax.tree_util.tree_unflatten(treedef, marks)
+
+
+class TiledLinear(nn.Module):
+    """y = x @ W split into an (in_splits × out_splits) tile grid.
+
+    Reference: runtime/zero/tiling.py TiledLinear — under ZeRO-3 each tile is
+    a separate parameter, so only ONE tile's weight is ever fully gathered at
+    a time (peak live weight memory drops from in·out to
+    in·out/(in_splits·out_splits)); the tile loop also bounds activation
+    scratch for very wide linears.
+
+    TPU shape: tiles are independent flax params carrying the same logical
+    axes as a dense kernel (fsdp/tp sharding falls out of partition.py);
+    ``remat_tiles=True`` wraps each tile matmul in jax.checkpoint so the
+    backward regathers instead of saving — the reference's
+    memory-for-compute trade, expressed to XLA."""
+
+    in_features: int
+    out_features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    remat_tiles: bool = False
+    param_dtype: Any = jnp.float32
+    axis_names: Tuple[str, str] = ("embed", "mlp")  # logical (in, out) axes
+
+    @nn.compact
+    def __call__(self, x):
+        if self.in_features % self.in_splits or \
+                self.out_features % self.out_splits:
+            raise ValueError(
+                f"in/out features ({self.in_features},{self.out_features}) "
+                f"must divide the tile grid ({self.in_splits},"
+                f"{self.out_splits})")
+        tin = self.in_features // self.in_splits
+        tout = self.out_features // self.out_splits
+        init = nn.initializers.normal(stddev=0.02)
+
+        def tile_mm(xi, w):
+            return xi @ w.astype(x.dtype)
+
+        if self.remat_tiles:
+            tile_mm = jax.checkpoint(tile_mm)
+
+        outs = []
+        for j in range(self.out_splits):
+            acc = None
+            for i in range(self.in_splits):
+                w = self.param(
+                    f"tile_{i}_{j}",
+                    nn.with_partitioning(init, self.axis_names),
+                    (tin, tout), self.param_dtype)
+                y = tile_mm(x[..., i * tin:(i + 1) * tin], w)
+                acc = y if acc is None else acc + y
+            outs.append(acc)
+        y = jnp.concatenate(outs, axis=-1)
+        if self.use_bias:
+            b = self.param("bias",
+                           nn.with_partitioning(nn.initializers.zeros,
+                                                (self.axis_names[1],)),
+                           (self.out_features,), self.param_dtype)
+            y = y + b.astype(x.dtype)
+        return y

@@ -386,8 +386,26 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, deterministic: bool,
                  use_cache: bool = False, kv_mask=None, start_index=0,
-                 kv_positions=None):
+                 kv_positions=None, pld_keep=None):
         c = self.cfg
+
+        def pld_mask():
+            # progressive layer drop (runtime/progressive_layer_drop.py):
+            # one Bernoulli per sublayer per step, shared across the batch;
+            # None = gate inactive (eval / cache / disabled)
+            if pld_keep is None or deterministic or use_cache:
+                return None
+            return jax.random.bernoulli(self.make_rng("dropout"), pld_keep)
+
+        def pld_gate(delta):
+            m = pld_mask()
+            if m is None:
+                return delta
+            # inverted scaling (PLD paper Alg. 1): kept branches divide by p
+            # so train-time expectation matches the full-depth eval forward
+            return delta * (m.astype(delta.dtype)
+                            / jnp.asarray(pld_keep, delta.dtype))
+
         if c.parallel_block:
             # falcon/phi-style parallel residual: attention and MLP both read
             # the SAME residual input (one shared input norm, or falcon-40b's
@@ -402,11 +420,13 @@ class Block(nn.Module):
             a = Attention(c, mesh=self.mesh)(h_attn, positions, deterministic,
                                              use_cache, kv_mask, start_index,
                                              kv_positions)
-            return x + a + MLP(c)(h_mlp, deterministic), jnp.float32(0.0)
-        x = x + Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
-                                             deterministic, use_cache,
-                                             kv_mask, start_index,
-                                             kv_positions)
+            return (x + pld_gate(a) + pld_gate(MLP(c)(h_mlp, deterministic)),
+                    jnp.float32(0.0))
+        x = x + pld_gate(
+            Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
+                                         deterministic, use_cache,
+                                         kv_mask, start_index,
+                                         kv_positions))
         if self.is_moe:
             from deepspeed_tpu.moe import MoE
             rng = (self.make_rng("dropout")
@@ -420,10 +440,16 @@ class Block(nn.Module):
                                dropless=c.moe_dropless,
                                gated=c.gated_mlp,
                                name="moe")(Norm(c)(x), rng, deterministic)
+            m = pld_mask()
+            if m is not None:     # one keep gates BOTH the output and the
+                scale = m.astype(moe_out.dtype) / jnp.asarray(
+                    pld_keep, moe_out.dtype)
+                moe_out = moe_out * scale
+                aux = aux * scale.astype(aux.dtype)  # dropped ffn: no LB loss
             x = x + moe_out
         else:
             aux = jnp.float32(0.0)
-            x = x + MLP(c)(Norm(c)(x), deterministic)
+            x = x + pld_gate(MLP(c)(Norm(c)(x), deterministic))
         return x, aux
 
 
@@ -437,7 +463,8 @@ class GPTBackbone(nn.Module):
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
                  positions=None, use_cache: bool = False, kv_mask=None,
-                 start_index=0, kv_positions=None, ltd_idx=None):
+                 start_index=0, kv_positions=None, ltd_idx=None,
+                 pld_theta=None):
         """positions: [B, T] absolute positions (default arange — the training
         path); the inference engine passes per-row positions for left-padded
         prompts and incremental decode.  kv_mask: [B, max_seq_len] validity of
@@ -473,17 +500,23 @@ class GPTBackbone(nn.Module):
             # reference examples put MoE on every other layer
             is_moe = (c.num_experts > 0 and i % c.moe_every == c.moe_every - 1)
             block = block_cls(c, is_moe, self.mesh, name=f"block_{i}")
+            keep = None
+            if pld_theta is not None:
+                from deepspeed_tpu.runtime.progressive_layer_drop import \
+                    layer_keep_prob
+                keep = layer_keep_prob(i, c.num_layers, pld_theta)
             if (ltd_idx is not None and i in ltd_layers and not use_cache):
                 from deepspeed_tpu.data_pipeline.random_ltd import \
                     apply_random_ltd
                 idx = ltd_idx[ltd_layers.index(i)]
                 x, aux = apply_random_ltd(
                     lambda xk, pk: block(xk, pk, deterministic, False,
-                                         None, 0, None),
+                                         None, 0, None, pld_keep=keep),
                     x, positions, idx)
             else:
                 x, aux = block(x, positions, deterministic,
-                               use_cache, kv_mask, start_index, kv_positions)
+                               use_cache, kv_mask, start_index, kv_positions,
+                               pld_keep=keep)
             aux_total = aux_total + aux
         x = Norm(c, name="final_norm")(x)
         return x, emb, aux_total
@@ -528,7 +561,9 @@ class GPT(nn.Module):
         x, emb, moe_aux = GPTBackbone(c, self.mesh,
                                       name="backbone")(input_ids,
                                                        deterministic,
-                                                       ltd_idx=ltd)
+                                                       ltd_idx=ltd,
+                                                       pld_theta=batch.get(
+                                                           "pld_theta"))
         if c.tie_embeddings:
             unembed = emb.astype(x.dtype).T                # [H, V]
         else:

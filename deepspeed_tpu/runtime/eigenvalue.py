@@ -1,0 +1,132 @@
+"""Hessian eigenvalue estimation — power iteration for MoQ.
+
+Reference: runtime/eigenvalue.py (Eigenvalue.compute_eigenvalue: per-layer
+power iteration using double-backward Hessian-vector products; the values
+drive the Mixture-of-Quantization schedule, docs/_tutorials/MoQ).
+
+TPU-native shape: the hand-rolled double backward becomes
+``jax.jvp(jax.grad(f), (p,), (v,))`` — forward-over-reverse HVP, compiled
+once per layer and run entirely on device.  No module hooks: layers are
+addressed as param-subtree paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_dot(a, b):
+    return sum(jnp.vdot(x, y) for x, y in zip(jax.tree_util.tree_leaves(a),
+                                              jax.tree_util.tree_leaves(b)))
+
+
+def _tree_norm(a):
+    return jnp.sqrt(_tree_dot(a, a).real)
+
+
+def hvp(loss_fn: Callable, params, v):
+    """Hessian-vector product ∇²L(params) · v (forward-over-reverse)."""
+    return jax.jvp(jax.grad(loss_fn), (params,), (v,))[1]
+
+
+def power_iteration(loss_fn: Callable, params, *, rng=None,
+                    max_iter: int = 100, tol: float = 1e-2,
+                    stability: float = 1e-6) -> float:
+    """Largest-magnitude Hessian eigenvalue of ``loss_fn`` at ``params``.
+
+    Matches the reference loop (eigenvalue.py:compute_eigenvalue): random
+    unit start, v ← H·v / ‖H·v‖, stop when |λ_k − λ_{k−1}| / |λ_k| < tol.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    v = jax.tree_util.tree_unflatten(
+        treedef, [jax.random.normal(k, x.shape, jnp.float32)
+                  for k, x in zip(keys, leaves)])
+
+    @jax.jit
+    def step(v):
+        n = _tree_norm(v) + stability
+        v = jax.tree_util.tree_map(lambda x: x / n, v)
+        w = hvp(loss_fn, params, v)
+        w = jax.tree_util.tree_map(jnp.nan_to_num, w)
+        lam = _tree_dot(v, w)
+        return w, lam
+
+    prev = 0.0
+    lam = 0.0
+    for _ in range(max_iter):
+        v, lam_dev = step(v)
+        lam = float(lam_dev)
+        if abs(lam) > 0 and abs(lam - prev) / abs(lam) < tol:
+            break
+        prev = lam
+    return lam
+
+
+class Eigenvalue:
+    """Per-layer Hessian eigenvalues over a flax param tree.
+
+    ``layer_paths`` select first-level-of-interest subtrees (e.g.
+    ``["backbone/block_0", "backbone/block_1"]``); each gets an independent
+    power iteration over a loss restricted to that subtree (block-diagonal
+    view, exactly the reference's per-layer treatment)."""
+
+    def __init__(self, max_iter: int = 100, tol: float = 1e-2,
+                 stability: float = 1e-6, seed: int = 0):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.seed = seed
+
+    @staticmethod
+    def _get(tree, path: str):
+        node = tree
+        for k in path.split("/"):
+            node = node[k]
+        return node
+
+    @staticmethod
+    def _set(tree, path: str, value):
+        parts = path.split("/")
+        if not isinstance(tree, dict):
+            raise TypeError("param tree must be a nested dict")
+
+        def rec(node, i):
+            if i == len(parts) - 1:
+                return {**node, parts[i]: value}
+            return {**node, parts[i]: rec(node[parts[i]], i + 1)}
+
+        return rec(tree, 0)
+
+    def compute(self, loss_fn: Callable[[Any], jnp.ndarray], params,
+                layer_paths: Sequence[str]) -> Dict[str, float]:
+        """{layer_path: |λ_max|} — post-processed like the reference
+        (compute_eigenvalue returns abs values for the quantization ratio)."""
+        out: Dict[str, float] = {}
+        rng = jax.random.PRNGKey(self.seed)
+        for i, path in enumerate(layer_paths):
+            sub = self._get(params, path)
+
+            def sub_loss(sub_params, _path=path):
+                return loss_fn(self._set(params, _path, sub_params))
+
+            lam = power_iteration(sub_loss, sub,
+                                  rng=jax.random.fold_in(rng, i),
+                                  max_iter=self.max_iter, tol=self.tol,
+                                  stability=self.stability)
+            out[path] = abs(lam)
+        return out
+
+    @staticmethod
+    def quantization_ratios(eigenvalues: Dict[str, float]) -> Dict[str, float]:
+        """Normalized λ/λ_max per layer — the MoQ schedule stretches each
+        layer's quantization period by this ratio (larger curvature →
+        quantize later)."""
+        top = max(eigenvalues.values()) if eigenvalues else 0.0
+        if top <= 0:
+            return {k: 1.0 for k in eigenvalues}
+        return {k: v / top for k, v in eigenvalues.items()}

@@ -1,0 +1,53 @@
+"""Progressive Layer Drop (PLD) — stochastic-depth schedule for training.
+
+Reference: runtime/progressive_layer_drop.py (ProgressiveLayerDrop), the PLD
+paper's theta schedule: theta(t) = (1 - theta̅)·exp(-gamma·t) + theta̅, with
+layer l (1-indexed of L) keeping its sublayers with probability
+1 - (l/L)·(1 - theta(t)).
+
+TPU shape: theta is a pure function of the step counter, so the engine
+computes it IN-GRAPH from ``state.step`` (runtime cost: two scalar flops) and
+threads it to the model through the batch dict — no host→device traffic, no
+recompile per step.  The host-side class below mirrors the reference API for
+logging/tests."""
+
+from __future__ import annotations
+
+import math
+
+
+class ProgressiveLayerDrop:
+    """Host-side schedule mirror (reference ProgressiveLayerDrop API)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = self.theta_host(global_step)
+        return self.current_theta
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    # ---- schedule in both host and traced forms ----
+
+    def theta_host(self, step: int) -> float:
+        return (1.0 - self.theta) * math.exp(-self.gamma * step) + self.theta
+
+    def theta_at(self, step):
+        """Traced version for in-jit use (step: traced int scalar)."""
+        import jax.numpy as jnp
+        t = step.astype(jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * t) + self.theta
+
+
+def layer_keep_prob(layer_idx: int, num_layers: int, theta):
+    """Keep probability for layer ``layer_idx`` (0-indexed): deeper layers
+    drop more; layer 0 keeps near-1, the last keeps exactly theta."""
+    frac = (layer_idx + 1) / max(num_layers, 1)
+    return 1.0 - frac * (1.0 - theta)

@@ -164,3 +164,91 @@ class TestRandomLTD:
         assert losses[-1] < losses[0]
         # curriculum reached max difficulty by step 10
         assert engine.curriculum_scheduler.current_difficulty == 64
+
+
+class TestDataAnalyzer:
+    """Offline map-reduce metric analysis (reference data_analyzer.py
+    test_compare_both_data_analyzers pattern: metric files must reproduce
+    per-sample values exactly, across any worker sharding)."""
+
+    def _dataset(self, n=37, seed=0):
+        rng = np.random.default_rng(seed)
+        return [{"input_ids": rng.integers(0, 32, size=(rng.integers(4, 20),))
+                 .astype(np.int32)} for _ in range(n)]
+
+    def test_single_metric_map_reduce(self, tmp_path):
+        from deepspeed_tpu.data_pipeline import (DataAnalyzer,
+                                                 load_sample_to_metric,
+                                                 metric_seqlen)
+        data = self._dataset()
+        out = DataAnalyzer(data, ["seqlen"], [metric_seqlen],
+                           save_path=str(tmp_path)).run_map_reduce()
+        vals = load_sample_to_metric(str(tmp_path), "seqlen")
+        want = [len(s["input_ids"]) for s in data]
+        np.testing.assert_array_equal(vals, want)
+        order = np.load(tmp_path / "seqlen" / "sample_index_sorted.npy")
+        assert (np.diff(vals[order]) >= 0).all()
+        import json
+        with open(tmp_path / "seqlen" / "metric_to_sample.json") as f:
+            v2s = json.load(f)
+        assert sum(len(v) for v in v2s.values()) == len(data)
+
+    def test_multi_worker_matches_single(self, tmp_path):
+        from deepspeed_tpu.data_pipeline import DataAnalyzer, metric_seqlen
+        data = self._dataset(n=25, seed=3)
+        for w in range(3):
+            DataAnalyzer(data, ["seqlen"], [metric_seqlen],
+                         save_path=str(tmp_path / "multi"),
+                         num_workers=3, worker_id=w).run_map()
+        DataAnalyzer(data, ["seqlen"], [metric_seqlen],
+                     save_path=str(tmp_path / "multi"),
+                     num_workers=3).run_reduce()
+        DataAnalyzer(data, ["seqlen"], [metric_seqlen],
+                     save_path=str(tmp_path / "single")).run_map_reduce()
+        a = np.load(tmp_path / "multi" / "seqlen" / "sample_to_metric.npy")
+        b = np.load(tmp_path / "single" / "seqlen" / "sample_to_metric.npy")
+        np.testing.assert_array_equal(a, b)
+
+    def test_accumulate_then_rarity_curriculum(self, tmp_path):
+        """Two-pass vocab-rarity recipe: counts pass (ACCUMULATE) feeds the
+        rarity metric (SINGLE) whose output drives the curriculum sampler."""
+        from deepspeed_tpu.data_pipeline import (CurriculumDataSampler,
+                                                 DataAnalyzer,
+                                                 load_sample_to_metric,
+                                                 metric_vocab_counts,
+                                                 metric_vocab_rarity)
+        from deepspeed_tpu.data_pipeline.analyzer import ACCUMULATE
+        data = self._dataset(n=20, seed=1)
+        DataAnalyzer(data, ["vocab"], [metric_vocab_counts(32)],
+                     metric_types=[ACCUMULATE],
+                     save_path=str(tmp_path)).run_map_reduce()
+        counts = np.load(tmp_path / "vocab" / "metric_value.npy")
+        total = sum(len(s["input_ids"]) for s in data)
+        assert counts.sum() == total
+        DataAnalyzer(data, ["rarity"], [metric_vocab_rarity(counts)],
+                     save_path=str(tmp_path)).run_map_reduce()
+        rarity = load_sample_to_metric(str(tmp_path), "rarity")
+        assert rarity.shape == (20,) and (rarity > 0).all()
+        from deepspeed_tpu.data_pipeline import CurriculumScheduler
+        top = float(np.ceil(rarity.max()))
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": top,
+            "max_difficulty": top,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 1,
+                                "difficulty_step": 1}})
+        sampler = CurriculumDataSampler(rarity, batch_size=4, scheduler=sched,
+                                        seed=0)
+        batch = next(iter(sampler))
+        assert len(batch) == 4
+
+    def test_reduce_missing_worker_raises(self, tmp_path):
+        from deepspeed_tpu.data_pipeline import DataAnalyzer, metric_seqlen
+        import pytest as _pytest
+        data = self._dataset(n=6)
+        DataAnalyzer(data, ["seqlen"], [metric_seqlen],
+                     save_path=str(tmp_path), num_workers=2,
+                     worker_id=0).run_map()
+        with _pytest.raises(FileNotFoundError, match="worker 1"):
+            DataAnalyzer(data, ["seqlen"], [metric_seqlen],
+                         save_path=str(tmp_path), num_workers=2).run_reduce()

@@ -1,0 +1,156 @@
+"""Misc-runtime components: progressive layer drop, Hessian eigenvalue
+(MoQ), tiled linear (reference runtime/progressive_layer_drop.py,
+runtime/eigenvalue.py, runtime/zero/tiling.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, GPTConfig
+
+VOCAB, SEQ = 64, 16
+
+
+def _data(n_batches, global_bs, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    for _ in range(n_batches):
+        yield {"input_ids": pool[rng.integers(0, len(pool), size=(global_bs,))]}
+
+
+class TestProgressiveLayerDrop:
+    def test_theta_schedule(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import (
+            ProgressiveLayerDrop, layer_keep_prob)
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        assert pld.update_state(0) == pytest.approx(1.0)
+        late = pld.update_state(10_000)
+        assert late == pytest.approx(0.5, abs=1e-3)   # decays to theta̅
+        # traced form matches the host form
+        t = pld.theta_at(jnp.asarray(137))
+        assert float(t) == pytest.approx(pld.theta_host(137), rel=1e-5)
+        # deeper layers drop more; layer 0 barely drops
+        assert layer_keep_prob(0, 4, 0.5) > layer_keep_prob(3, 4, 0.5)
+        assert layer_keep_prob(3, 4, 0.5) == pytest.approx(0.5)
+
+    def test_engine_trains_with_pld(self, devices):
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "mesh": {"dp": 8},
+            "steps_per_print": 0,
+            "progressive_layer_drop": {"enabled": True, "theta": 0.6,
+                                       "gamma": 0.01},
+        }
+        model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg,
+            example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+        assert engine.pld is not None
+        losses = [float(engine.train_batch(b).loss)
+                  for b in _data(30, engine.train_batch_size)]
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+    def test_pld_inactive_at_inference(self, devices):
+        """Deterministic forward ignores pld_theta (no stochastic depth at
+        eval, reference PLD is train-only)."""
+        model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+        rng = jax.random.PRNGKey(0)
+        batch = {"input_ids": np.zeros((2, SEQ), np.int32)}
+        params = model.init(rng, batch)
+        a = model.apply(params, dict(batch, pld_theta=jnp.float32(0.1)),
+                        deterministic=True, rngs={"dropout": rng})
+        b = model.apply(params, batch, deterministic=True,
+                        rngs={"dropout": rng})
+        np.testing.assert_allclose(float(a), float(b))
+
+
+class TestEigenvalue:
+    def test_quadratic_known_eigenvalue(self):
+        """L(x) = ½ xᵀAx has Hessian A — power iteration must find A's top
+        |eigenvalue| (reference eigenvalue.py power-iteration semantics)."""
+        from deepspeed_tpu.runtime.eigenvalue import power_iteration
+        rng = np.random.default_rng(0)
+        q, _ = np.linalg.qr(rng.normal(size=(8, 8)))
+        eig = np.array([5.0, -3.0, 2.0, 1.0, 0.5, 0.2, 0.1, 0.05])
+        a = jnp.asarray(q @ np.diag(eig) @ q.T, jnp.float32)
+
+        def loss(x):
+            return 0.5 * x @ a @ x
+
+        lam = power_iteration(loss, jnp.ones(8, jnp.float32), max_iter=200,
+                              tol=1e-5)
+        assert lam == pytest.approx(5.0, rel=1e-2)
+
+    def test_per_layer_on_model(self, devices):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+        batch = {"input_ids":
+                 np.random.default_rng(0).integers(
+                     0, VOCAB, (2, SEQ)).astype(np.int32)}
+        params = model.init(jax.random.PRNGKey(0), batch)["params"]
+
+        def loss_fn(p):
+            return model.apply(
+                {"params": p}, batch, deterministic=True)
+
+        ev = Eigenvalue(max_iter=20, tol=1e-2)
+        vals = ev.compute(loss_fn, params,
+                          ["backbone/block_0", "backbone/block_1"])
+        assert set(vals) == {"backbone/block_0", "backbone/block_1"}
+        assert all(np.isfinite(v) and v >= 0 for v in vals.values())
+        ratios = Eigenvalue.quantization_ratios(vals)
+        assert max(ratios.values()) == pytest.approx(1.0)
+
+
+class TestTiledLinear:
+    def test_matches_dense(self):
+        """Tile grid output == dense matmul with the same weights stitched
+        (reference tiling.py TiledLinear.copy_params_from equivalence)."""
+        from deepspeed_tpu.linear import TiledLinear
+        lin = TiledLinear(in_features=12, out_features=8, in_splits=3,
+                          out_splits=2, use_bias=True)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(5, 12)),
+                        jnp.float32)
+        params = lin.init(jax.random.PRNGKey(1), x)
+        y = lin.apply(params, x)
+        # stitch the dense W from tiles (unbox the Partitioned metadata)
+        import flax.core.meta as meta
+        p = jax.tree_util.tree_map(np.asarray, meta.unbox(params))["params"]
+        w = np.block([[p[f"tile_{i}_{j}"] for j in range(2)]
+                      for i in range(3)])
+        want = x @ w + p["bias"]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_remat_tiles_same_grads(self):
+        from deepspeed_tpu.linear import TiledLinear
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8)),
+                        jnp.float32)
+        a = TiledLinear(in_features=8, out_features=6, in_splits=2,
+                        out_splits=3, remat_tiles=False)
+        b = TiledLinear(in_features=8, out_features=6, in_splits=2,
+                        out_splits=3, remat_tiles=True)
+        params = a.init(jax.random.PRNGKey(2), x)
+
+        def loss(m, p):
+            return jnp.sum(m.apply(p, x) ** 2)
+
+        ga = jax.grad(lambda p: loss(a, p))(params)
+        gb = jax.grad(lambda p: loss(b, p))(params)
+        for u, v in zip(jax.tree_util.tree_leaves(ga),
+                        jax.tree_util.tree_leaves(gb)):
+            np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                       atol=1e-6)
+
+    def test_indivisible_raises(self):
+        from deepspeed_tpu.linear import TiledLinear
+        with pytest.raises(ValueError, match="divide"):
+            TiledLinear(in_features=10, out_features=8,
+                        in_splits=3).init(jax.random.PRNGKey(0),
+                                          jnp.zeros((2, 10)))
