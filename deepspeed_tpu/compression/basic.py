@@ -36,6 +36,8 @@ class CompressionSpec:
     target_bits: int = 8
     quantization_period: int = 0   # steps between stage halvings (0 = fixed)
     offset: int = 0                # step when quantization begins
+    scope: str = ""            # extra regex that must ALSO match (MoQ
+    #                            per-layer overrides, compression/moq.py)
 
     def stages(self) -> List[Tuple[int, int]]:
         """[(step_threshold, bits)] — start_bits at ``offset``, halving every
@@ -93,15 +95,18 @@ def scheduled_weight_qdq(params, specs: Sequence[CompressionSpec], step):
     in one compiled program."""
     if not specs:
         return params
-    compiled = [(re.compile(s.pattern), s.stages()) for s in specs]
+    compiled = [(re.compile(s.pattern),
+                 re.compile(s.scope) if s.scope else None,
+                 s.stages()) for s in specs]
 
     def visit(path, leaf):
         if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
             return leaf
         name = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
                         for p in path)
-        for rx, stages in compiled:
-            if rx.search(name):
+        for rx, scope_rx, stages in compiled:
+            if rx.search(name) and (scope_rx is None
+                                    or scope_rx.search(name)):
                 out = leaf
                 for thr, bits in stages:
                     if bits >= 16:       # ≥16 bits ≡ uncompressed on TPU

@@ -1,0 +1,52 @@
+"""Mixture of Quantization (MoQ) — eigenvalue-adaptive quantization schedule.
+
+Reference: runtime/quantize.py Quantizer (``quantize`` :51 — when
+``q_eigenvalue`` is on, each layer's quantization period is stretched by
+``factor = 1 + floor(eigenvalue_norm * 4)``, :70) fed by runtime/eigenvalue.py
+power iteration; engine hooks at runtime/engine.py:334,2160.
+
+TPU shape: eigenvalues come from runtime/eigenvalue.py (jvp-of-grad power
+iteration); the stretched schedule is expressed as per-layer scoped
+CompressionSpec overrides, so the whole MoQ schedule still compiles into the
+single staged-QDQ program (compression/basic.py scheduled_weight_qdq)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Sequence
+
+from deepspeed_tpu.compression.basic import CompressionSpec
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+
+def moq_adjusted_specs(specs: Sequence[CompressionSpec],
+                       eigenvalues: Dict[str, float],
+                       multiplier: int = 4) -> List[CompressionSpec]:
+    """Per-layer schedule stretch.
+
+    For every base spec with a halving schedule (quantization_period > 0) and
+    every layer path with a normalized eigenvalue r, emit a scoped override
+    whose period is ``period * (1 + floor(r * multiplier))`` — high-curvature
+    layers quantize later (reference quantize.py:70).  Base specs stay as
+    fallbacks for layers without an eigenvalue.
+    """
+    ratios = Eigenvalue.quantization_ratios(eigenvalues)
+    # stretch only the UNSCOPED base specs: prior MoQ overrides are replaced,
+    # not compounded, so calling this again (curriculum boundaries) is
+    # idempotent in count and period
+    base = [s for s in specs if not s.scope]
+    out: List[CompressionSpec] = []
+    for s in base:
+        if s.quantization_period > 0:
+            for path, r in ratios.items():
+                factor = 1 + math.floor(r * multiplier)
+                # "(/|$)" anchors the layer boundary — block_1 must not
+                # swallow block_10..19 under first-match-wins
+                out.append(dataclasses.replace(
+                    s,
+                    scope=re.escape(path.replace(".", "/")) + "(/|$)",
+                    quantization_period=s.quantization_period * factor))
+    out.extend(base)           # fallback for unmatched layers
+    return out

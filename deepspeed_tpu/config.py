@@ -230,6 +230,15 @@ class WandbConfig(DeepSpeedConfigModel):
     project: Optional[str] = None
 
 
+class CometConfig(DeepSpeedConfigModel):
+    """reference: monitor/comet.py CometConfig."""
+
+    enabled: bool = False
+    project: Optional[str] = None
+    experiment_name: Optional[str] = None
+    api_key: Optional[str] = None
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     """reference: "flops_profiler" block (profiling/flops_profiler)."""
 
@@ -269,6 +278,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     tensorboard: TensorboardConfig = Field(default_factory=TensorboardConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
+    comet: CometConfig = Field(default_factory=CometConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)

@@ -315,26 +315,7 @@ class DeepSpeedTPUEngine:
         # ---- build + jit the step functions ----
         self._jit_init = jax.jit(
             self._make_init(), out_shardings=self._as_shardings_tuple())
-        self._jit_grad = jax.jit(self._make_grad_fn())
-        if self.offloading:
-            # device runs grads-only; optimizer step is host-side
-            self._grads_batch_fn = self._make_grads_batch()
-            self._train_batch_fn = self._grads_batch_fn  # flops profiler trace
-            self._jit_grads_batch = jax.jit(
-                self._grads_batch_fn,
-                out_shardings=(self.grad_shardings, None, None))
-            self._jit_train_batch = None
-            self._jit_apply = None
-            self._jit_gnorm = jax.jit(optax.global_norm)
-        else:
-            self._train_batch_fn = self._make_train_batch()
-            self._jit_train_batch = jax.jit(
-                self._train_batch_fn,
-                donate_argnums=(0,),
-                out_shardings=(self._as_shardings_tuple(), None))
-            self._jit_apply = jax.jit(
-                self._make_apply_fn(), donate_argnums=(0,),
-                out_shardings=(self._as_shardings_tuple(), None))
+        self._build_step_functions()
 
         with self.mesh:
             self.state = self._jit_init(rng, example_batch)
@@ -437,6 +418,80 @@ class DeepSpeedTPUEngine:
 
     def _as_shardings_tuple(self):
         return self.state_shardings
+
+    def _build_step_functions(self):
+        """(Re)jit the train/grad step programs.  Called at init and again by
+        configure_moq — the compiled programs close over the compression
+        specs at trace time, so a schedule change needs a re-trace."""
+        self._jit_grad = jax.jit(self._make_grad_fn())
+        if self.offloading:
+            # device runs grads-only; optimizer step is host-side
+            self._grads_batch_fn = self._make_grads_batch()
+            self._train_batch_fn = self._grads_batch_fn  # flops profiler trace
+            self._jit_grads_batch = jax.jit(
+                self._grads_batch_fn,
+                out_shardings=(self.grad_shardings, None, None))
+            self._jit_train_batch = None
+            self._jit_apply = None
+            self._jit_gnorm = jax.jit(optax.global_norm)
+        else:
+            self._train_batch_fn = self._make_train_batch()
+            self._jit_train_batch = jax.jit(
+                self._train_batch_fn,
+                donate_argnums=(0,),
+                out_shardings=(self._as_shardings_tuple(), None))
+            self._jit_apply = jax.jit(
+                self._make_apply_fn(), donate_argnums=(0,),
+                out_shardings=(self._as_shardings_tuple(), None))
+
+    def configure_moq(self, sample_batch, layer_paths=None, *,
+                      multiplier: int = 4, max_iter: int = 20,
+                      tol: float = 1e-2) -> dict:
+        """Mixture-of-Quantization (reference runtime/quantize.py +
+        engine.py:334 _configure_eigenvalue): measure per-layer Hessian
+        eigenvalues on ``sample_batch``, stretch each layer's staged-QDQ
+        quantization period by 1 + floor(λ_norm·multiplier), and re-jit.
+
+        Call once after ``initialize`` (and optionally again at curriculum
+        boundaries).  Returns {layer_path: λ}.
+        """
+        if not self._compression_specs:
+            raise ValueError(
+                "configure_moq needs a compression_training block with "
+                "weight_quantization groups (none configured)")
+        from deepspeed_tpu.compression.moq import moq_adjusted_specs
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        if layer_paths is None:
+            # key listing needs only tree structure — no host transfer
+            root = self.state.params
+            prefix = ""
+            for key in ("params", "backbone"):   # flax collection + GPT tree
+                if isinstance(root, dict) and key in root:
+                    prefix += key + "/"
+                    root = root[key]
+            layer_paths = sorted(
+                f"{prefix}{k}" for k in root
+                if isinstance(root[k], dict) and k.startswith("block_"))
+            if not layer_paths:
+                raise ValueError("no block_* layers found; pass layer_paths")
+
+        rng = jax.random.PRNGKey(self.config.seed)
+
+        def loss_fn(p):
+            return self._apply_fn(p, sample_batch, rng)
+
+        ev = Eigenvalue(max_iter=max_iter, tol=tol)
+        with self.mesh:
+            eigenvalues = ev.compute(loss_fn, self.state.params, layer_paths)
+        self._compression_specs = moq_adjusted_specs(
+            self._compression_specs, eigenvalues, multiplier=multiplier)
+        self._build_step_functions()
+        log_dist(f"MoQ: adjusted quantization periods for "
+                 f"{len(eigenvalues)} layers "
+                 f"(λ_norm={Eigenvalue.quantization_ratios(eigenvalues)})",
+                 ranks=[0])
+        return eigenvalues
 
     def _make_init(self):
         compute_dtype = self.compute_dtype
@@ -1007,6 +1062,29 @@ class DeepSpeedTPUEngine:
             np.savez(os.path.join(save_dir, tag, "offload_state.npz"),
                      **self.offload_opt.state_dict())
         return tag
+
+    def save_16bit_model(self, save_dir: str,
+                         filename: str = "model_states.safetensors") -> str:
+        """Consolidated low-precision weight export (reference
+        engine.save_16bit_model / _zero3_consolidated_16bit_state_dict
+        engine.py:3485,3554): the FULL (unsharded) param tree in the compute
+        dtype, one safetensors file with dotted names — loadable without this
+        framework.  For HF-architecture models prefer
+        checkpoint.hf.save_hf_checkpoint (adds config.json)."""
+        import os as _os
+
+        from deepspeed_tpu.checkpoint.universal import _flatten_params
+        _os.makedirs(save_dir, exist_ok=True)
+        params = jax.device_get(self.state.params)   # gathers sharded leaves
+        flat = {k: np.asarray(v).astype(self.compute_dtype)
+                if np.asarray(v).dtype.kind == "f"
+                or np.asarray(v).dtype == jnp.bfloat16 else np.asarray(v)
+                for k, v in _flatten_params(params).items()}
+        path = _os.path.join(save_dir, filename)
+        if jax.process_index() == 0:
+            import safetensors.numpy
+            safetensors.numpy.save_file(flat, path)
+        return path
 
     def export_universal_checkpoint(self, out_dir: str) -> str:
         """reference checkpoint/ds_to_universal.py: dump per-parameter fp32

@@ -127,17 +127,50 @@ class WandbMonitor(Monitor):
             self._wandb.log({name: float(value)}, step=int(step))
 
 
+class CometMonitor(Monitor):
+    """reference monitor/comet.py (CometMonitor: experiment.__internal_api__
+    log_metric per event)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._experiment = None
+        if not self.enabled:
+            return
+        try:
+            import comet_ml
+        except ImportError:
+            logger.warning(
+                "comet monitor enabled but the comet_ml package is not "
+                "installed — comet events will be dropped")
+            self.enabled = False
+            return
+        kw = {}
+        if getattr(config, "api_key", None):
+            kw["api_key"] = config.api_key
+        self._experiment = comet_ml.Experiment(
+            project_name=config.project or None, **kw)
+        if getattr(config, "experiment_name", None):
+            self._experiment.set_name(config.experiment_name)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        if not self.enabled or self._experiment is None:
+            return
+        for name, value, step in event_list:
+            self._experiment.log_metric(name, float(value), step=int(step))
+
+
 class MonitorMaster(Monitor):
     """Fan-out writer (reference monitor/monitor.py:30): rank 0 only."""
 
     def __init__(self, config):
         # config is the top-level DeepSpeedTPUConfig (carries .tensorboard,
-        # .csv_monitor, .wandb sub-blocks)
+        # .csv_monitor, .wandb, .comet sub-blocks)
         self.tb_monitor = None
         self.csv_monitor = None
         self.wandb_monitor = None
+        self.comet_monitor = None
         self.enabled = (config.tensorboard.enabled or config.csv_monitor.enabled
-                        or config.wandb.enabled)
+                        or config.wandb.enabled or config.comet.enabled)
         if not _is_rank0():
             self.enabled = False
             return
@@ -147,10 +180,13 @@ class MonitorMaster(Monitor):
             self.csv_monitor = csvMonitor(config.csv_monitor)
         if config.wandb.enabled:
             self.wandb_monitor = WandbMonitor(config.wandb)
+        if config.comet.enabled:
+            self.comet_monitor = CometMonitor(config.comet)
 
     def write_events(self, event_list: Sequence[Event]) -> None:
         if not self.enabled:
             return
-        for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor):
+        for m in (self.tb_monitor, self.csv_monitor, self.wandb_monitor,
+                  self.comet_monitor):
             if m is not None:
                 m.write_events(event_list)

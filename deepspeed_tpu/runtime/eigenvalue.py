@@ -19,8 +19,13 @@ import jax.numpy as jnp
 
 
 def _tree_dot(a, b):
-    return sum(jnp.vdot(x, y) for x, y in zip(jax.tree_util.tree_leaves(a),
-                                              jax.tree_util.tree_leaves(b)))
+    tot = jnp.float32(0.0)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        if x.dtype == jax.dtypes.float0:    # int-leaf tangent: contributes 0
+            continue
+        tot = tot + jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+    return tot
 
 
 def _tree_norm(a):
@@ -40,19 +45,32 @@ def power_iteration(loss_fn: Callable, params, *, rng=None,
     Matches the reference loop (eigenvalue.py:compute_eigenvalue): random
     unit start, v ← H·v / ‖H·v‖, stop when |λ_k − λ_{k−1}| / |λ_k| < tol.
     """
+    import numpy as np
+
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     leaves, treedef = jax.tree_util.tree_flatten(params)
     keys = jax.random.split(rng, len(leaves))
+
+    def randn_like(k, x):
+        # tangents must carry the primal dtype (bf16 params → bf16 tangent);
+        # int/bool primals take float0 tangents per jvp's contract
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jax.random.normal(k, x.shape, x.dtype)
+        return np.zeros(x.shape, jax.dtypes.float0)
+
     v = jax.tree_util.tree_unflatten(
-        treedef, [jax.random.normal(k, x.shape, jnp.float32)
-                  for k, x in zip(keys, leaves)])
+        treedef, [randn_like(k, x) for k, x in zip(keys, leaves)])
 
     @jax.jit
     def step(v):
         n = _tree_norm(v) + stability
-        v = jax.tree_util.tree_map(lambda x: x / n, v)
+        v = jax.tree_util.tree_map(
+            lambda x: x if x.dtype == jax.dtypes.float0
+            else (x / n.astype(x.dtype)), v)
         w = hvp(loss_fn, params, v)
-        w = jax.tree_util.tree_map(jnp.nan_to_num, w)
+        w = jax.tree_util.tree_map(
+            lambda x: x if x.dtype == jax.dtypes.float0
+            else jnp.nan_to_num(x), w)
         lam = _tree_dot(v, w)
         return w, lam
 

@@ -1,3 +1,6 @@
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.memory import (instrument_w_nvtx, instrument_w_trace,
+                                        see_memory_usage)
 
-__all__ = ["logger", "log_dist"]
+__all__ = ["logger", "log_dist", "see_memory_usage", "instrument_w_trace",
+           "instrument_w_nvtx"]

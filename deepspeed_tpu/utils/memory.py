@@ -1,0 +1,63 @@
+"""Memory reporting + profiler annotations.
+
+Reference: runtime/utils.py see_memory_usage (torch.cuda allocator stats +
+host RSS), utils/nvtx.py instrument_w_nvtx (range push/pop on hot functions).
+
+TPU shape: device numbers come from the accelerator shim's memory_stats
+(XLA allocator stats where the backend exposes them); ranges become
+jax.profiler TraceAnnotations so they show up in xplane traces exactly where
+NVTX ranges show up in nsys."""
+
+from __future__ import annotations
+
+import functools
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def see_memory_usage(message: str, force: bool = False) -> dict:
+    """Log device + host memory usage (reference runtime/utils.py
+    see_memory_usage; rank-0 only like the original)."""
+    if not force:
+        return {}
+    from deepspeed_tpu.accelerator import get_accelerator
+    stats = get_accelerator().memory_stats() or {}
+    gb = 1024 ** 3
+    parts = []
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            parts.append(f"{key}={stats[key] / gb:.2f}GB")
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        parts.append(f"host_rss={rss / gb:.2f}GB")
+        stats["host_rss_bytes"] = rss
+    except Exception:  # noqa: BLE001 — resource is POSIX-only
+        pass
+    log_dist(f"MEM {message}: " + (", ".join(parts) or "no allocator stats"),
+             ranks=[0])
+    return stats
+
+
+def instrument_w_trace(fn=None, *, name: str = None):
+    """Decorator adding a jax.profiler TraceAnnotation around ``fn`` — the
+    xplane analog of the reference's instrument_w_nvtx (utils/nvtx.py): the
+    span shows up in `jax.profiler.trace` captures under the function name."""
+
+    def wrap(f):
+        label = name or getattr(f, "__qualname__", getattr(f, "__name__",
+                                                           "fn"))
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            import jax.profiler
+            with jax.profiler.TraceAnnotation(label):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+# API-parity alias (reference call sites read instrument_w_nvtx)
+instrument_w_nvtx = instrument_w_trace

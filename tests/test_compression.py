@@ -109,3 +109,73 @@ class TestLayerReduction:
     def test_missing_layer_raises(self):
         with pytest.raises(ValueError, match="not found"):
             layer_reduction_init({"params": {"backbone": {}}}, [3], 4)
+
+
+class TestMoQ:
+    """Eigenvalue-adaptive quantization schedule (reference
+    runtime/quantize.py:70 factor = 1 + floor(lambda_norm * 4))."""
+
+    def test_moq_adjusted_specs(self):
+        from deepspeed_tpu.compression.basic import CompressionSpec
+        from deepspeed_tpu.compression.moq import moq_adjusted_specs
+        base = [CompressionSpec(pattern="MLP_0", start_bits=8, target_bits=2,
+                                quantization_period=100)]
+        eig = {"backbone/block_0": 4.0, "backbone/block_1": 1.0}
+        out = moq_adjusted_specs(base, eig)
+        scoped = {s.scope: s for s in out if s.scope}
+        # top layer (ratio 1.0): period * (1 + floor(1*4)) = 500
+        assert scoped["backbone/block_0(/|$)"].quantization_period == 500
+        # ratio 0.25: period * (1 + floor(0.25*4)) = 200
+        assert scoped["backbone/block_1(/|$)"].quantization_period == 200
+        assert out[-1] == base[0]         # base fallback preserved
+        # idempotent under re-invocation (curriculum boundaries): overrides
+        # are replaced, never compounded
+        again = moq_adjusted_specs(out, eig)
+        assert len(again) == len(out)
+        assert sorted(s.quantization_period for s in again) == \
+            sorted(s.quantization_period for s in out)
+        # boundary anchor: block_1's scope must not match block_10
+        import re as _re
+        rx = _re.compile(scoped["backbone/block_1(/|$)"].scope)
+        assert rx.search("backbone/block_1/MLP_0/kernel")
+        assert not rx.search("backbone/block_10/MLP_0/kernel")
+
+    def test_engine_configure_moq(self):
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        rng = np.random.default_rng(0)
+        pool = rng.integers(0, 128, size=(4, 32)).astype(np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "mesh": {"dp": 1}, "steps_per_print": 0,
+                "compression_training": {"weight_quantization": {
+                    "shared_parameters": {"enabled": True,
+                                          "schedule_offset": 0},
+                    "different_groups": {"wq1": {
+                        "params": {"start_bits": 8, "target_bits": 2,
+                                   "quantization_period": 50},
+                        "modules": ["Attention_0|MLP_0"]}}}},
+            }, example_batch={"input_ids": pool})
+        n_before = len(engine._compression_specs)
+        eig = engine.configure_moq({"input_ids": pool}, max_iter=5)
+        assert sorted(eig) == ["params/backbone/block_0", "params/backbone/block_1"]
+        assert len(engine._compression_specs) == n_before + 2
+        assert any(s.scope and s.quantization_period > 50
+                   for s in engine._compression_specs)
+        # re-jitted programs still train
+        losses = [float(engine.train_batch({"input_ids": pool}).loss)
+                  for _ in range(10)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_configure_moq_without_compression_raises(self):
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        pool = np.zeros((2, 32), np.int32)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "mesh": {"dp": 1}, "steps_per_print": 0,
+            }, example_batch={"input_ids": pool})
+        with pytest.raises(ValueError, match="compression_training"):
+            engine.configure_moq({"input_ids": pool})

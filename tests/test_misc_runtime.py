@@ -154,3 +154,50 @@ class TestTiledLinear:
             TiledLinear(in_features=10, out_features=8,
                         in_splits=3).init(jax.random.PRNGKey(0),
                                           jnp.zeros((2, 10)))
+
+
+class TestMemoryAndExport:
+    def test_see_memory_usage(self):
+        from deepspeed_tpu.utils import see_memory_usage
+        assert see_memory_usage("probe", force=False) == {}
+        stats = see_memory_usage("probe", force=True)
+        assert isinstance(stats, dict)
+
+    def test_instrument_w_trace(self):
+        from deepspeed_tpu.utils import instrument_w_nvtx, instrument_w_trace
+
+        @instrument_w_trace
+        def f(x):
+            return x + 1
+
+        @instrument_w_nvtx(name="custom")
+        def g(x):
+            return x * 2
+
+        assert f(1) == 2 and g(3) == 6
+
+    def test_save_16bit_model(self, devices, tmp_path):
+        import safetensors.numpy
+
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3},
+            "bf16": {"enabled": True},
+            "mesh": {"dp": 1, "fsdp": 8},
+            "steps_per_print": 0,
+        }
+        model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg,
+            example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+        path = engine.save_16bit_model(str(tmp_path))
+        loaded = safetensors.numpy.load_file(path)
+        from deepspeed_tpu.checkpoint.universal import _flatten_params
+        flat = _flatten_params(jax.device_get(engine.state.params))
+        assert set(loaded) == set(flat)
+        for k, v in flat.items():
+            arr = np.asarray(v)
+            want = arr.astype(jnp.bfloat16) if arr.dtype.kind == "f" \
+                or arr.dtype == jnp.bfloat16 else arr
+            np.testing.assert_array_equal(loaded[k], want)
