@@ -55,6 +55,11 @@ def initialize(model=None,
     example_batch: a host pytree with microbatch-shaped leaves used to trace
     ``model.init``; taken from ``training_data`` if omitted.
     """
+    if config is None and config_params is None and args is not None:
+        # reference deepspeed/__init__.py: the --deepspeed_config CLI flag
+        # (add_config_arguments) supplies the config when none is passed
+        config = (getattr(args, "deepspeed_config", None)
+                  or getattr(args, "deepscale_config", None))
     cfg = parse_config(config if config is not None else config_params)
     if dist_init_required is None or dist_init_required:
         comm.init_distributed()
@@ -127,3 +132,22 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
         cfg_dict.update(kwargs)
         config = cfg_dict
     return InferenceEngine(model=model, config=config, params=params, mesh=mesh)
+
+
+def add_config_arguments(parser):
+    """Add the canonical DeepSpeed CLI flags to an argparse parser
+    (reference deepspeed.add_config_arguments, deepspeed/__init__.py:250 →
+    add_core_arguments): ``--deepspeed`` enable flag, ``--deepspeed_config``
+    JSON path, ``--deepscale*`` legacy aliases."""
+    group = parser.add_argument_group("DeepSpeed-TPU",
+                                      "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for user "
+                            "scripts; initialize() is what activates it)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed-TPU JSON config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser

@@ -360,6 +360,18 @@ def offload_state_dict_from_fragments(params,
 # CLI (reference: ds_to_universal.py script)
 # ---------------------------------------------------------------------------
 
+def _restore_ckpt(ckpt_dir: str, tag: Optional[str]):
+    """Resolve tag (falling back to the 'latest' file) and restore the orbax
+    state on host.  Returns (state, tag) or (None, None) if no tag."""
+    from deepspeed_tpu.checkpoint import latest_tag
+    import orbax.checkpoint as ocp
+    tag = tag or latest_tag(ckpt_dir)
+    if tag is None:
+        return None, None
+    path = os.path.join(os.path.abspath(ckpt_dir), tag, "state")
+    return ocp.StandardCheckpointer().restore(path), tag
+
+
 def _cli(argv=None) -> int:
     import argparse
 
@@ -374,17 +386,20 @@ def _cli(argv=None) -> int:
     ex.add_argument("--tag", default=None)
     ins = sub.add_parser("inspect", help="print a universal dir's manifest")
     ins.add_argument("universal_dir")
+    fp32 = sub.add_parser(
+        "zero_to_fp32",
+        help="orbax checkpoint dir -> ONE consolidated fp32 safetensors "
+             "(reference utils/zero_to_fp32.py offline converter)")
+    fp32.add_argument("ckpt_dir")
+    fp32.add_argument("out_file")
+    fp32.add_argument("--tag", default=None)
     args = ap.parse_args(argv)
 
     if args.cmd == "export":
-        from deepspeed_tpu.checkpoint import latest_tag
-        import orbax.checkpoint as ocp
-        tag = args.tag or latest_tag(args.ckpt_dir)
-        if tag is None:
+        state, tag = _restore_ckpt(args.ckpt_dir, args.tag)
+        if state is None:
             print(f"no 'latest' file in {args.ckpt_dir}; pass --tag")
             return 1
-        path = os.path.join(os.path.abspath(args.ckpt_dir), tag, "state")
-        state = ocp.StandardCheckpointer().restore(path)
 
         class _Carrier:
             pass
@@ -395,6 +410,29 @@ def _cli(argv=None) -> int:
         c.step = state.get("step", 0)
         export_universal(c, args.out_dir)
         print(f"exported {args.ckpt_dir}@{tag} -> {args.out_dir}")
+        return 0
+    if args.cmd == "zero_to_fp32":
+        import safetensors.numpy
+        state, tag = _restore_ckpt(args.ckpt_dir, args.tag)
+        if state is None:
+            print(f"no 'latest' file in {args.ckpt_dir}; pass --tag")
+            return 1
+        masters = _master_states(state["opt_state"])
+        src = masters[0]["master"] if masters else state["params"]
+        flat = {}
+        for k, v in _flatten_params(src).items():
+            arr = np.asarray(v)
+            if arr.dtype != np.float32 and (arr.dtype.kind == "f"
+                                            or arr.dtype
+                                            == jax.numpy.bfloat16):
+                arr = arr.astype(np.float32)
+            flat[k] = arr
+        os.makedirs(os.path.dirname(os.path.abspath(args.out_file)),
+                    exist_ok=True)
+        safetensors.numpy.save_file(flat, args.out_file)
+        print(f"consolidated {len(flat)} tensors "
+              f"({'fp32 masters' if masters else 'params'}) -> "
+              f"{args.out_file}")
         return 0
     frags, meta = load_universal(args.universal_dir)
     print(json.dumps({"format": meta.get("format"),

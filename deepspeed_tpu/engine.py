@@ -176,15 +176,40 @@ class DeepSpeedTPUEngine:
         self.gas_in_model = bool(getattr(model, "is_pipeline", False))
         if isinstance(model, tuple):
             self._init_fn, self._apply_fn = model
+            # rng=None signals "deterministic" by convention (PipeGPT does
+            # the same); an apply_fn that ignores rng is unaffected
+            self._apply_fn_det = (
+                lambda params, batch, rng: self._apply_fn(params, batch,
+                                                          None))
         else:
             import flax.linen as fnn
             self._init_fn = lambda rng, batch: model.init(rng, batch)
             if isinstance(model, fnn.Module):
                 self._apply_fn = lambda params, batch, rng: model.apply(
                     params, batch, rngs={"dropout": rng})
+                # deterministic leg for eval_batch (reference module.eval()):
+                # only if the module's __call__ actually takes the optional
+                # `deterministic` flag — the base contract (__call__(batch))
+                # doesn't require it
+                import inspect
+                try:
+                    takes_det = "deterministic" in inspect.signature(
+                        type(model).__call__).parameters
+                except (TypeError, ValueError):
+                    takes_det = False
+                if takes_det:
+                    self._apply_fn_det = \
+                        lambda params, batch, rng: model.apply(
+                            params, batch, deterministic=True,
+                            rngs={"dropout": rng})
+                else:
+                    self._apply_fn_det = self._apply_fn
             else:  # duck-typed (init/apply) object, e.g. PipeGPT
                 self._apply_fn = lambda params, batch, rng: model.apply(
                     params, batch, rng)
+                # PipeGPT contract: rng=None disables dropout
+                self._apply_fn_det = lambda params, batch, rng: model.apply(
+                    params, batch, None)
         self.model = model
 
         # ---- optimizer + schedule (reference engine._configure_optimizer
@@ -423,6 +448,7 @@ class DeepSpeedTPUEngine:
         """(Re)jit the train/grad step programs.  Called at init and again by
         configure_moq — the compiled programs close over the compression
         specs at trace time, so a schedule change needs a re-trace."""
+        self._jit_eval = None              # rebuilt lazily by eval_batch
         self._jit_grad = jax.jit(self._make_grad_fn())
         if self.offloading:
             # device runs grads-only; optimizer step is host-side
@@ -514,7 +540,8 @@ class DeepSpeedTPUEngine:
             )
         return init
 
-    def _loss(self, params, batch, rng, scale, step=None):
+    def _loss(self, params, batch, rng, scale, step=None,
+              deterministic=False):
         if not self.use_master_weights:
             params = _cast_params(params, self.compute_dtype)
         if self._compression_specs and step is not None:
@@ -539,7 +566,8 @@ class DeepSpeedTPUEngine:
             # PLD adds zero host↔device traffic (reference updates it on the
             # host each step, progressive_layer_drop.py update_state)
             batch = dict(batch, pld_theta=self.pld.theta_at(step))
-        loss = self._apply_fn(params, batch, rng)
+        apply = self._apply_fn_det if deterministic else self._apply_fn
+        loss = apply(params, batch, rng)
         return (loss * scale).astype(jnp.float32), loss
 
     def _grads_one_micro(self, state: TrainState, batch, idx):
@@ -846,6 +874,31 @@ class DeepSpeedTPUEngine:
         self.tput_timer.stop(int(self.config.train_batch_size), tokens)
         self._post_step_reporting(metrics)
         return metrics
+
+    def eval_batch(self, batch):
+        """Deterministic evaluation loss on one global batch — no grads, no
+        state mutation (reference PipelineEngine.eval_batch
+        pipe/engine.py:415; plain-engine eval = module.eval() + forward).
+
+        Weight-side semantics match training exactly (master-weight cast,
+        staged QDQ at the CURRENT step, qwZ gather).  Dropout/PLD/random-LTD
+        are off for models exposing a deterministic leg (a flax module with a
+        ``deterministic`` flag, or an apply_fn treating ``rng=None`` as
+        eval); other models run their training-mode forward with the current
+        state rng.  Returns the scalar loss as a float32 jax array.
+        """
+        # no leading gas dim: pipeline models treat a flat [B, T] batch as a
+        # single microbatch (pipe/module.py _3d)
+        batch = self._shard_batch(batch)
+        if self._jit_eval is None:
+            def eval_fn(state, batch):
+                _, loss = self._loss(state.params, batch, state.rng,
+                                     jnp.float32(1.0), state.step,
+                                     deterministic=True)
+                return loss.astype(jnp.float32)
+            self._jit_eval = jax.jit(eval_fn)
+        with self.mesh:
+            return self._jit_eval(self.state, batch)
 
     def forward(self, batch):
         """Compatibility trio part 1 (reference engine.forward engine.py:1785):

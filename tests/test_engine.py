@@ -263,3 +263,73 @@ class TestHpZ:
                     "mesh": {"fsdp": 4, "dp": -1},
                 }, example_batch={"input_ids": rng.integers(
                     0, 64, (8, 16)).astype(np.int32)})
+
+
+class TestEvalBatch:
+    """engine.eval_batch (reference PipelineEngine.eval_batch
+    pipe/engine.py:415 + module.eval() forward semantics)."""
+
+    def test_eval_deterministic_and_stateless(self, devices):
+        engine = _build(2)
+        batch = next(_data(1, engine.train_batch_size))
+        step_before = int(np.asarray(jax.device_get(engine.state.step)))
+        a = float(engine.eval_batch(batch))
+        b = float(engine.eval_batch(batch))
+        assert a == b, "eval must be deterministic"
+        assert int(np.asarray(jax.device_get(engine.state.step))) == \
+            step_before, "eval must not step the optimizer"
+        assert engine.global_steps == 0
+
+    def test_eval_tracks_training(self, devices):
+        engine = _build(1)
+        batch = next(_data(1, engine.train_batch_size, seed=3))
+        before = float(engine.eval_batch(batch))
+        for b in _data(20, engine.train_batch_size, seed=3):
+            engine.train_batch(b)
+        after = float(engine.eval_batch(batch))
+        assert after < before * 0.8, (before, after)
+
+    def test_eval_ignores_dropout(self, devices):
+        """eval loss == a hand-computed deterministic forward (dropout truly
+        off, not merely same-rng-twice)."""
+        import dataclasses
+        mcfg = dataclasses.replace(
+            GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ), dropout=0.3)
+        model = GPT(mcfg)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"dp": 8},                      # fp32: params not cast
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=cfg,
+            example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+        batch = next(_data(1, engine.train_batch_size))
+        got = float(engine.eval_batch(batch))
+        want = float(model.apply(
+            jax.device_get(engine.state.params), batch, deterministic=True,
+            rngs={"dropout": jax.random.PRNGKey(99)}))
+        assert got == pytest.approx(want, rel=1e-6)
+        # and the stochastic train-mode loss differs (dropout is real)
+        noisy = float(model.apply(
+            jax.device_get(engine.state.params), batch,
+            rngs={"dropout": jax.random.PRNGKey(99)}))
+        assert abs(noisy - want) > 1e-6
+
+    def test_eval_batch_pipeline_model(self, devices):
+        from deepspeed_tpu.pipe import PipeGPT
+        cfg = GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=PipeGPT(cfg, num_stages=2), config={
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": True},
+                "mesh": {"pp": 2, "dp": 4},
+                "steps_per_print": 0,
+            }, example_batch={"input_ids": np.zeros((2, 2, SEQ), np.int32)})
+        loss = float(engine.eval_batch(
+            {"input_ids": np.zeros((4, SEQ), np.int32)}))
+        assert np.isfinite(loss)

@@ -180,3 +180,27 @@ class TestUniversalOffload:
             if k == "step_count":
                 continue
             np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+class TestZeroToFp32:
+    def test_cli_consolidates_fp32_masters(self, devices, tmp_path):
+        """reference utils/zero_to_fp32.py: one consolidated fp32 file from a
+        sharded checkpoint, loadable by plain safetensors."""
+        import safetensors.numpy
+        src = _build(2, {"dp": 8})
+        for b in _data(2, src.train_batch_size):
+            src.train_batch(b)
+        ckpt = str(tmp_path / "ckpt")
+        src.save_checkpoint(ckpt)
+        out = str(tmp_path / "consolidated.safetensors")
+        assert _cli(["zero_to_fp32", ckpt, out]) == 0
+        tensors = safetensors.numpy.load_file(out)
+        from deepspeed_tpu.checkpoint.universal import (_flatten_params,
+                                                        _master_states)
+        masters = _master_states(jax.device_get(src.state.opt_state))
+        flat = _flatten_params(masters[0]["master"])
+        assert set(tensors) == set(flat)
+        for k, v in flat.items():
+            assert tensors[k].dtype == np.float32
+            np.testing.assert_array_equal(tensors[k],
+                                          np.asarray(v, np.float32))
