@@ -37,8 +37,12 @@ _GPT2_LIKE = {"GPT2LMHeadModel"}
 _OPT_LIKE = {"OPTForCausalLM"}
 _PHI_LIKE = {"PhiForCausalLM"}
 _FALCON_LIKE = {"FalconForCausalLM"}
+_GPTJ_LIKE = {"GPTJForCausalLM"}
+_NEOX_LIKE = {"GPTNeoXForCausalLM"}
+_BLOOM_LIKE = {"BloomForCausalLM"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
-                                 | _PHI_LIKE | _FALCON_LIKE)
+                                 | _PHI_LIKE | _FALCON_LIKE | _GPTJ_LIKE
+                                 | _NEOX_LIKE | _BLOOM_LIKE)
 
 
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
@@ -228,12 +232,8 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
         # GQA, LayerNorm, bias-free projections, parallel attention (7b: one
         # shared input norm; 40b new_decoder_architecture: ln_attn + ln_mlp)
         _reject_unsupported_semantics(hf, arch, max_seq_len)
-        if hf.get("alibi"):
-            raise ValueError(f"{arch}: alibi position encoding is not "
-                             "implemented (rotary falcon variants only)")
-        if hf.get("bias"):
-            raise ValueError(f"{arch}: bias=true (falcon-rw) is not "
-                             "implemented")
+        use_alibi = bool(hf.get("alibi", False))     # falcon-rw lineage
+        has_bias = bool(hf.get("bias", False))
         hidden = hf["hidden_size"]
         heads = hf["num_attention_heads"]
         new_arch = bool(hf.get("new_decoder_architecture", False))
@@ -258,7 +258,9 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             hidden_size=hidden,
             mlp_dim_override=hf.get("ffn_hidden_size") or 4 * hidden,
             max_seq_len=min(msl, max_seq_len or msl),
-            use_rope=True, use_rmsnorm=False, gated_mlp=False,
+            use_rope=not use_alibi, use_alibi=use_alibi,
+            alibi_prescale=use_alibi,
+            use_rmsnorm=False, gated_mlp=False,
             activation=_map_activation(arch, hf.get("activation", "gelu")),
             parallel_block=parallel,
             parallel_norms=2 if (parallel and two_norms) else 1,
@@ -266,6 +268,85 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=has_bias, attn_out_bias=has_bias, mlp_bias=has_bias,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _GPTJ_LIKE:
+        # reference module_inject/containers/gptj.py: parallel residual off
+        # one shared ln, partial INTERLEAVED rotary (converted to half-split
+        # by a head-dim permutation in _gptj_tree), bias-free attention,
+        # biased fc + lm_head
+        hidden = hf["n_embd"]
+        heads = hf["n_head"]
+        hd = hidden // heads
+        msl = hf.get("n_positions", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["n_layer"],
+            num_heads=heads,
+            head_dim=hd,
+            hidden_size=hidden,
+            mlp_dim_override=hf.get("n_inner") or 4 * hidden,
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=False, gated_mlp=False,
+            activation=_map_activation(arch, hf.get("activation_function",
+                                                    "gelu_new")),
+            parallel_block=True, parallel_norms=1,
+            rope_pct=(hf.get("rotary_dim") or hd) / hd,  # null = full rotary
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            mlp_bias=True, unembed_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _NEOX_LIKE:
+        # reference module_inject/containers/gptneox.py: fused per-head qkv,
+        # half-split partial rotary (native layout), dual-norm parallel
+        # residual when use_parallel_residual
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        parallel = bool(hf.get("use_parallel_residual", True))
+        msl = hf.get("max_position_embeddings", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf["intermediate_size"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=False, gated_mlp=False,
+            activation=_map_activation(arch, hf.get("hidden_act", "gelu")),
+            parallel_block=parallel,
+            parallel_norms=2 if parallel else 1,
+            rope_pct=float(hf.get("rotary_pct", 0.25)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _BLOOM_LIKE:
+        # reference module_inject/containers/bloom.py: alibi positions (no
+        # table), embedding LayerNorm, fused per-head qkv, tied embeddings
+        hidden = hf.get("hidden_size") or hf["n_embed"]  # bloom legacy key
+        heads = hf.get("n_head") or hf["num_attention_heads"]
+        layers = hf.get("n_layer") or hf["num_hidden_layers"]
+        msl = max_seq_len or 2048      # alibi: no positional table to bound
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=layers,
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=4 * hidden,
+            max_seq_len=msl,
+            use_rope=False, use_rmsnorm=False, gated_mlp=False,
+            use_alibi=True, embed_norm=True,
+            activation="gelu",          # BloomGelu = tanh approximation
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
             dtype=dtype or jnp.bfloat16,
         )
     raise ValueError(
@@ -546,10 +627,19 @@ def _falcon_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
             "wo": r.get(p + "self_attention.dense.weight").T.reshape(nh, hd,
                                                                      H),
         }
+        mlp = {"wi": r.get(p + "mlp.dense_h_to_4h.weight").T,
+               "wo": r.get(p + "mlp.dense_4h_to_h.weight").T}
+        if cfg.qkv_bias:         # falcon-rw bias=true
+            b4 = r.get(p + "self_attention.query_key_value.bias"
+                       ).reshape(nkv, g_per + 2, hd)
+            att["bq"] = b4[:, :g_per].reshape(nh, hd)
+            att["bk"], att["bv"] = b4[:, g_per], b4[:, g_per + 1]
+            att["bo"] = r.get(p + "self_attention.dense.bias")
+            mlp["bi"] = r.get(p + "mlp.dense_h_to_4h.bias")
+            mlp["bo"] = r.get(p + "mlp.dense_4h_to_h.bias")
         blk = {
             "Attention_0": att,
-            "MLP_0": {"wi": r.get(p + "mlp.dense_h_to_4h.weight").T,
-                      "wo": r.get(p + "mlp.dense_4h_to_h.weight").T},
+            "MLP_0": mlp,
         }
         if cfg.parallel_block and cfg.parallel_norms == 2:
             blk["Norm_0"] = {"scale": r.get(p + "ln_attn.weight"),
@@ -564,6 +654,152 @@ def _falcon_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
                     "scale": r.get(p + "post_attention_layernorm.weight"),
                     "bias": r.get(p + "post_attention_layernorm.bias")}
         bb[f"block_{i}"] = blk
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    return tree
+
+
+def _rope_interleave_perm(head_dim: int, rot: int) -> np.ndarray:
+    """Head-dim permutation converting gpt-j's INTERLEAVED rotary pairing
+    ((0,1),(2,3),…) to this model's half-split pairing ((0,rot/2),…).
+
+    Valid because attention scores are invariant under a shared q/k head-dim
+    permutation and half_rope(x[perm]) == interleaved_rope(x)[perm] — so
+    permuting wq/wk rows once at load time makes the native kernel exact."""
+    return np.concatenate([np.arange(0, rot, 2), np.arange(1, rot, 2),
+                           np.arange(rot, head_dim)])
+
+
+def _gptj_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """GPT-J → flax tree (reference module_inject/containers/gptj.py)."""
+    from deepspeed_tpu.models.gpt import rotary_dim
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    perm = _rope_interleave_perm(hd, rotary_dim(hd, cfg.rope_pct))
+
+    bb: Dict[str, Any] = {
+        "wte": r.get("transformer.wte.weight"),
+        "final_norm": {"scale": r.get("transformer.ln_f.weight"),
+                       "bias": r.get("transformer.ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        wq = r.get(p + "attn.q_proj.weight").T.reshape(H, nh, hd)
+        wk = r.get(p + "attn.k_proj.weight").T.reshape(H, nh, hd)
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": wq[:, :, perm],
+                "wk": wk[:, :, perm],
+                "wv": r.get(p + "attn.v_proj.weight").T.reshape(H, nh, hd),
+                "wo": r.get(p + "attn.out_proj.weight").T.reshape(nh, hd, H),
+            },
+            "Norm_0": {"scale": r.get(p + "ln_1.weight"),
+                       "bias": r.get(p + "ln_1.bias")},
+            "MLP_0": {
+                "wi": r.get(p + "mlp.fc_in.weight").T,
+                "bi": r.get(p + "mlp.fc_in.bias"),
+                "wo": r.get(p + "mlp.fc_out.weight").T,
+                "bo": r.get(p + "mlp.fc_out.bias"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    if cfg.unembed_bias:
+        tree["lm_head_bias"] = (r.get("lm_head.bias")
+                                if r.has("lm_head.bias")
+                                else np.zeros(cfg.vocab_size, np.float32))
+    return tree
+
+
+def _neox_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """GPT-NeoX → flax tree (reference module_inject/containers/gptneox.py).
+    Fused qkv is per-head interleaved: rows [h·3hd:(h+1)·3hd] hold head h's
+    q, k, v stripes."""
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    bb: Dict[str, Any] = {
+        "wte": r.get("gpt_neox.embed_in.weight"),
+        "final_norm": {"scale": r.get("gpt_neox.final_layer_norm.weight"),
+                       "bias": r.get("gpt_neox.final_layer_norm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"gpt_neox.layers.{i}."
+        w4 = r.get(p + "attention.query_key_value.weight"
+                   ).reshape(nh, 3, hd, H)
+        b3 = r.get(p + "attention.query_key_value.bias").reshape(nh, 3, hd)
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": np.transpose(w4[:, 0], (2, 0, 1)),
+                "wk": np.transpose(w4[:, 1], (2, 0, 1)),
+                "wv": np.transpose(w4[:, 2], (2, 0, 1)),
+                "bq": b3[:, 0], "bk": b3[:, 1], "bv": b3[:, 2],
+                "wo": r.get(p + "attention.dense.weight").T.reshape(nh, hd,
+                                                                    H),
+                "bo": r.get(p + "attention.dense.bias"),
+            },
+            "Norm_0": {"scale": r.get(p + "input_layernorm.weight"),
+                       "bias": r.get(p + "input_layernorm.bias")},
+            "Norm_1": {
+                "scale": r.get(p + "post_attention_layernorm.weight"),
+                "bias": r.get(p + "post_attention_layernorm.bias")},
+            "MLP_0": {
+                "wi": r.get(p + "mlp.dense_h_to_4h.weight").T,
+                "bi": r.get(p + "mlp.dense_h_to_4h.bias"),
+                "wo": r.get(p + "mlp.dense_4h_to_h.weight").T,
+                "bo": r.get(p + "mlp.dense_4h_to_h.bias"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("embed_out.weight").T
+                           if r.has("embed_out.weight") else bb["wte"].T)
+    return tree
+
+
+def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """BLOOM → flax tree (reference module_inject/containers/bloom.py).
+    Fused qkv interleaves q/k/v WITHIN each head: [nh, 3, hd]."""
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def g(name):
+        return r.get("transformer." + name
+                     if r.has("transformer." + name) else name)
+
+    bb: Dict[str, Any] = {
+        "wte": g("word_embeddings.weight"),
+        "embed_norm": {"scale": g("word_embeddings_layernorm.weight"),
+                       "bias": g("word_embeddings_layernorm.bias")},
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        w4 = g(p + "self_attention.query_key_value.weight"
+               ).reshape(nh, 3, hd, H)
+        b3 = g(p + "self_attention.query_key_value.bias").reshape(nh, 3, hd)
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": np.transpose(w4[:, 0], (2, 0, 1)),
+                "wk": np.transpose(w4[:, 1], (2, 0, 1)),
+                "wv": np.transpose(w4[:, 2], (2, 0, 1)),
+                "bq": b3[:, 0], "bk": b3[:, 1], "bv": b3[:, 2],
+                "wo": g(p + "self_attention.dense.weight").T.reshape(nh, hd,
+                                                                     H),
+                "bo": g(p + "self_attention.dense.bias"),
+            },
+            "Norm_0": {"scale": g(p + "input_layernorm.weight"),
+                       "bias": g(p + "input_layernorm.bias")},
+            "Norm_1": {"scale": g(p + "post_attention_layernorm.weight"),
+                       "bias": g(p + "post_attention_layernorm.bias")},
+            "MLP_0": {
+                "wi": g(p + "mlp.dense_h_to_4h.weight").T,
+                "bi": g(p + "mlp.dense_h_to_4h.bias"),
+                "wo": g(p + "mlp.dense_4h_to_h.weight").T,
+                "bo": g(p + "mlp.dense_4h_to_h.bias"),
+            },
+        }
     tree: Dict[str, Any] = {"backbone": bb}
     if not cfg.tie_embeddings:
         tree["lm_head"] = (r.get("lm_head.weight").T
@@ -589,6 +825,12 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
         tree = _phi_tree(r, cfg)
     elif arch in _FALCON_LIKE:
         tree = _falcon_tree(r, cfg)
+    elif arch in _GPTJ_LIKE:
+        tree = _gptj_tree(r, cfg)
+    elif arch in _NEOX_LIKE:
+        tree = _neox_tree(r, cfg)
+    elif arch in _BLOOM_LIKE:
+        tree = _bloom_tree(r, cfg)
     else:
         tree = _llama_tree(r, cfg)
     n = sum(int(np.prod(l.shape))

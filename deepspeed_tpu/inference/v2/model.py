@@ -143,7 +143,9 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
     # ---- embed (reference ragged_ops/embed) ----
     x = bb["wte"].astype(dtype)[tokens]
-    if not cfg.use_rope:
+    if cfg.embed_norm:
+        x = _norm(bb["embed_norm"], x, cfg)
+    if not cfg.use_rope and not cfg.use_alibi:
         x = x + bb["wpe"].astype(dtype)[token_pos]
 
     # scatter destinations in the page pool; pad tokens get an out-of-range
@@ -199,10 +201,18 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         mask = (kvpos[:, None, :] <= qpos_dense[:, :, None]) & \
                (kvpos[:, None, :] < kv_len[:, None, None])   # [S, Q, Kmax]
         from deepspeed_tpu import ops
+        bias = None
+        if cfg.use_alibi:
+            from deepspeed_tpu.models.gpt import alibi_slopes
+            s = jnp.asarray(alibi_slopes(cfg.num_heads, cfg.head_dim,
+                                         cfg.alibi_prescale))
+            # key logical position == gathered index (pages are in order)
+            bias = s[None, :, None, None] * kvpos[:, None, None, :].astype(
+                jnp.float32)
         o_dense = ops.causal_attention(q_dense.astype(dtype),
                                        k_pages.astype(dtype),
                                        v_pages.astype(dtype),
-                                       causal=False, mask=mask)
+                                       causal=False, mask=mask, bias=bias)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
         attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")
@@ -245,7 +255,9 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     g = nh // nkv
 
     x = bb["wte"].astype(dtype)[tokens]                       # [S, H]
-    if not cfg.use_rope:
+    if cfg.embed_norm:
+        x = _norm(bb["embed_norm"], x, cfg)
+    if not cfg.use_rope and not cfg.use_alibi:
         x = x + bb["wpe"].astype(dtype)[token_pos]
 
     big = jnp.iinfo(jnp.int32).max
@@ -272,8 +284,12 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         k_pages = jax.lax.dynamic_slice_in_dim(flat_k_all, li * NB, NB)
         v_pages = jax.lax.dynamic_slice_in_dim(flat_v_all, li * NB, NB)
         qg = q.reshape(S, nkv, g, hd)
+        slopes = None
+        if cfg.use_alibi:
+            from deepspeed_tpu.models.gpt import alibi_slopes
+            slopes = jnp.asarray(alibi_slopes(nh, hd, cfg.alibi_prescale))
         o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len,
-                                mesh=mesh)
+                                alibi_slopes=slopes, mesh=mesh)
         o = o.reshape(S, nh, hd)
         attn_delta = _attn_out(ap, o, cfg, "skd,kdh->sh")
         x = _block_residual(blk, x, h, attn_delta, cfg)

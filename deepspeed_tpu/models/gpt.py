@@ -82,6 +82,13 @@ class GPTConfig:
     parallel_norms: int = 1             # 1 = shared input norm; 2 = ln_attn+ln_mlp
     rope_pct: float = 1.0               # partial rotary (phi partial_rotary_factor)
     unembed_bias: bool = False          # lm_head bias (phi)
+    use_alibi: bool = False             # alibi attention bias, no positional
+    #                                     table (bloom/falcon-rw)
+    alibi_prescale: bool = False        # falcon-rw: (scores+alibi)·scale with
+    #                                     bf16-rounded slopes; bloom adds the
+    #                                     bias AFTER scaling
+    embed_norm: bool = False            # LayerNorm right after the embedding
+    #                                     (bloom word_embeddings_layernorm)
     # random-LTD (data_pipeline/random_ltd.py): layers that run on a kept
     # token subset when the batch carries "random_ltd_idx"
     random_ltd_layer_ids: tuple = ()
@@ -166,6 +173,32 @@ def _part(init, names):
     return nn.with_partitioning(init, names)
 
 
+def alibi_slopes(n_heads: int, head_dim: int = 0, prescale: bool = False):
+    """Per-head alibi slopes (HF build_alibi_tensor formula: geometric
+    sequence from the closest power of two, odd-power infill for non-pow2
+    head counts).  Reference: bloom/falcon-rw attention bias.
+
+    ``prescale`` applies the falcon-rw convention in ONE place for all three
+    attention paths: slopes bf16-rounded (HF casts them before the product)
+    and folded into the 1/√head_dim scale, because falcon computes
+    ``(scores + alibi)·scale`` while bloom adds the bias post-scale."""
+    import math
+    cp2 = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(cp2) - 3)))
+    slopes = [base ** i for i in range(1, cp2 + 1)]
+    if cp2 != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * cp2) - 3)))
+        slopes += [extra_base ** i
+                   for i in range(1, 2 * (n_heads - cp2), 2)]
+    import numpy as np
+    s = np.asarray(slopes, np.float32)
+    if prescale:
+        import ml_dtypes
+        s = s.astype(ml_dtypes.bfloat16).astype(np.float32) * (
+            head_dim ** -0.5)
+    return s
+
+
 def rotary_dim(head_dim: int, rope_pct: float) -> int:
     """Rotated prefix width for partial rotary (phi partial_rotary_factor),
     rounded down to even so the half-split convention holds."""
@@ -227,12 +260,12 @@ class Norm(nn.Module):
         return layer_norm(x, scale, bias, eps=c.norm_eps or LN_EPS)
 
 
-def attend_with_mask(q, k, v, mask):
+def attend_with_mask(q, k, v, mask, bias=None):
     """Attention with an explicit boolean mask [B, Tq, S] — the KV-cache /
     padded-prefill path (reference: masked softmax in
     csrc/transformer/inference/csrc/softmax.cu).  Delegates to the ops layer."""
     from deepspeed_tpu import ops
-    return ops.causal_attention(q, k, v, causal=False, mask=mask)
+    return ops.causal_attention(q, k, v, causal=False, mask=mask, bias=bias)
 
 
 def causal_attend(q, k, v, probs_dropout=None):
@@ -289,6 +322,17 @@ class Attention(nn.Module):
             q, k = rope(q, k, positions, hd, base=c.rope_theta,
                         rope_pct=c.rope_pct)
 
+        def alibi_bias(key_pos):
+            """[.., S] key positions → [.., nh, 1, S] logit bias.  Key-
+            position-only form: softmax is invariant to the per-row
+            -slope·qpos constant, so slope·kpos ≡ slope·(kpos−qpos)
+            (reference bloom build_alibi_tensor)."""
+            if not c.use_alibi:
+                return None
+            s = jnp.asarray(alibi_slopes(nh, hd, c.alibi_prescale))
+            return (s[:, None, None]
+                    * key_pos[..., None, None, :].astype(jnp.float32))
+
         if use_cache:
             # static KV cache in a flax "cache" collection (reference:
             # inference_context.h KV workspace; flax decode-cache idiom).
@@ -306,15 +350,20 @@ class Attention(nn.Module):
             # slot index differs from the token's position, so the engine passes
             # per-slot kv_positions; default (no padding) slot == position.
             if kv_positions is None:
-                kvpos = jnp.arange(S)[None, None, :]         # [1, 1, S]
+                kp2 = jnp.arange(S)[None, :]                 # [1, S]
             else:
-                kvpos = kv_positions[:, None, :]             # [B, 1, S]
+                kp2 = kv_positions                           # [B, S]
+            kvpos = kp2[:, None, :]                          # [B|1, 1, S]
             mask = kvpos <= positions[:, :, None]            # causal, absolute
             if kv_mask is not None:
                 mask = mask & kv_mask[:, None, :].astype(bool)
-            out = attend_with_mask(q, ck.value, cv.value, mask)
+            out = attend_with_mask(q, ck.value, cv.value, mask,
+                                   bias=alibi_bias(kp2))
             return out_proj(out)
 
+        if c.use_alibi and c.sequence_parallel:
+            raise ValueError("alibi + sequence parallelism is not wired "
+                             "(the a2a/ring paths carry no logit bias)")
         if (c.sequence_parallel and self.mesh is not None
                 and self.mesh.shape["sp"] > 1):
             # sequence parallelism: Ulysses (seq→head all-to-all swap around
@@ -344,6 +393,7 @@ class Attention(nn.Module):
                 pdrop = lambda p: nn.Dropout(rate=c.dropout)(  # noqa: E731
                     p, deterministic=False)
             out = ops.causal_attention(q, k, v, dropout_fn=pdrop,
+                                       bias=alibi_bias(positions),
                                        impl=c.attn_impl)
         return out_proj(out)
 
@@ -479,9 +529,11 @@ class GPTBackbone(nn.Module):
                          (c.vocab_size, c.hidden_size), c.param_dtype)
         x = _gather_table(emb.astype(c.dtype), self.mesh)[input_ids]
         x = _pin_activations(x, self.mesh, c.sequence_parallel)
+        if c.embed_norm:     # bloom word_embeddings_layernorm
+            x = Norm(c, name="embed_norm")(x)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-        if not c.use_rope:
+        if not c.use_rope and not c.use_alibi:
             pos_emb = self.param("wpe", _part(_kernel_init(), (None, "embed")),
                                  (c.max_seq_len, c.hidden_size), c.param_dtype)
             x = x + _gather_table(pos_emb.astype(c.dtype), self.mesh,
@@ -634,8 +686,10 @@ def count_params(cfg: GPTConfig) -> int:
                  + H * M * (3 if cfg.gated_mlp else 2)         # mlp
                  + H * norms * (1 if cfg.use_rmsnorm else 2))
     total = per_layer * cfg.num_layers + V * H + H
-    if not cfg.use_rope:
+    if not cfg.use_rope and not cfg.use_alibi:
         total += cfg.max_seq_len * H
+    if cfg.embed_norm:
+        total += H * (1 if cfg.use_rmsnorm else 2)
     if not cfg.tie_embeddings:
         total += V * H
     if cfg.unembed_bias:

@@ -24,13 +24,14 @@ from deepspeed_tpu.ops.registry import dispatch, list_ops, op_report, register_o
 
 
 def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                   mask=None, interpret=None):
+                   mask=None, bias=None, interpret=None):
     """Plain attention on [B, T, N, D] — numeric ground truth for the kernel.
 
     The ONE XLA softmax-attention body in the codebase: causal tril masking, or
     an explicit [B, Tq, S] boolean mask (the KV-cache / padded-prefill path;
     all-False rows produce zeros, not NaN, so left-pad garbage never reaches
-    later layers' V inputs).
+    later layers' V inputs).  ``bias`` [B|1, N, Tq|1, S] is added to the fp32
+    logits pre-softmax (alibi; reference bloom/falcon-rw baddbmm bias).
     """
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
@@ -41,6 +42,8 @@ def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     neg = jnp.finfo(jnp.float32).min
     if mask is not None:
         m = mask[:, None]                                # [B, 1, Tq, S]
@@ -59,7 +62,7 @@ def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
 
 
 def _attention_pallas(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                      mask=None, interpret=None):
+                      mask=None, bias=None, interpret=None):
     if dropout_fn is not None:
         raise ValueError(
             "the pallas flash-attention kernel has no probs-dropout; use "
@@ -67,14 +70,17 @@ def _attention_pallas(q, k, v, *, causal=True, scale=None, dropout_fn=None,
     if mask is not None:
         raise ValueError("the pallas flash-attention kernel takes no explicit "
                          "mask; use impl='xla' for the KV-cache/padded path")
+    if bias is not None:
+        raise ValueError("the pallas flash-attention kernel takes no logit "
+                         "bias; use impl='xla' for alibi models")
     return flash_attention(q, k, v, causal=causal, scale=scale,
                            interpret=interpret)
 
 
 def _attention_supported(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                         mask=None, interpret=None):
+                         mask=None, bias=None, interpret=None):
     from deepspeed_tpu.ops.flash_attention import supported as flash_supported
-    return (dropout_fn is None and mask is None
+    return (dropout_fn is None and mask is None and bias is None
             and flash_supported(q, k, v, causal=causal))
 
 
@@ -95,11 +101,11 @@ register_op("evoformer_attention", xla=evoformer_attention)
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
                      dropout_fn: Optional[Callable] = None,
-                     mask=None,
+                     mask=None, bias=None,
                      impl: Optional[str] = None):
     """Dispatching attention entry used by the model layer."""
     return dispatch("causal_attention", q, k, v, causal=causal, scale=scale,
-                    dropout_fn=dropout_fn, mask=mask, impl=impl)
+                    dropout_fn=dropout_fn, mask=mask, bias=bias, impl=impl)
 
 
 __all__ = ["causal_attention", "flash_attention", "paged_attention",

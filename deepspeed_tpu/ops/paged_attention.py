@@ -37,8 +37,8 @@ _NEG_INF = -1e30
 
 
 def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
-                        scale: Optional[float] = None, interpret=None,
-                        mesh=None):
+                        scale: Optional[float] = None, alibi_slopes=None,
+                        interpret=None, mesh=None):
     """Ground-truth XLA path: gather this slot's pages, masked softmax.
 
     ``mesh`` is accepted for signature parity with the Pallas path; the XLA
@@ -57,6 +57,10 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     mask = kvpos[None, :] < kv_lens[:, None]                  # [S, K]
     s_log = jnp.einsum("sngd,sknd->sngk", q, k_seq,
                        preferred_element_type=jnp.float32) * scale
+    if alibi_slopes is not None:
+        # key-position bias per GLOBAL head h = kv_group·g + g_idx
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
+        s_log = s_log + sl[None, :, :, None] * kvpos[None, None, None, :]
     s_log = jnp.where(mask[:, None, None, :], s_log,
                       jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(s_log, axis=-1)
@@ -130,6 +134,7 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
 
 
 def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
+                           alibi_slopes=None,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
                            mesh=None):
@@ -137,6 +142,9 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     kernel runs per-shard under shard_map (attention is independent per kv
     head, so TP needs no collective here — the reference shards its blocked
     flash the same way, model_implementations/sharding/attn.py)."""
+    if alibi_slopes is not None:
+        raise ValueError("the pallas paged-attention kernel has no alibi "
+                         "bias; use impl='xla' for alibi models")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[1] % mesh.shape["tp"] == 0):
         from jax import shard_map
@@ -199,7 +207,9 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
 
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
-              interpret=None, mesh=None):
+              alibi_slopes=None, interpret=None, mesh=None):
+    if alibi_slopes is not None:   # alibi rides the XLA fallback
+        return False
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
@@ -210,11 +220,12 @@ def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
 
 def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                     scale: Optional[float] = None,
+                    alibi_slopes=None,
                     impl: Optional[str] = None,
                     interpret: Optional[bool] = None,
                     mesh=None):
     """Registry entry (ops/__init__ registers this like causal_attention)."""
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("paged_attention", q, k_pages, v_pages, block_table,
-                    kv_lens, scale=scale, impl=impl, interpret=interpret,
-                    mesh=mesh)
+                    kv_lens, scale=scale, alibi_slopes=alibi_slopes,
+                    impl=impl, interpret=interpret, mesh=mesh)

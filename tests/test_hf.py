@@ -232,16 +232,87 @@ class TestOptPhiFalcon:
         path = _save(tmp_models, model, "falcon11b")
         _check(path, model, rng, 128)
 
-    def test_falcon_rejects_alibi(self, tmp_models):
-        path = os.path.join(tmp_models, "falcon_rw")
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump({"architectures": ["FalconForCausalLM"],
-                       "hidden_size": 64, "vocab_size": 128,
-                       "num_hidden_layers": 2, "num_attention_heads": 4,
-                       "alibi": True}, f)
-        with pytest.raises(ValueError, match="alibi"):
-            config_from_hf(path)
+    def test_gptj_logits_match(self, tmp_models, rng):
+        """GPT-J: parallel residual + partial INTERLEAVED rotary, handled by
+        the load-time head-dim permutation (_rope_interleave_perm)."""
+        cfg = transformers.GPTJConfig(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, rotary_dim=8,
+            n_positions=64, tie_word_embeddings=False)
+        torch.manual_seed(9)
+        model = transformers.GPTJForCausalLM(cfg).eval()
+        with torch.no_grad():
+            model.lm_head.bias.normal_(0, 0.05)
+        path = _save(tmp_models, model, "gptj")
+        _check(path, model, rng, 128)
+
+    def test_neox_logits_match(self, tmp_models, rng):
+        """GPT-NeoX: fused per-head qkv, dual-norm parallel residual,
+        partial half-split rotary."""
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=192, rotary_pct=0.25,
+            max_position_embeddings=64, use_parallel_residual=True,
+            tie_word_embeddings=False)
+        torch.manual_seed(10)
+        model = transformers.GPTNeoXForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "neox")
+        _check(path, model, rng, 128)
+
+    def test_neox_sequential_variant(self, tmp_models, rng):
+        """use_parallel_residual=False (pythia-70m-style sequential)."""
+        cfg = transformers.GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=192, rotary_pct=0.5,
+            max_position_embeddings=64, use_parallel_residual=False,
+            tie_word_embeddings=False)
+        torch.manual_seed(11)
+        model = transformers.GPTNeoXForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "neox_seq")
+        _check(path, model, rng, 128)
+
+    def test_bloom_logits_match(self, tmp_models, rng):
+        """BLOOM: alibi bias (no positional table), embedding LayerNorm,
+        per-head-interleaved fused qkv, tied embeddings."""
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+        torch.manual_seed(12)
+        model = transformers.BloomForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "bloom")
+        _check(path, model, rng, 128)
+
+    def test_bloom_v2_serving(self, tmp_models, rng):
+        """alibi through the ragged prefill AND the paged decode fallback ==
+        HF greedy generate."""
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+        torch.manual_seed(12)
+        model = transformers.BloomForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "bloom")
+        prompt = rng.integers(0, 128, (1, 9)).astype(np.int32)
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                do_sample=False).numpy()[0, 9:]
+        eng = InferenceEngineV2(
+            path, {"dtype": "fp32", "max_seq_len": 64,
+                   "state_manager": {"max_tracked_sequences": 2,
+                                     "kv_block_size": 8},
+                   "generation": {"do_sample": False}})
+        got = eng.generate([prompt[0]], max_new_tokens=6)[0]
+        np.testing.assert_array_equal(got, want)
+
+    def test_falcon_rw_alibi_logits_match(self, tmp_models, rng):
+        """falcon-rw lineage: alibi + bias=True + sequential residual."""
+        cfg = transformers.FalconConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, new_decoder_architecture=False,
+            multi_query=False, parallel_attn=False, bias=True, alibi=True,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(13)
+        model = transformers.FalconForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "falcon_rw")
+        _check(path, model, rng, 128)
 
 
 class TestV2Serving:
@@ -296,7 +367,7 @@ class TestErrors:
         path = os.path.join(tmp_models, "weird")
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump({"architectures": ["BloomForCausalLM"]}, f)
+            json.dump({"architectures": ["MambaForCausalLM"]}, f)
         with pytest.raises(ValueError, match="unsupported HF architecture"):
             config_from_hf(path)
 
