@@ -111,26 +111,41 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
     from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
                                          InferenceEngine)
     from deepspeed_tpu.checkpoint.hf import is_hf_model_dir, load_hf_checkpoint
+
+    def as_dict(cfg):
+        """config path/dict/model → plain dict (the shared normal form)."""
+        if cfg is None:
+            return {}
+        if isinstance(cfg, dict):
+            return dict(cfg)
+        if isinstance(cfg, str):
+            import json
+            with open(cfg) as f:
+                return json.load(f)
+        if isinstance(cfg, DeepSpeedInferenceConfig):
+            return cfg.model_dump(by_alias=False)
+        raise TypeError(f"config must be dict/path/config model, got "
+                        f"{type(cfg)!r}")
+
     if is_hf_model_dir(model):
         if params is not None:
             raise ValueError("pass either an HF model dir or params, not both")
+        import os as _os
+        from deepspeed_tpu.checkpoint.hf import (_BERT_LIKE, _arch_of,
+                                                 _read_json, load_hf_bert)
+        arch = _arch_of(_read_json(_os.path.join(model, "config.json")))
+        if arch in _BERT_LIKE:
+            # encoder family: single-shot forward engine (reference bert
+            # injection policies, module_inject/containers/bert.py)
+            from deepspeed_tpu.inference.encoder import EncoderInferenceEngine
+            bcfg, bparams = load_hf_bert(model)
+            return EncoderInferenceEngine(bcfg, bparams,
+                                          config=dict(as_dict(config),
+                                                      **kwargs),
+                                          mesh=mesh)
         model, params = load_hf_checkpoint(model)
     if kwargs:
-        if config is None:
-            cfg_dict = {}
-        elif isinstance(config, dict):
-            cfg_dict = dict(config)
-        elif isinstance(config, str):
-            import json
-            with open(config) as f:
-                cfg_dict = json.load(f)
-        elif isinstance(config, DeepSpeedInferenceConfig):
-            cfg_dict = config.model_dump(by_alias=False)
-        else:
-            raise TypeError(f"config must be dict/path/config model, got "
-                            f"{type(config)!r}")
-        cfg_dict.update(kwargs)
-        config = cfg_dict
+        config = dict(as_dict(config), **kwargs)
     return InferenceEngine(model=model, config=config, params=params, mesh=mesh)
 
 

@@ -807,6 +807,90 @@ def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return tree
 
 
+_BERT_LIKE = {"BertForMaskedLM", "BertModel", "BertForPreTraining"}
+
+
+def load_hf_bert(model_path: str, *, dtype=None) -> Tuple[Any,
+                                                          Dict[str, Any]]:
+    """BERT-family encoder checkpoint → (BertConfig, flax params tree)
+    (reference module_inject/containers/bert.py HFBertLayerPolicy)."""
+    from deepspeed_tpu.models.bert import BertConfig
+
+    hf = _read_json(os.path.join(model_path, "config.json"))
+    cfg = BertConfig(
+        vocab_size=hf["vocab_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        hidden_size=hf["hidden_size"],
+        mlp_dim=hf["intermediate_size"],
+        max_seq_len=hf.get("max_position_embeddings", 512),
+        type_vocab_size=hf.get("type_vocab_size", 2),
+        norm_eps=float(hf.get("layer_norm_eps", 1e-12)),
+        activation=_map_activation(_arch_of(hf), hf.get("hidden_act",
+                                                        "gelu")),
+        dtype=dtype or jnp.float32,
+    )
+    r = _ShardReader(model_path)
+
+    def g(name):
+        # BertForMaskedLM prefixes with "bert."; plain BertModel doesn't
+        return r.get("bert." + name if r.has("bert." + name) else name)
+
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    enc: Dict[str, Any] = {
+        "wte": g("embeddings.word_embeddings.weight"),
+        "wpe": g("embeddings.position_embeddings.weight"),
+        "wtt": g("embeddings.token_type_embeddings.weight"),
+        "embed_norm": {
+            "scale": g("embeddings.LayerNorm.weight"),
+            "bias": g("embeddings.LayerNorm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}."
+        enc[f"block_{i}"] = {
+            "attn": {
+                "wq": g(p + "attention.self.query.weight").T.reshape(
+                    H, nh, hd),
+                "bq": g(p + "attention.self.query.bias").reshape(nh, hd),
+                "wk": g(p + "attention.self.key.weight").T.reshape(
+                    H, nh, hd),
+                "bk": g(p + "attention.self.key.bias").reshape(nh, hd),
+                "wv": g(p + "attention.self.value.weight").T.reshape(
+                    H, nh, hd),
+                "bv": g(p + "attention.self.value.bias").reshape(nh, hd),
+                "wo": g(p + "attention.output.dense.weight").T.reshape(
+                    nh, hd, H),
+                "bo": g(p + "attention.output.dense.bias"),
+            },
+            "attn_norm": {
+                "scale": g(p + "attention.output.LayerNorm.weight"),
+                "bias": g(p + "attention.output.LayerNorm.bias")},
+            "mlp": {
+                "wi": g(p + "intermediate.dense.weight").T,
+                "bi": g(p + "intermediate.dense.bias"),
+                "wo": g(p + "output.dense.weight").T,
+                "bo": g(p + "output.dense.bias"),
+            },
+            "mlp_norm": {
+                "scale": g(p + "output.LayerNorm.weight"),
+                "bias": g(p + "output.LayerNorm.bias")},
+        }
+    tree: Dict[str, Any] = {"encoder": enc}
+    if r.has("cls.predictions.transform.dense.weight"):
+        tree.update({
+            "transform_w": r.get("cls.predictions.transform.dense.weight").T,
+            "transform_b": r.get("cls.predictions.transform.dense.bias"),
+            "transform_norm": {
+                "scale": r.get(
+                    "cls.predictions.transform.LayerNorm.weight"),
+                "bias": r.get("cls.predictions.transform.LayerNorm.bias")},
+            "decoder_bias": r.get("cls.predictions.bias"),
+        })
+    log_dist(f"loaded HF BERT checkpoint {model_path} "
+             f"({cfg.num_layers}L/{H}H)", ranks=[0])
+    return cfg, tree
+
+
 def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
                        dtype=None) -> Tuple[Any, Dict[str, Any]]:
     """Load an HF model directory → (GPTConfig, flax params tree).

@@ -1,0 +1,82 @@
+"""Encoder (BERT-family) inference engine — single-shot forward, no KV cache.
+
+Reference: the v1 InferenceEngine serving encoder policies
+(module_inject/containers/bert.py HFBertLayerPolicy via
+replace_transformer_layer); encoders need none of the generate/cache
+machinery, so this engine is just a jitted forward with the same dtype and
+mesh handling as the decoder engine."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+_DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
+           "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+           "fp16": jnp.float16, "float16": jnp.float16}
+
+
+class EncoderInferenceEngine:
+    """``forward(input_ids, token_type_ids, attention_mask) -> logits``.
+
+    With an MLM head in the checkpoint the logits are vocab logits
+    ([B, T, V]); otherwise the encoder's hidden states ([B, T, H])."""
+
+    def __init__(self, model_cfg, params, config: Optional[Dict[str,
+                                                                Any]] = None,
+                 mesh=None):
+        import dataclasses
+
+        from deepspeed_tpu.models.bert import BertEncoder, BertForMaskedLM
+
+        if mesh is not None:
+            raise ValueError(
+                "EncoderInferenceEngine has no sharded serving path yet — "
+                "refusing a mesh rather than silently serving replicated")
+        config = dict(config or {})
+        dtype = _DTYPES.get(str(config.get("dtype", "fp32")).lower())
+        if dtype is None:
+            raise ValueError(f"unknown dtype {config.get('dtype')!r}")
+        self.model_config = dataclasses.replace(model_cfg, dtype=dtype)
+        self.has_mlm_head = "transform_w" in params
+        module_cls = BertForMaskedLM if self.has_mlm_head else BertEncoder
+        self._module = module_cls(self.model_config)
+        if not self.has_mlm_head:
+            # headless: the BertEncoder module's params are the "encoder"
+            # subtree itself
+            params = params.get("encoder", params)
+        self.params = jax.device_put({"params": params})
+
+        def fwd(p, ids, types, mask):
+            out = self._module.apply(p, ids, types, mask)
+            if not self.has_mlm_head:
+                out = out[0]                      # (hidden, wte) → hidden
+            return out.astype(jnp.float32)
+
+        self._fwd = jax.jit(fwd)
+        n = sum(int(np.prod(x.shape))
+                for x in jax.tree_util.tree_leaves(params))
+        log_dist(f"encoder inference engine ready: params={n/1e6:.1f}M "
+                 f"mlm_head={self.has_mlm_head} dtype={dtype.__name__}",
+                 ranks=[0])
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[1] > self.model_config.max_seq_len:
+            raise ValueError(
+                f"input length {ids.shape[1]} exceeds max_seq_len "
+                f"{self.model_config.max_seq_len}")
+        types = (jnp.zeros_like(ids) if token_type_ids is None
+                 else jnp.asarray(np.asarray(token_type_ids), jnp.int32))
+        mask = (jnp.ones_like(ids) if attention_mask is None
+                else jnp.asarray(np.asarray(attention_mask), jnp.int32))
+        return self._fwd(self.params, ids, types, mask)
+
+    __call__ = forward

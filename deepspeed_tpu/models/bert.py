@@ -1,0 +1,198 @@
+"""BERT-family encoder (bidirectional transformer + MLM head).
+
+Reference: module_inject/containers/{bert,distil_bert}.py (HFBertLayerPolicy —
+the injection zoo's encoder rows) and the fused training transformer kernel
+(csrc/transformer/ds_transformer_cuda.cpp) whose flagship workload was BERT
+pre-training.
+
+TPU-first shape: same logical-axis annotations as models/gpt.py (TP/FSDP fall
+out of parallel/partition.py), one fused einsum attention path on the MXU, and
+HF's POST-LayerNorm residual order reproduced exactly so checkpoints load
+bit-compatibly.  No causal mask — padding is the only mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: object = jnp.float32
+    param_dtype: object = jnp.float32
+    activation: str = "gelu_exact"      # HF bert uses exact erf gelu
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("max_seq_len", 64)
+        return cls(num_layers=2, num_heads=4, hidden_size=64, mlp_dim=128,
+                   **kw)
+
+
+def _part(init, names):
+    return nn.with_partitioning(init, names)
+
+
+def _kinit():
+    return nn.initializers.normal(stddev=0.02)
+
+
+class _Norm(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from deepspeed_tpu.ops import layer_norm
+        c = self.cfg
+        scale = self.param("scale", _part(nn.initializers.ones, ("embed",)),
+                           (c.hidden_size,), c.param_dtype)
+        bias = self.param("bias", _part(nn.initializers.zeros, ("embed",)),
+                          (c.hidden_size,), c.param_dtype)
+        return layer_norm(x, scale, bias, eps=c.norm_eps)
+
+
+class _SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        c = self.cfg
+        H, nh, hd = c.hidden_size, c.num_heads, c.head_dim
+
+        def lin(name, shape, axes):
+            return (self.param(f"w{name}", _part(_kinit(), axes), shape,
+                               c.param_dtype),
+                    self.param(f"b{name}",
+                               _part(nn.initializers.zeros, axes[1:]),
+                               shape[1:], c.param_dtype))
+
+        wq, bq = lin("q", (H, nh, hd), ("embed", "heads", "kv"))
+        wk, bk = lin("k", (H, nh, hd), ("embed", "heads", "kv"))
+        wv, bv = lin("v", (H, nh, hd), ("embed", "heads", "kv"))
+        wo = self.param("wo", _part(_kinit(), ("heads", "kv", "embed")),
+                        (nh, hd, H), c.param_dtype)
+        bo = self.param("bo", _part(nn.initializers.zeros, ("embed",)),
+                        (H,), c.param_dtype)
+
+        q = jnp.einsum("bth,hnd->btnd", x, wq.astype(x.dtype)) + bq.astype(
+            x.dtype)
+        k = jnp.einsum("bth,hnd->btnd", x, wk.astype(x.dtype)) + bk.astype(
+            x.dtype)
+        v = jnp.einsum("bth,hnd->btnd", x, wv.astype(x.dtype)) + bv.astype(
+            x.dtype)
+        # bidirectional: every query row sees all non-pad keys
+        mask = jnp.broadcast_to(pad_mask[:, None, :].astype(bool),
+                                (x.shape[0], x.shape[1], x.shape[1]))
+        from deepspeed_tpu import ops
+        out = ops.causal_attention(q, k, v, causal=False, mask=mask)
+        return jnp.einsum("btnd,ndh->bth", out, wo.astype(x.dtype)) \
+            + bo.astype(x.dtype)
+
+
+class _Mlp(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from deepspeed_tpu.models.gpt import mlp_activation
+        c = self.cfg
+        wi = self.param("wi", _part(_kinit(), ("embed", "mlp")),
+                        (c.hidden_size, c.mlp_dim), c.param_dtype)
+        bi = self.param("bi", _part(nn.initializers.zeros, ("mlp",)),
+                        (c.mlp_dim,), c.param_dtype)
+        wo = self.param("wo", _part(_kinit(), ("mlp", "embed")),
+                        (c.mlp_dim, c.hidden_size), c.param_dtype)
+        bo = self.param("bo", _part(nn.initializers.zeros, ("embed",)),
+                        (c.hidden_size,), c.param_dtype)
+        h = mlp_activation(c.activation)(x @ wi.astype(x.dtype)
+                                         + bi.astype(x.dtype))
+        return h @ wo.astype(x.dtype) + bo.astype(x.dtype)
+
+
+class _Block(nn.Module):
+    """HF Bert layer: POST-norm — x = LN(x + attn(x)); x = LN(x + mlp(x))."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        c = self.cfg
+        x = _Norm(c, name="attn_norm")(x + _SelfAttention(c, name="attn")(
+            x, pad_mask))
+        x = _Norm(c, name="mlp_norm")(x + _Mlp(c, name="mlp")(x))
+        return x
+
+
+class BertEncoder(nn.Module):
+    """ids (+ token types, padding mask) → (hidden states [B, T, H], wte) —
+    the embedding table rides along for the tied MLM decoder (same contract
+    as gpt.py GPTBackbone)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.cfg
+        B, T = input_ids.shape
+        wte = self.param("wte", _part(_kinit(), ("vocab", "embed")),
+                         (c.vocab_size, c.hidden_size), c.param_dtype)
+        wpe = self.param("wpe", _part(_kinit(), (None, "embed")),
+                         (c.max_seq_len, c.hidden_size), c.param_dtype)
+        wtt = self.param("wtt", _part(_kinit(), (None, "embed")),
+                         (c.type_vocab_size, c.hidden_size), c.param_dtype)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        x = (wte.astype(c.dtype)[input_ids]
+             + wpe.astype(c.dtype)[jnp.arange(T)][None]
+             + wtt.astype(c.dtype)[token_type_ids])
+        x = _Norm(c, name="embed_norm")(x)
+        for i in range(c.num_layers):
+            x = _Block(c, name=f"block_{i}")(x, attention_mask)
+        return x, wte
+
+
+class BertForMaskedLM(nn.Module):
+    """Encoder + MLM transform head (dense→gelu→LN→tied decoder + bias) —
+    exactly HF's BertOnlyMLMHead so checkpoints reproduce logits."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.cfg
+        x, wte = BertEncoder(c, name="encoder")(input_ids, token_type_ids,
+                                                attention_mask)
+        wt = self.param("transform_w", _part(_kinit(), ("embed", "embed2")),
+                        (c.hidden_size, c.hidden_size), c.param_dtype)
+        bt = self.param("transform_b", _part(nn.initializers.zeros,
+                                             ("embed2",)),
+                        (c.hidden_size,), c.param_dtype)
+        from deepspeed_tpu.models.gpt import mlp_activation
+        x = mlp_activation(c.activation)(x @ wt.astype(x.dtype)
+                                         + bt.astype(x.dtype))
+        x = _Norm(c, name="transform_norm")(x)
+        logits = x @ wte.astype(x.dtype).T           # tied decoder
+        bias = self.param("decoder_bias", _part(nn.initializers.zeros,
+                                                ("vocab",)),
+                          (c.vocab_size,), c.param_dtype)
+        return (logits + bias.astype(x.dtype)).astype(jnp.float32)

@@ -361,11 +361,12 @@ class Attention(nn.Module):
                                    bias=alibi_bias(kp2))
             return out_proj(out)
 
-        if c.use_alibi and c.sequence_parallel:
+        sp_active = (c.sequence_parallel and self.mesh is not None
+                     and self.mesh.shape["sp"] > 1)
+        if c.use_alibi and sp_active:
             raise ValueError("alibi + sequence parallelism is not wired "
                              "(the a2a/ring paths carry no logit bias)")
-        if (c.sequence_parallel and self.mesh is not None
-                and self.mesh.shape["sp"] > 1):
+        if sp_active:
             # sequence parallelism: Ulysses (seq→head all-to-all swap around
             # local attention) or ring (KV blocks rotate over neighbor links;
             # no head-divisibility constraint — sequence/ring.py).  Dropout

@@ -315,6 +315,76 @@ class TestOptPhiFalcon:
         _check(path, model, rng, 128)
 
 
+class TestBertEncoder:
+    """Encoder family (reference module_inject/containers/bert.py
+    HFBertLayerPolicy): MLM logits parity + padding-mask correctness."""
+
+    def _model(self):
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2)
+        torch.manual_seed(20)
+        return transformers.BertForMaskedLM(cfg).eval()
+
+    def test_bert_mlm_logits_match(self, tmp_models, rng):
+        model = self._model()
+        path = _save(tmp_models, model, "bert")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        types = (rng.integers(0, 2, (2, 12))).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long),
+                         token_type_ids=torch.tensor(types, dtype=torch.long)
+                         ).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        got = np.asarray(eng.forward(ids, token_type_ids=types))
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+    def test_bert_padding_mask(self, tmp_models, rng):
+        model = self._model()
+        path = _save(tmp_models, model, "bert")
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        ids = rng.integers(0, 128, (1, 10)).astype(np.int32)
+        mask = np.ones((1, 10), np.int32)
+        mask[0, 7:] = 0
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long),
+                         attention_mask=torch.tensor(mask,
+                                                     dtype=torch.long)
+                         ).logits.numpy()
+        got = np.asarray(eng.forward(ids, attention_mask=mask))
+        # compare only non-pad rows (HF computes pad rows too but they are
+        # meaningless; ours match on the attended positions)
+        np.testing.assert_allclose(got[0, :7], want[0, :7], atol=2e-3,
+                                   rtol=1e-3)
+
+    def test_bare_bertmodel_hidden_states(self, tmp_models, rng):
+        """architectures=['BertModel'] (no 'bert.' prefix, no MLM head) →
+        last-hidden-state parity."""
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64)
+        torch.manual_seed(21)
+        model = transformers.BertModel(cfg).eval()
+        path = _save(tmp_models, model, "bert_bare")
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        assert not eng.has_mlm_head
+        ids = rng.integers(0, 128, (2, 10)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)
+                         ).last_hidden_state.numpy()
+        np.testing.assert_allclose(np.asarray(eng.forward(ids)), want,
+                                   atol=2e-3, rtol=1e-3)
+
+    def test_bert_seq_len_guard(self, tmp_models):
+        model = self._model()
+        path = _save(tmp_models, model, "bert")
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.forward(np.zeros((1, 65), np.int32))
+
+
 class TestV2Serving:
     def test_v2_engine_serves_hf_checkpoint(self, tmp_models, rng):
         """Greedy tokens from the ragged engine == HF greedy generate."""
