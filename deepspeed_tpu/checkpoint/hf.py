@@ -807,16 +807,97 @@ def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return tree
 
 
-_BERT_LIKE = {"BertForMaskedLM", "BertModel", "BertForPreTraining"}
+_DISTILBERT_LIKE = {"DistilBertForMaskedLM", "DistilBertModel",
+                    "DistilBertForSequenceClassification"}
+_BERT_LIKE = {"BertForMaskedLM", "BertModel", "BertForPreTraining",
+              "BertForSequenceClassification"} | _DISTILBERT_LIKE
+
+
+def _distilbert_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """DistilBERT → the same flax encoder tree (reference
+    module_inject/containers/distil_bert.py): q/k/v/out lin, sa_layer_norm +
+    output_layer_norm, no token types, tied vocab_projector head."""
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def g(name):
+        return r.get("distilbert." + name
+                     if r.has("distilbert." + name) else name)
+
+    enc: Dict[str, Any] = {
+        "wte": g("embeddings.word_embeddings.weight"),
+        "wpe": g("embeddings.position_embeddings.weight"),
+        "embed_norm": {"scale": g("embeddings.LayerNorm.weight"),
+                       "bias": g("embeddings.LayerNorm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.layer.{i}."
+        enc[f"block_{i}"] = {
+            "attn": {
+                "wq": g(p + "attention.q_lin.weight").T.reshape(H, nh, hd),
+                "bq": g(p + "attention.q_lin.bias").reshape(nh, hd),
+                "wk": g(p + "attention.k_lin.weight").T.reshape(H, nh, hd),
+                "bk": g(p + "attention.k_lin.bias").reshape(nh, hd),
+                "wv": g(p + "attention.v_lin.weight").T.reshape(H, nh, hd),
+                "bv": g(p + "attention.v_lin.bias").reshape(nh, hd),
+                "wo": g(p + "attention.out_lin.weight").T.reshape(nh, hd, H),
+                "bo": g(p + "attention.out_lin.bias"),
+            },
+            "attn_norm": {"scale": g(p + "sa_layer_norm.weight"),
+                          "bias": g(p + "sa_layer_norm.bias")},
+            "mlp": {
+                "wi": g(p + "ffn.lin1.weight").T,
+                "bi": g(p + "ffn.lin1.bias"),
+                "wo": g(p + "ffn.lin2.weight").T,
+                "bo": g(p + "ffn.lin2.bias"),
+            },
+            "mlp_norm": {"scale": g(p + "output_layer_norm.weight"),
+                         "bias": g(p + "output_layer_norm.bias")},
+        }
+    tree: Dict[str, Any] = {"encoder": enc}
+    if r.has("vocab_transform.weight"):
+        tree.update({
+            "transform_w": r.get("vocab_transform.weight").T,
+            "transform_b": r.get("vocab_transform.bias"),
+            "transform_norm": {"scale": r.get("vocab_layer_norm.weight"),
+                               "bias": r.get("vocab_layer_norm.bias")},
+            "decoder_bias": r.get("vocab_projector.bias"),
+        })
+    elif r.has("classifier.weight"):     # DistilBertForSequenceClassification
+        tree.update({
+            "pooler_w": r.get("pre_classifier.weight").T,
+            "pooler_b": r.get("pre_classifier.bias"),
+            "cls_w": r.get("classifier.weight").T,
+            "cls_b": r.get("classifier.bias"),
+        })
+    return tree
 
 
 def load_hf_bert(model_path: str, *, dtype=None) -> Tuple[Any,
                                                           Dict[str, Any]]:
     """BERT-family encoder checkpoint → (BertConfig, flax params tree)
-    (reference module_inject/containers/bert.py HFBertLayerPolicy)."""
+    (reference module_inject/containers/{bert,distil_bert}.py)."""
     from deepspeed_tpu.models.bert import BertConfig
 
     hf = _read_json(os.path.join(model_path, "config.json"))
+    arch = _arch_of(hf)
+    if arch in _DISTILBERT_LIKE:
+        cfg = BertConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["n_layers"],
+            num_heads=hf["n_heads"],
+            hidden_size=hf["dim"],
+            mlp_dim=hf["hidden_dim"],
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            type_vocab_size=0,
+            norm_eps=1e-12,
+            activation=_map_activation(arch, hf.get("activation", "gelu")),
+            pooler_act="relu",       # distilbert pre_classifier uses relu
+            dtype=dtype or jnp.float32,
+        )
+        tree = _distilbert_tree(_ShardReader(model_path), cfg)
+        log_dist(f"loaded HF DistilBERT checkpoint {model_path} "
+                 f"({cfg.num_layers}L/{cfg.hidden_size}H)", ranks=[0])
+        return cfg, tree
     cfg = BertConfig(
         vocab_size=hf["vocab_size"],
         num_layers=hf["num_hidden_layers"],
@@ -885,6 +966,13 @@ def load_hf_bert(model_path: str, *, dtype=None) -> Tuple[Any,
                     "cls.predictions.transform.LayerNorm.weight"),
                 "bias": r.get("cls.predictions.transform.LayerNorm.bias")},
             "decoder_bias": r.get("cls.predictions.bias"),
+        })
+    elif r.has("classifier.weight"):     # BertForSequenceClassification
+        tree.update({
+            "pooler_w": g("pooler.dense.weight").T,
+            "pooler_b": g("pooler.dense.bias"),
+            "cls_w": r.get("classifier.weight").T,
+            "cls_b": r.get("classifier.bias"),
         })
     log_dist(f"loaded HF BERT checkpoint {model_path} "
              f"({cfg.num_layers}L/{H}H)", ranks=[0])

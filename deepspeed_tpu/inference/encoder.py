@@ -22,17 +22,19 @@ _DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
 
 
 class EncoderInferenceEngine:
-    """``forward(input_ids, token_type_ids, attention_mask) -> logits``.
+    """``forward(input_ids, token_type_ids, attention_mask) -> output``.
 
-    With an MLM head in the checkpoint the logits are vocab logits
-    ([B, T, V]); otherwise the encoder's hidden states ([B, T, H])."""
+    Output follows the checkpoint's head: MLM → vocab logits [B, T, V];
+    sequence classification → class logits [B, num_labels]; headless →
+    hidden states [B, T, H]."""
 
     def __init__(self, model_cfg, params, config: Optional[Dict[str,
                                                                 Any]] = None,
                  mesh=None):
         import dataclasses
 
-        from deepspeed_tpu.models.bert import BertEncoder, BertForMaskedLM
+        from deepspeed_tpu.models.bert import (BertEncoder, BertForMaskedLM,
+                                               BertForSequenceClassification)
 
         if mesh is not None:
             raise ValueError(
@@ -44,26 +46,34 @@ class EncoderInferenceEngine:
             raise ValueError(f"unknown dtype {config.get('dtype')!r}")
         self.model_config = dataclasses.replace(model_cfg, dtype=dtype)
         self.has_mlm_head = "transform_w" in params
-        module_cls = BertForMaskedLM if self.has_mlm_head else BertEncoder
-        self._module = module_cls(self.model_config)
-        if not self.has_mlm_head:
+        self.has_cls_head = "cls_w" in params
+        if self.has_mlm_head:
+            self._module = BertForMaskedLM(self.model_config)
+        elif self.has_cls_head:
+            self._module = BertForSequenceClassification(
+                self.model_config, num_labels=params["cls_w"].shape[-1])
+        else:
             # headless: the BertEncoder module's params are the "encoder"
             # subtree itself
+            self._module = BertEncoder(self.model_config)
             params = params.get("encoder", params)
         self.params = jax.device_put({"params": params})
 
+        headless = not (self.has_mlm_head or self.has_cls_head)
+
         def fwd(p, ids, types, mask):
             out = self._module.apply(p, ids, types, mask)
-            if not self.has_mlm_head:
+            if headless:
                 out = out[0]                      # (hidden, wte) → hidden
             return out.astype(jnp.float32)
 
         self._fwd = jax.jit(fwd)
         n = sum(int(np.prod(x.shape))
                 for x in jax.tree_util.tree_leaves(params))
+        head = ("mlm" if self.has_mlm_head
+                else "classifier" if self.has_cls_head else "none")
         log_dist(f"encoder inference engine ready: params={n/1e6:.1f}M "
-                 f"mlm_head={self.has_mlm_head} dtype={dtype.__name__}",
-                 ranks=[0])
+                 f"head={head} dtype={dtype.__name__}", ranks=[0])
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
@@ -73,6 +83,12 @@ class EncoderInferenceEngine:
             raise ValueError(
                 f"input length {ids.shape[1]} exceeds max_seq_len "
                 f"{self.model_config.max_seq_len}")
+        if (token_type_ids is not None
+                and not self.model_config.type_vocab_size):
+            raise ValueError(
+                "this checkpoint has no token-type (segment) embeddings "
+                "(distilbert); passing token_type_ids would be silently "
+                "ignored")
         types = (jnp.zeros_like(ids) if token_type_ids is None
                  else jnp.asarray(np.asarray(token_type_ids), jnp.int32))
         mask = (jnp.ones_like(ids) if attention_mask is None

@@ -34,6 +34,8 @@ class BertConfig:
     dtype: object = jnp.float32
     param_dtype: object = jnp.float32
     activation: str = "gelu_exact"      # HF bert uses exact erf gelu
+    pooler_act: str = "tanh"            # bert pooler tanh; distilbert
+    #                                     pre_classifier relu
 
     @property
     def head_dim(self) -> int:
@@ -156,19 +158,47 @@ class BertEncoder(nn.Module):
                          (c.vocab_size, c.hidden_size), c.param_dtype)
         wpe = self.param("wpe", _part(_kinit(), (None, "embed")),
                          (c.max_seq_len, c.hidden_size), c.param_dtype)
-        wtt = self.param("wtt", _part(_kinit(), (None, "embed")),
-                         (c.type_vocab_size, c.hidden_size), c.param_dtype)
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
         x = (wte.astype(c.dtype)[input_ids]
-             + wpe.astype(c.dtype)[jnp.arange(T)][None]
-             + wtt.astype(c.dtype)[token_type_ids])
+             + wpe.astype(c.dtype)[jnp.arange(T)][None])
+        if c.type_vocab_size:          # distilbert has no segment embeddings
+            wtt = self.param("wtt", _part(_kinit(), (None, "embed")),
+                             (c.type_vocab_size, c.hidden_size),
+                             c.param_dtype)
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + wtt.astype(c.dtype)[token_type_ids]
         x = _Norm(c, name="embed_norm")(x)
         for i in range(c.num_layers):
             x = _Block(c, name=f"block_{i}")(x, attention_mask)
         return x, wte
+
+
+class BertForSequenceClassification(nn.Module):
+    """Encoder + pooler (dense-tanh on [CLS]) + classifier — HF's
+    BertForSequenceClassification layout."""
+
+    cfg: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.cfg
+        x, _ = BertEncoder(c, name="encoder")(input_ids, token_type_ids,
+                                              attention_mask)
+        wp = self.param("pooler_w", _part(_kinit(), ("embed", "embed2")),
+                        (c.hidden_size, c.hidden_size), c.param_dtype)
+        bp = self.param("pooler_b", _part(nn.initializers.zeros, ("embed2",)),
+                        (c.hidden_size,), c.param_dtype)
+        act = jnp.tanh if c.pooler_act == "tanh" else jax.nn.relu
+        pooled = act(x[:, 0] @ wp.astype(x.dtype) + bp.astype(x.dtype))
+        wc = self.param("cls_w", _part(_kinit(), ("embed", None)),
+                        (c.hidden_size, self.num_labels), c.param_dtype)
+        bc = self.param("cls_b", _part(nn.initializers.zeros, (None,)),
+                        (self.num_labels,), c.param_dtype)
+        return (pooled @ wc.astype(x.dtype)
+                + bc.astype(x.dtype)).astype(jnp.float32)
 
 
 class BertForMaskedLM(nn.Module):

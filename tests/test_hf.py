@@ -377,6 +377,39 @@ class TestBertEncoder:
         np.testing.assert_allclose(np.asarray(eng.forward(ids)), want,
                                    atol=2e-3, rtol=1e-3)
 
+    def test_distilbert_mlm_logits_match(self, tmp_models, rng):
+        """DistilBERT (reference module_inject/containers/distil_bert.py):
+        no token types, tied vocab projector."""
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+            max_position_embeddings=64)
+        torch.manual_seed(22)
+        model = transformers.DistilBertForMaskedLM(cfg).eval()
+        path = _save(tmp_models, model, "distilbert")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        np.testing.assert_allclose(np.asarray(eng.forward(ids)), want,
+                                   atol=2e-3, rtol=1e-3)
+
+    def test_bert_sequence_classification(self, tmp_models, rng):
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, num_labels=3)
+        torch.manual_seed(23)
+        model = transformers.BertForSequenceClassification(cfg).eval()
+        path = _save(tmp_models, model, "bert_cls")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        assert eng.has_cls_head
+        got = np.asarray(eng.forward(ids))
+        assert got.shape == (2, 3)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
     def test_bert_seq_len_guard(self, tmp_models):
         model = self._model()
         path = _save(tmp_models, model, "bert")
@@ -547,3 +580,31 @@ class TestMixtral:
         want = _torch_logits(model, ids)
         got = _our_logits(src, ids)
         np.testing.assert_allclose(got, want, atol=3e-3, rtol=2e-3)
+
+
+class TestDistilBertClassifier:
+    def test_distilbert_classification_logits_match(self, tmp_models, rng):
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+            max_position_embeddings=64, num_labels=3, seq_classif_dropout=0.0)
+        torch.manual_seed(24)
+        model = transformers.DistilBertForSequenceClassification(cfg).eval()
+        path = _save(tmp_models, model, "distilbert_cls")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        got = np.asarray(eng.forward(ids))
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+    def test_token_types_rejected_for_distilbert(self, tmp_models, rng):
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=128,
+            max_position_embeddings=64)
+        torch.manual_seed(22)
+        model = transformers.DistilBertForMaskedLM(cfg).eval()
+        path = _save(tmp_models, model, "distilbert")
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        with pytest.raises(ValueError, match="token-type"):
+            eng.forward(np.zeros((1, 8), np.int32),
+                        token_type_ids=np.zeros((1, 8), np.int32))
