@@ -809,8 +809,13 @@ def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
 
 _DISTILBERT_LIKE = {"DistilBertForMaskedLM", "DistilBertModel",
                     "DistilBertForSequenceClassification"}
-_BERT_LIKE = {"BertForMaskedLM", "BertModel", "BertForPreTraining",
-              "BertForSequenceClassification"} | _DISTILBERT_LIKE
+_ROBERTA_LIKE = {"RobertaForMaskedLM", "RobertaModel",
+                 "RobertaForSequenceClassification",
+                 "XLMRobertaForMaskedLM", "XLMRobertaModel",
+                 "XLMRobertaForSequenceClassification"}
+_BERT_LIKE = ({"BertForMaskedLM", "BertModel", "BertForPreTraining",
+               "BertForSequenceClassification"}
+              | _DISTILBERT_LIKE | _ROBERTA_LIKE)
 
 
 def _distilbert_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
@@ -898,24 +903,34 @@ def load_hf_bert(model_path: str, *, dtype=None) -> Tuple[Any,
         log_dist(f"loaded HF DistilBERT checkpoint {model_path} "
                  f"({cfg.num_layers}L/{cfg.hidden_size}H)", ranks=[0])
         return cfg, tree
+    is_roberta = arch in _ROBERTA_LIKE
+    # roberta positions start at padding_idx+1; the table keeps its offset
+    # rows (pad tokens take row padding_idx), so only the USABLE length
+    # shrinks
+    rob_pad = int(hf.get("pad_token_id") or 1) if is_roberta else None
+    pos_off = (rob_pad + 1) if is_roberta else 0
     cfg = BertConfig(
         vocab_size=hf["vocab_size"],
         num_layers=hf["num_hidden_layers"],
         num_heads=hf["num_attention_heads"],
         hidden_size=hf["hidden_size"],
         mlp_dim=hf["intermediate_size"],
-        max_seq_len=hf.get("max_position_embeddings", 512),
+        max_seq_len=hf.get("max_position_embeddings", 512) - pos_off,
         type_vocab_size=hf.get("type_vocab_size", 2),
         norm_eps=float(hf.get("layer_norm_eps", 1e-12)),
         activation=_map_activation(_arch_of(hf), hf.get("hidden_act",
                                                         "gelu")),
+        pos_pad_token=rob_pad,
         dtype=dtype or jnp.float32,
     )
     r = _ShardReader(model_path)
 
     def g(name):
-        # BertForMaskedLM prefixes with "bert."; plain BertModel doesn't
-        return r.get("bert." + name if r.has("bert." + name) else name)
+        # headed checkpoints prefix with "bert."/"roberta."; bare models don't
+        for pre in ("bert.", "roberta."):
+            if r.has(pre + name):
+                return r.get(pre + name)
+        return r.get(name)
 
     H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
     enc: Dict[str, Any] = {
@@ -966,6 +981,22 @@ def load_hf_bert(model_path: str, *, dtype=None) -> Tuple[Any,
                     "cls.predictions.transform.LayerNorm.weight"),
                 "bias": r.get("cls.predictions.transform.LayerNorm.bias")},
             "decoder_bias": r.get("cls.predictions.bias"),
+        })
+    elif r.has("lm_head.dense.weight"):  # roberta MLM head naming
+        tree.update({
+            "transform_w": r.get("lm_head.dense.weight").T,
+            "transform_b": r.get("lm_head.dense.bias"),
+            "transform_norm": {"scale": r.get("lm_head.layer_norm.weight"),
+                               "bias": r.get("lm_head.layer_norm.bias")},
+            "decoder_bias": r.get("lm_head.bias"),
+        })
+    elif r.has("classifier.out_proj.weight"):
+        # roberta classification head: dense→tanh→out_proj on [CLS]
+        tree.update({
+            "pooler_w": r.get("classifier.dense.weight").T,
+            "pooler_b": r.get("classifier.dense.bias"),
+            "cls_w": r.get("classifier.out_proj.weight").T,
+            "cls_b": r.get("classifier.out_proj.bias"),
         })
     elif r.has("classifier.weight"):     # BertForSequenceClassification
         tree.update({

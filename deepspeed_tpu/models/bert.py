@@ -36,6 +36,9 @@ class BertConfig:
     activation: str = "gelu_exact"      # HF bert uses exact erf gelu
     pooler_act: str = "tanh"            # bert pooler tanh; distilbert
     #                                     pre_classifier relu
+    pos_pad_token: Optional[int] = None  # roberta: positions count only
+    #                                      non-pad tokens (HF create_position_
+    #                                      ids_from_input_ids); None = arange
 
     @property
     def head_dim(self) -> int:
@@ -156,12 +159,24 @@ class BertEncoder(nn.Module):
         B, T = input_ids.shape
         wte = self.param("wte", _part(_kinit(), ("vocab", "embed")),
                          (c.vocab_size, c.hidden_size), c.param_dtype)
+        # roberta keeps its padding_idx-offset position table whole: real
+        # token #k sits at row k+padding_idx, pad tokens at row padding_idx
+        pos_rows = c.max_seq_len + (c.pos_pad_token + 1
+                                    if c.pos_pad_token is not None else 0)
         wpe = self.param("wpe", _part(_kinit(), (None, "embed")),
-                         (c.max_seq_len, c.hidden_size), c.param_dtype)
+                         (pos_rows, c.hidden_size), c.param_dtype)
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
-        x = (wte.astype(c.dtype)[input_ids]
-             + wpe.astype(c.dtype)[jnp.arange(T)][None])
+        if c.pos_pad_token is not None:
+            # HF create_position_ids_from_input_ids exactly: an id equal to
+            # the pad token never advances the counter and takes row
+            # padding_idx itself
+            real = (input_ids != c.pos_pad_token).astype(jnp.int32)
+            pos = jnp.cumsum(real, axis=1) * real + c.pos_pad_token
+            pos_emb = wpe.astype(c.dtype)[pos]
+        else:
+            pos_emb = wpe.astype(c.dtype)[jnp.arange(T)][None]
+        x = wte.astype(c.dtype)[input_ids] + pos_emb
         if c.type_vocab_size:          # distilbert has no segment embeddings
             wtt = self.param("wtt", _part(_kinit(), (None, "embed")),
                              (c.type_vocab_size, c.hidden_size),

@@ -608,3 +608,59 @@ class TestDistilBertClassifier:
         with pytest.raises(ValueError, match="token-type"):
             eng.forward(np.zeros((1, 8), np.int32),
                         token_type_ids=np.zeros((1, 8), np.int32))
+
+
+class TestRoberta:
+    """RoBERTa/XLM-R (offset-2 learned positions, lm_head naming, dense->
+    tanh->out_proj classification head)."""
+
+    def test_roberta_mlm_logits_match(self, tmp_models, rng):
+        cfg = transformers.RobertaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=66, type_vocab_size=1)
+        torch.manual_seed(25)
+        model = transformers.RobertaForMaskedLM(cfg).eval()
+        path = _save(tmp_models, model, "roberta")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        np.testing.assert_allclose(np.asarray(eng.forward(ids)), want,
+                                   atol=2e-3, rtol=1e-3)
+
+    def test_roberta_pad_positions_match_hf(self, tmp_models, rng):
+        """Inputs CONTAINING the pad id (1): HF's position counter skips
+        them — ours must too (create_position_ids_from_input_ids parity)."""
+        cfg = transformers.RobertaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=66, type_vocab_size=1)
+        torch.manual_seed(25)
+        model = transformers.RobertaForMaskedLM(cfg).eval()
+        path = _save(tmp_models, model, "roberta")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        ids[0, 3] = 1
+        ids[1, 0] = 1          # pad id mid-sequence and at the front
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        np.testing.assert_allclose(np.asarray(eng.forward(ids)), want,
+                                   atol=2e-3, rtol=1e-3)
+
+    def test_roberta_classification_logits_match(self, tmp_models, rng):
+        cfg = transformers.RobertaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=66, type_vocab_size=1, num_labels=4,
+            classifier_dropout=0.0, hidden_dropout_prob=0.0)
+        torch.manual_seed(26)
+        model = transformers.RobertaForSequenceClassification(cfg).eval()
+        path = _save(tmp_models, model, "roberta_cls")
+        ids = rng.integers(0, 128, (2, 12)).astype(np.int32)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        got = np.asarray(eng.forward(ids))
+        assert got.shape == (2, 4)
+        np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
