@@ -39,10 +39,11 @@ _PHI_LIKE = {"PhiForCausalLM"}
 _FALCON_LIKE = {"FalconForCausalLM"}
 _GPTJ_LIKE = {"GPTJForCausalLM"}
 _NEOX_LIKE = {"GPTNeoXForCausalLM"}
+_GPTNEO_LIKE = {"GPTNeoForCausalLM"}
 _BLOOM_LIKE = {"BloomForCausalLM"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
                                  | _PHI_LIKE | _FALCON_LIKE | _GPTJ_LIKE
-                                 | _NEOX_LIKE | _BLOOM_LIKE)
+                                 | _NEOX_LIKE | _BLOOM_LIKE | _GPTNEO_LIKE)
 
 
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
@@ -82,18 +83,19 @@ def _reject_unsupported_semantics(hf: Dict[str, Any], arch: str,
         raise ValueError(
             f"{arch}: mlp_bias=true (gate/up/down biases) is not implemented "
             f"in the SwiGLU body; logits would be silently wrong")
+def _sliding_window_of(hf: Dict[str, Any],
+                       max_seq_len: Optional[int]) -> Optional[int]:
+    """Effective sliding window (mistral/qwen2): None when disabled or when
+    the window never binds at the serving length."""
     window = hf.get("sliding_window")
     uses_window = window is not None and (
         hf.get("use_sliding_window", True) if "use_sliding_window" in hf
         else True)
-    if uses_window:
-        msl = hf.get("max_position_embeddings", 2048)
-        eff = min(msl, max_seq_len or msl)
-        if window < eff:
-            raise ValueError(
-                f"{arch}: sliding_window={window} < effective max_seq_len "
-                f"{eff} — windowed attention is not implemented; cap "
-                f"max_seq_len to {window} to serve exactly")
+    if not uses_window:
+        return None
+    msl = hf.get("max_position_embeddings", 2048)
+    eff = min(msl, max_seq_len or msl)
+    return int(window) if window < eff else None
 
 
 def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
@@ -115,6 +117,17 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
         head_dim = hf.get("head_dim") or hidden // heads
         msl = hf.get("max_position_embeddings", 2048)
         attn_bias = bool(hf.get("attention_bias", False))
+        # sliding window (mistral/qwen2); qwen2 gates SWA to layers
+        # >= max_window_layers (modeling_qwen2 per-layer check)
+        swa = _sliding_window_of(hf, max_seq_len)
+        swa_layers: tuple = ()
+        mwl = hf.get("max_window_layers")
+        if swa and mwl is not None:
+            mwl = int(mwl)
+            if mwl >= hf["num_hidden_layers"]:
+                swa = None                 # no layer ever windows
+            elif mwl > 0:
+                swa_layers = tuple(range(mwl, hf["num_hidden_layers"]))
         moe_kw = {}
         if arch == "MixtralForCausalLM":
             # every layer is MoE with SwiGLU experts (modeling_mixtral.py
@@ -142,6 +155,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
             qkv_bias=(arch == "Qwen2ForCausalLM") or attn_bias,
             attn_out_bias=attn_bias,
+            sliding_window=swa, local_attn_layers=swa_layers,
             dtype=dtype or jnp.bfloat16,
         )
     if arch in _GPT2_LIKE:
@@ -324,6 +338,41 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _GPTNEO_LIKE:
+        # reference module_inject/containers/gptneo.py: learned positions,
+        # UNSCALED attention logits, alternating global/local layers with a
+        # 256-token window, bias-free qkv
+        hidden = hf["hidden_size"]
+        heads = hf["num_heads"] if "num_heads" in hf else hf["num_attention_heads"]  # noqa: E501
+        layers = hf.get("num_layers") or hf["num_hidden_layers"]
+        att_types = hf.get("attention_types") or [[["global", "local"],
+                                                   layers // 2]]
+        layer_kinds: list = []
+        for kinds, rep in att_types:
+            layer_kinds += list(kinds) * rep
+        local_ids = tuple(i for i, k in enumerate(layer_kinds)
+                          if k == "local")
+        msl = hf.get("max_position_embeddings", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=layers,
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf.get("intermediate_size") or 4 * hidden,
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=False, use_rmsnorm=False, gated_mlp=False,
+            activation=_map_activation(arch, hf.get("activation_function",
+                                                    "gelu_new")),
+            attn_scale=1.0,               # gpt-neo does not scale by 1/√d
+            sliding_window=(int(hf.get("window_size", 256))
+                            if local_ids else None),
+            local_attn_layers=local_ids,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            attn_out_bias=True, mlp_bias=True,
             dtype=dtype or jnp.bfloat16,
         )
     if arch in _BLOOM_LIKE:
@@ -759,6 +808,54 @@ def _neox_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return tree
 
 
+def _gptneo_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """GPT-Neo → flax tree (reference module_inject/containers/gptneo.py).
+    torch Linear layout everywhere (unlike gpt2's Conv1D), bias-free qkv."""
+    H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    def g(name):
+        # prefixed (GPTNeoForCausalLM) first; bare GPTNeoModel keys otherwise
+        return r.get(name if r.has(name)
+                     else name[len("transformer."):])
+
+    bb: Dict[str, Any] = {
+        "wte": g("transformer.wte.weight"),
+        "wpe": g("transformer.wpe.weight")[:cfg.max_seq_len],
+        "final_norm": {"scale": g("transformer.ln_f.weight"),
+                       "bias": g("transformer.ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": g(p + "attn.attention.q_proj.weight").T.reshape(
+                    H, nh, hd),
+                "wk": g(p + "attn.attention.k_proj.weight").T.reshape(
+                    H, nh, hd),
+                "wv": g(p + "attn.attention.v_proj.weight").T.reshape(
+                    H, nh, hd),
+                "wo": g(p + "attn.attention.out_proj.weight").T.reshape(
+                    nh, hd, H),
+                "bo": g(p + "attn.attention.out_proj.bias"),
+            },
+            "Norm_0": {"scale": g(p + "ln_1.weight"),
+                       "bias": g(p + "ln_1.bias")},
+            "Norm_1": {"scale": g(p + "ln_2.weight"),
+                       "bias": g(p + "ln_2.bias")},
+            "MLP_0": {
+                "wi": g(p + "mlp.c_fc.weight").T,
+                "bi": g(p + "mlp.c_fc.bias"),
+                "wo": g(p + "mlp.c_proj.weight").T,
+                "bo": g(p + "mlp.c_proj.bias"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    return tree
+
+
 def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     """BLOOM → flax tree (reference module_inject/containers/bloom.py).
     Fused qkv interleaves q/k/v WITHIN each head: [nh, 3, hd]."""
@@ -1034,6 +1131,8 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
         tree = _neox_tree(r, cfg)
     elif arch in _BLOOM_LIKE:
         tree = _bloom_tree(r, cfg)
+    elif arch in _GPTNEO_LIKE:
+        tree = _gptneo_tree(r, cfg)
     else:
         tree = _llama_tree(r, cfg)
     n = sum(int(np.prod(l.shape))

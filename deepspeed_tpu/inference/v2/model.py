@@ -200,6 +200,10 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         # logical position j because blocks are appended in order
         mask = (kvpos[:, None, :] <= qpos_dense[:, :, None]) & \
                (kvpos[:, None, :] < kv_len[:, None, None])   # [S, Q, Kmax]
+        win = cfg.window_for_layer(li)
+        if win is not None:
+            mask = mask & (kvpos[:, None, :]
+                           > qpos_dense[:, :, None] - win)
         from deepspeed_tpu import ops
         bias = None
         if cfg.use_alibi:
@@ -212,7 +216,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         o_dense = ops.causal_attention(q_dense.astype(dtype),
                                        k_pages.astype(dtype),
                                        v_pages.astype(dtype),
-                                       causal=False, mask=mask, bias=bias)
+                                       causal=False, mask=mask, bias=bias,
+                                       scale=cfg.attn_scale)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
         attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")
@@ -288,8 +293,10 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         if cfg.use_alibi:
             from deepspeed_tpu.models.gpt import alibi_slopes
             slopes = jnp.asarray(alibi_slopes(nh, hd, cfg.alibi_prescale))
+        win = cfg.window_for_layer(li)
         o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len,
-                                alibi_slopes=slopes, mesh=mesh)
+                                alibi_slopes=slopes, window=win,
+                                scale=cfg.attn_scale, mesh=mesh)
         o = o.reshape(S, nh, hd)
         attn_delta = _attn_out(ap, o, cfg, "skd,kdh->sh")
         x = _block_residual(blk, x, h, attn_delta, cfg)

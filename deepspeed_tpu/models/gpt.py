@@ -84,6 +84,12 @@ class GPTConfig:
     unembed_bias: bool = False          # lm_head bias (phi)
     use_alibi: bool = False             # alibi attention bias, no positional
     #                                     table (bloom/falcon-rw)
+    sliding_window: Optional[int] = None  # each token sees the last W keys
+    #                                       (mistral; gpt-neo local layers)
+    local_attn_layers: tuple = ()       # layers the window applies to; empty
+    #                                     + sliding_window set = all layers
+    attn_scale: Optional[float] = None  # logit scale; None = 1/sqrt(head_dim)
+    #                                     (gpt-neo uses 1.0)
     alibi_prescale: bool = False        # falcon-rw: (scores+alibi)·scale with
     #                                     bf16-rounded slopes; bloom adds the
     #                                     bias AFTER scaling
@@ -96,6 +102,14 @@ class GPTConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    def window_for_layer(self, i: int):
+        """Per-layer sliding window — THE gating rule shared by the training
+        model, ragged prefill, and paged decode paths."""
+        if self.sliding_window and (not self.local_attn_layers
+                                    or i in self.local_attn_layers):
+            return self.sliding_window
+        return None
 
     @property
     def mlp_dim(self) -> int:
@@ -260,12 +274,13 @@ class Norm(nn.Module):
         return layer_norm(x, scale, bias, eps=c.norm_eps or LN_EPS)
 
 
-def attend_with_mask(q, k, v, mask, bias=None):
+def attend_with_mask(q, k, v, mask, bias=None, scale=None):
     """Attention with an explicit boolean mask [B, Tq, S] — the KV-cache /
     padded-prefill path (reference: masked softmax in
     csrc/transformer/inference/csrc/softmax.cu).  Delegates to the ops layer."""
     from deepspeed_tpu import ops
-    return ops.causal_attention(q, k, v, causal=False, mask=mask, bias=bias)
+    return ops.causal_attention(q, k, v, causal=False, mask=mask, bias=bias,
+                                scale=scale)
 
 
 def causal_attend(q, k, v, probs_dropout=None):
@@ -283,7 +298,7 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, deterministic: bool,
                  use_cache: bool = False, kv_mask=None, start_index=0,
-                 kv_positions=None):
+                 kv_positions=None, window=None):
         c = self.cfg
         B, T, H = x.shape
         nh, nkv, hd = c.num_heads, c.kv_heads, c.head_dim
@@ -355,10 +370,14 @@ class Attention(nn.Module):
                 kp2 = kv_positions                           # [B, S]
             kvpos = kp2[:, None, :]                          # [B|1, 1, S]
             mask = kvpos <= positions[:, :, None]            # causal, absolute
+            if window is not None:
+                # sliding window over LOGICAL positions (mistral/gpt-neo
+                # local attention): key within the last `window` positions
+                mask = mask & (kvpos > positions[:, :, None] - window)
             if kv_mask is not None:
                 mask = mask & kv_mask[:, None, :].astype(bool)
             out = attend_with_mask(q, ck.value, cv.value, mask,
-                                   bias=alibi_bias(kp2))
+                                   bias=alibi_bias(kp2), scale=c.attn_scale)
             return out_proj(out)
 
         sp_active = (c.sequence_parallel and self.mesh is not None
@@ -366,6 +385,13 @@ class Attention(nn.Module):
         if c.use_alibi and sp_active:
             raise ValueError("alibi + sequence parallelism is not wired "
                              "(the a2a/ring paths carry no logit bias)")
+        if window is not None and sp_active:
+            raise ValueError("sliding-window attention + sequence "
+                             "parallelism is not wired")
+        if c.attn_scale is not None and sp_active:
+            raise ValueError("custom attn_scale + sequence parallelism is "
+                             "not wired (the a2a/ring paths use the default "
+                             "1/sqrt(head_dim) scale)")
         if sp_active:
             # sequence parallelism: Ulysses (seq→head all-to-all swap around
             # local attention) or ring (KV blocks rotate over neighbor links;
@@ -393,9 +419,20 @@ class Attention(nn.Module):
             if c.dropout > 0 and not deterministic:
                 pdrop = lambda p: nn.Dropout(rate=c.dropout)(  # noqa: E731
                     p, deterministic=False)
-            out = ops.causal_attention(q, k, v, dropout_fn=pdrop,
-                                       bias=alibi_bias(positions),
-                                       impl=c.attn_impl)
+            if window is not None:
+                # causal ∧ within-window, over absolute positions
+                rel = positions[:, :, None] - positions[:, None, :]
+                wmask = (rel >= 0) & (rel < window)
+                out = ops.causal_attention(q, k, v, causal=False, mask=wmask,
+                                           dropout_fn=pdrop,
+                                           bias=alibi_bias(positions),
+                                           scale=c.attn_scale,
+                                           impl=c.attn_impl)
+            else:
+                out = ops.causal_attention(q, k, v, dropout_fn=pdrop,
+                                           bias=alibi_bias(positions),
+                                           scale=c.attn_scale,
+                                           impl=c.attn_impl)
         return out_proj(out)
 
 
@@ -437,7 +474,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, deterministic: bool,
                  use_cache: bool = False, kv_mask=None, start_index=0,
-                 kv_positions=None, pld_keep=None):
+                 kv_positions=None, pld_keep=None, window=None):
         c = self.cfg
 
         def pld_mask():
@@ -470,14 +507,14 @@ class Block(nn.Module):
             h_mlp = Norm(c)(x) if c.parallel_norms == 2 else h_attn  # Norm_1
             a = Attention(c, mesh=self.mesh)(h_attn, positions, deterministic,
                                              use_cache, kv_mask, start_index,
-                                             kv_positions)
+                                             kv_positions, window=window)
             return (x + pld_gate(a) + pld_gate(MLP(c)(h_mlp, deterministic)),
                     jnp.float32(0.0))
         x = x + pld_gate(
             Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
                                          deterministic, use_cache,
                                          kv_mask, start_index,
-                                         kv_positions))
+                                         kv_positions, window=window))
         if self.is_moe:
             from deepspeed_tpu.moe import MoE
             rng = (self.make_rng("dropout")
@@ -558,18 +595,20 @@ class GPTBackbone(nn.Module):
                 from deepspeed_tpu.runtime.progressive_layer_drop import \
                     layer_keep_prob
                 keep = layer_keep_prob(i, c.num_layers, pld_theta)
+            win = c.window_for_layer(i)
             if (ltd_idx is not None and i in ltd_layers and not use_cache):
                 from deepspeed_tpu.data_pipeline.random_ltd import \
                     apply_random_ltd
                 idx = ltd_idx[ltd_layers.index(i)]
                 x, aux = apply_random_ltd(
                     lambda xk, pk: block(xk, pk, deterministic, False,
-                                         None, 0, None, pld_keep=keep),
+                                         None, 0, None, pld_keep=keep,
+                                         window=win),
                     x, positions, idx)
             else:
                 x, aux = block(x, positions, deterministic,
                                use_cache, kv_mask, start_index, kv_positions,
-                               pld_keep=keep)
+                               pld_keep=keep, window=win)
             aux_total = aux_total + aux
         x = Norm(c, name="final_norm")(x)
         return x, emb, aux_total

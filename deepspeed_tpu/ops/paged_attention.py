@@ -38,7 +38,7 @@ _NEG_INF = -1e30
 
 def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                         scale: Optional[float] = None, alibi_slopes=None,
-                        interpret=None, mesh=None):
+                        window=None, interpret=None, mesh=None):
     """Ground-truth XLA path: gather this slot's pages, masked softmax.
 
     ``mesh`` is accepted for signature parity with the Pallas path; the XLA
@@ -55,6 +55,9 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
         S, MB * bs, nkv, hd)
     kvpos = jnp.arange(MB * bs)
     mask = kvpos[None, :] < kv_lens[:, None]                  # [S, K]
+    if window is not None:
+        # decode query position is kv_len-1; keep the last `window` keys
+        mask = mask & (kvpos[None, :] > kv_lens[:, None] - 1 - window)
     s_log = jnp.einsum("sngd,sknd->sngk", q, k_seq,
                        preferred_element_type=jnp.float32) * scale
     if alibi_slopes is not None:
@@ -134,7 +137,7 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
 
 
 def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
-                           alibi_slopes=None,
+                           alibi_slopes=None, window=None,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
                            mesh=None):
@@ -142,9 +145,9 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     kernel runs per-shard under shard_map (attention is independent per kv
     head, so TP needs no collective here — the reference shards its blocked
     flash the same way, model_implementations/sharding/attn.py)."""
-    if alibi_slopes is not None:
+    if alibi_slopes is not None or window is not None:
         raise ValueError("the pallas paged-attention kernel has no alibi "
-                         "bias; use impl='xla' for alibi models")
+                         "bias or sliding window; use impl='xla'")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[1] % mesh.shape["tp"] == 0):
         from jax import shard_map
@@ -207,9 +210,9 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
 
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
-              alibi_slopes=None, interpret=None, mesh=None):
-    if alibi_slopes is not None:   # alibi rides the XLA fallback
-        return False
+              alibi_slopes=None, window=None, interpret=None, mesh=None):
+    if alibi_slopes is not None or window is not None:
+        return False               # alibi/window ride the XLA fallback
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
@@ -220,7 +223,7 @@ def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
 
 def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                     scale: Optional[float] = None,
-                    alibi_slopes=None,
+                    alibi_slopes=None, window=None,
                     impl: Optional[str] = None,
                     interpret: Optional[bool] = None,
                     mesh=None):
@@ -228,4 +231,4 @@ def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("paged_attention", q, k_pages, v_pages, block_table,
                     kv_lens, scale=scale, alibi_slopes=alibi_slopes,
-                    impl=impl, interpret=interpret, mesh=mesh)
+                    window=window, impl=impl, interpret=interpret, mesh=mesh)

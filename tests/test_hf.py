@@ -664,3 +664,78 @@ class TestRoberta:
         got = np.asarray(eng.forward(ids))
         assert got.shape == (2, 4)
         np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+class TestSlidingWindow:
+    """Windowed attention (mistral sliding_window; gpt-neo local layers) —
+    previously rejected, now exact."""
+
+    def test_mistral_sliding_window_logits_match(self, tmp_models, rng):
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=1e4,
+            sliding_window=5, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(27)
+        model = transformers.MistralForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "mistral_swa")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        assert config_from_hf(path).sliding_window == 5
+        _check(path, model, rng, 128)
+
+    def test_qwen2_max_window_layers_logits_match(self, tmp_models, rng):
+        """qwen2 gates SWA per layer: layers < max_window_layers keep full
+        attention (modeling_qwen2 layer_idx check)."""
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=1e4,
+            sliding_window=5, use_sliding_window=True, max_window_layers=1,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(29)
+        model = transformers.Qwen2ForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "qwen2_swa")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        c = config_from_hf(path)
+        assert c.sliding_window == 5 and c.local_attn_layers == (1,)
+        _check(path, model, rng, 128)
+
+    def test_gptneo_logits_match(self, tmp_models, rng):
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=4,
+            max_position_embeddings=64, tie_word_embeddings=True)
+        torch.manual_seed(28)
+        model = transformers.GPTNeoForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "gptneo")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        c = config_from_hf(path)
+        assert c.attn_scale == 1.0 and c.local_attn_layers == (1,)
+        assert c.sliding_window == 4
+        _check(path, model, rng, 128)
+
+    def test_windowed_v2_serving(self, tmp_models, rng):
+        """Sliding window through ragged prefill + paged decode fallback."""
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=1e4,
+            sliding_window=5, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(27)
+        model = transformers.MistralForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "mistral_swa")
+        prompt = rng.integers(0, 128, (1, 9)).astype(np.int32)
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                do_sample=False).numpy()[0, 9:]
+        eng = InferenceEngineV2(
+            path, {"dtype": "fp32",
+                   "state_manager": {"max_tracked_sequences": 2,
+                                     "kv_block_size": 8},
+                   "generation": {"do_sample": False}})
+        got = eng.generate([prompt[0]], max_new_tokens=6)[0]
+        np.testing.assert_array_equal(got, want)
