@@ -264,15 +264,20 @@ class InferenceEngineV2:
         return logits
 
     def _run(self, rb: RaggedBatch) -> "jax.Array":
-        # exactly TWO compiled programs: a decode-only step (Q=1, full-pool
-        # ownership-mask attention — the steady-state hot path, see
-        # ragged_decode_forward) and the mixed prefill step (Q=max_q_per_seq,
-        # per-slot page gathers); finer shape bucketing trades too much
-        # recompilation for the saved FLOPs
+        # small set of compiled programs: a decode-only step (Q=1, Pallas
+        # paged attention — the steady-state hot path, ragged_decode_forward)
+        # plus one mixed prefill step per power-of-two BLOCK-TABLE-WIDTH
+        # bucket: prefill attention cost scales with the LONGEST sequence in
+        # this step, not the pool-wide per-sequence allocation (reference
+        # atom_builder sizes attention atoms by actual kv length the same
+        # way).  Buckets: ≤ log2(MB) programs.
         sm = self.config.state_manager
         if int(rb.q_len.max()) <= 1:
             return self._run_decode(rb)
-        key = ("mixed", sm.max_q_per_seq)
+        mb_full = rb.block_table.shape[1]
+        mb_used = max(1, -(-int(rb.kv_len.max()) // self._block_size))
+        mb = min(1 << (mb_used - 1).bit_length(), mb_full)
+        key = ("mixed", sm.max_q_per_seq, mb)
         if key not in self._steps:
             self._steps[key] = jax.jit(
                 functools.partial(ragged_forward, cfg=self.model_config,
@@ -283,7 +288,7 @@ class InferenceEngineV2:
         batch = {"tokens": rb.tokens, "token_slot": rb.token_slot,
                  "token_pos": rb.token_pos,
                  "token_dense_idx": rb.token_dense_idx,
-                 "block_table": rb.block_table, "kv_len": rb.kv_len}
+                 "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len}
         batch = jax.tree_util.tree_map(jnp.asarray, batch)
         logits, self.cache = self._steps[key](self.params, self.cache, batch)
         return logits

@@ -273,3 +273,28 @@ class TestTensorParallel:
             *a, interpret=True, mesh=mesh))(q, k, v, bt, lens)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5)
+
+
+class TestPrefillBuckets:
+    def test_chunked_prefill_crosses_buckets_token_exact(self, cfg, v2cfg):
+        """A prompt long enough that successive SplitFuse chunks land in
+        different power-of-two block-table buckets must still match the
+        cache-free forward exactly (the bucket slice only removes NEVER-USED
+        pages)."""
+        eng = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, 97, size=(50,)).astype(np.int32)  # 7 blocks
+        uid = 11
+        # feed in max_q_per_seq chunks like generate() does
+        pos = 0
+        while pos < len(prompt):
+            chunk = prompt[pos:pos + 16]
+            logits = eng.put([uid], [chunk])
+            pos += len(chunk)
+        # put() returns rows uid-ordered (one uid here → row 0)
+        want = full_logits(cfg, eng, prompt[None])[0, -1]
+        np.testing.assert_allclose(np.asarray(logits)[0], want,
+                                   atol=2e-4, rtol=2e-4)
+        # multiple prefill programs were compiled (different mb buckets)
+        mixed_keys = [k for k in eng._steps if k[0] == "mixed"]
+        assert len(mixed_keys) >= 2, mixed_keys
