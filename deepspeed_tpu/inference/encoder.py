@@ -35,12 +35,19 @@ class EncoderInferenceEngine:
 
         from deepspeed_tpu.models.bert import (BertEncoder, BertForMaskedLM,
                                                BertForSequenceClassification)
+        from deepspeed_tpu.parallel import mesh as mesh_lib
 
-        if mesh is not None:
-            raise ValueError(
-                "EncoderInferenceEngine has no sharded serving path yet — "
-                "refusing a mesh rather than silently serving replicated")
         config = dict(config or {})
+        # same normalization as the decoder engine (tensor_parallel: N
+        # shorthand, "tp" alias — inference/config.py:75)
+        from deepspeed_tpu.inference.config import parse_inference_config
+        known = parse_inference_config(
+            {k: v for k, v in config.items()
+             if k in ("dtype", "tensor_parallel", "tp")})
+        if mesh is None:
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(
+                tp=known.tensor_parallel.tp_size, dp=1, fsdp=1))
+        self.mesh = mesh
         dtype = _DTYPES.get(str(config.get("dtype", "fp32")).lower())
         if dtype is None:
             raise ValueError(f"unknown dtype {config.get('dtype')!r}")
@@ -57,7 +64,22 @@ class EncoderInferenceEngine:
             # subtree itself
             self._module = BertEncoder(self.model_config)
             params = params.get("encoder", params)
-        self.params = jax.device_put({"params": params})
+
+        # TP sharding from the modules' logical axes (same AutoTP-analog
+        # path as the decoder engine, inference/engine.py:86)
+        from deepspeed_tpu.parallel import partition
+        from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
+        dummy = jnp.zeros((1, min(8, self.model_config.max_seq_len)),
+                          jnp.int32)
+        boxed = jax.eval_shape(
+            lambda r: self._module.init(r, dummy), jax.random.PRNGKey(0))
+        shardings = partition.param_shardings(
+            annotate_abstract(boxed["params"]), mesh, zero_stage=0)
+        params = unbox(params)
+        with mesh:
+            self.params = {"params": jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(jnp.asarray(p), s),
+                params, shardings)}
 
         headless = not (self.has_mlm_head or self.has_cls_head)
 
@@ -73,7 +95,8 @@ class EncoderInferenceEngine:
         head = ("mlm" if self.has_mlm_head
                 else "classifier" if self.has_cls_head else "none")
         log_dist(f"encoder inference engine ready: params={n/1e6:.1f}M "
-                 f"head={head} dtype={dtype.__name__}", ranks=[0])
+                 f"head={head} tp={mesh.shape['tp']} "
+                 f"dtype={dtype.__name__}", ranks=[0])
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
@@ -93,6 +116,7 @@ class EncoderInferenceEngine:
                  else jnp.asarray(np.asarray(token_type_ids), jnp.int32))
         mask = (jnp.ones_like(ids) if attention_mask is None
                 else jnp.asarray(np.asarray(attention_mask), jnp.int32))
-        return self._fwd(self.params, ids, types, mask)
+        with self.mesh:
+            return self._fwd(self.params, ids, types, mask)
 
     __call__ = forward

@@ -739,3 +739,28 @@ class TestSlidingWindow:
                    "generation": {"do_sample": False}})
         got = eng.generate([prompt[0]], max_new_tokens=6)[0]
         np.testing.assert_array_equal(got, want)
+
+
+class TestEncoderTP:
+    def test_bert_tp2_matches_tp1(self, tmp_models, rng):
+        """tp=2 encoder serving == tp=1 (heads/mlp split over the tp axis
+        like the decoder engine's AutoTP analog)."""
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64)
+        torch.manual_seed(30)
+        model = transformers.BertForMaskedLM(cfg).eval()
+        path = _save(tmp_models, model, "bert_tp")
+        ids = rng.integers(0, 128, (2, 10)).astype(np.int32)
+        eng1 = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        got1 = np.asarray(eng1.forward(ids))
+        # int shorthand, like the decoder engine accepts
+        eng2 = deepspeed_tpu.init_inference(
+            path, config={"dtype": "fp32", "tensor_parallel": 2})
+        assert eng2.mesh.shape["tp"] == 2
+        got2 = np.asarray(eng2.forward(ids))
+        np.testing.assert_allclose(got2, got1, atol=2e-4, rtol=2e-4)
+        with torch.no_grad():
+            want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(got2, want, atol=2e-3, rtol=1e-3)
