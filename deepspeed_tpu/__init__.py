@@ -131,9 +131,18 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
         if params is not None:
             raise ValueError("pass either an HF model dir or params, not both")
         import os as _os
-        from deepspeed_tpu.checkpoint.hf import (_BERT_LIKE, _arch_of,
-                                                 _read_json, load_hf_bert)
+        from deepspeed_tpu.checkpoint.hf import (_BERT_LIKE, _CLIP_LIKE,
+                                                 _arch_of, _read_json,
+                                                 load_hf_bert,
+                                                 load_hf_clip_text)
         arch = _arch_of(_read_json(_os.path.join(model, "config.json")))
+        if arch in _CLIP_LIKE:
+            # clip text tower (reference module_inject/containers/clip.py)
+            from deepspeed_tpu.inference.encoder import ClipTextEngine
+            ccfg, ctree, extras = load_hf_clip_text(model)
+            return ClipTextEngine(ccfg, ctree, extras,
+                                  config=dict(as_dict(config), **kwargs),
+                                  mesh=mesh)
         if arch in _BERT_LIKE:
             # encoder family: single-shot forward engine (reference bert
             # injection policies, module_inject/containers/bert.py)

@@ -49,7 +49,8 @@ SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
 # "gelu_new"/"gelu_pytorch_tanh" are the tanh approximation)
 _HF_ACT = {"gelu": "gelu_exact", "gelu_new": "gelu",
-           "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+           "gelu_pytorch_tanh": "gelu", "relu": "relu",
+           "quick_gelu": "quick_gelu"}
 
 
 def _map_activation(arch: str, name: str) -> str:
@@ -906,6 +907,7 @@ def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
 
 _DISTILBERT_LIKE = {"DistilBertForMaskedLM", "DistilBertModel",
                     "DistilBertForSequenceClassification"}
+_CLIP_LIKE = {"CLIPTextModel", "CLIPTextModelWithProjection", "CLIPModel"}
 _ROBERTA_LIKE = {"RobertaForMaskedLM", "RobertaModel",
                  "RobertaForSequenceClassification",
                  "XLMRobertaForMaskedLM", "XLMRobertaModel",
@@ -972,6 +974,86 @@ def _distilbert_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
             "cls_b": r.get("classifier.bias"),
         })
     return tree
+
+
+def load_hf_clip_text(model_path: str, *, dtype=None):
+    """CLIP text encoder → (GPTConfig, tree, extras) (reference
+    module_inject/containers/clip.py — the text-encoder leg of the stable-
+    diffusion serving stack).
+
+    CLIP's text tower IS a pre-LN causal transformer with learned positions,
+    quick-gelu MLPs and biases everywhere — exactly the GPT backbone — so the
+    weights stream into the same tree and the TPU attention paths serve it
+    unchanged.  extras: {"text_projection": [H, P] or None, "eos_token_id"}.
+    """
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    full = _read_json(os.path.join(model_path, "config.json"))
+    tc = full.get("text_config", full)      # CLIPModel nests the text config
+    hidden = tc["hidden_size"]
+    heads = tc["num_attention_heads"]
+    cfg = GPTConfig(
+        vocab_size=tc["vocab_size"],
+        num_layers=tc["num_hidden_layers"],
+        num_heads=heads,
+        head_dim=hidden // heads,
+        hidden_size=hidden,
+        mlp_dim_override=tc["intermediate_size"],
+        max_seq_len=tc.get("max_position_embeddings", 77),
+        use_rope=False, use_rmsnorm=False, gated_mlp=False,
+        activation=_map_activation("CLIPText", tc.get("hidden_act",
+                                                      "quick_gelu")),
+        norm_eps=float(tc.get("layer_norm_eps", 1e-5)),
+        qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+        tie_embeddings=True,
+        dtype=dtype or jnp.float32,
+    )
+    r = _ShardReader(model_path)
+
+    def g(name):
+        return r.get("text_model." + name
+                     if r.has("text_model." + name) else name)
+
+    H, nh, hd = hidden, heads, cfg.head_dim
+    bb: Dict[str, Any] = {
+        "wte": g("embeddings.token_embedding.weight"),
+        "wpe": g("embeddings.position_embedding.weight"),
+        "final_norm": {"scale": g("final_layer_norm.weight"),
+                       "bias": g("final_layer_norm.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"encoder.layers.{i}."
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": g(p + "self_attn.q_proj.weight").T.reshape(H, nh, hd),
+                "bq": g(p + "self_attn.q_proj.bias").reshape(nh, hd),
+                "wk": g(p + "self_attn.k_proj.weight").T.reshape(H, nh, hd),
+                "bk": g(p + "self_attn.k_proj.bias").reshape(nh, hd),
+                "wv": g(p + "self_attn.v_proj.weight").T.reshape(H, nh, hd),
+                "bv": g(p + "self_attn.v_proj.bias").reshape(nh, hd),
+                "wo": g(p + "self_attn.out_proj.weight").T.reshape(nh, hd,
+                                                                   H),
+                "bo": g(p + "self_attn.out_proj.bias"),
+            },
+            "Norm_0": {"scale": g(p + "layer_norm1.weight"),
+                       "bias": g(p + "layer_norm1.bias")},
+            "Norm_1": {"scale": g(p + "layer_norm2.weight"),
+                       "bias": g(p + "layer_norm2.bias")},
+            "MLP_0": {
+                "wi": g(p + "mlp.fc1.weight").T,
+                "bi": g(p + "mlp.fc1.bias"),
+                "wo": g(p + "mlp.fc2.weight").T,
+                "bo": g(p + "mlp.fc2.bias"),
+            },
+        }
+    extras = {
+        "text_projection": (r.get("text_projection.weight").T
+                            if r.has("text_projection.weight") else None),
+        "eos_token_id": int(tc.get("eos_token_id", 49407)),
+    }
+    log_dist(f"loaded HF CLIP text checkpoint {model_path} "
+             f"({cfg.num_layers}L/{H}H)", ranks=[0])
+    return cfg, {"backbone": bb}, extras
 
 
 def load_hf_bert(model_path: str, *, dtype=None) -> Tuple[Any,

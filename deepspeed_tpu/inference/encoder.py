@@ -21,6 +21,50 @@ _DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
            "fp16": jnp.float16, "float16": jnp.float16}
 
 
+def _resolve_mesh_dtype(config, mesh):
+    """Shared engine setup: decoder-style config normalization
+    (tensor_parallel int shorthand / tp alias), mesh build, dtype resolve."""
+    from deepspeed_tpu.inference.config import parse_inference_config
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    config = dict(config or {})
+    known = parse_inference_config(
+        {k: v for k, v in config.items()
+         if k in ("dtype", "tensor_parallel", "tp")})
+    if mesh is None:
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(
+            tp=known.tensor_parallel.tp_size, dp=1, fsdp=1))
+    dtype = _DTYPES.get(str(config.get("dtype", "fp32")).lower())
+    if dtype is None:
+        raise ValueError(f"unknown dtype {config.get('dtype')!r}")
+    return config, mesh, dtype
+
+
+def _shard_module_params(module, params, mesh, max_seq_len):
+    """Device-put a loaded tree with shardings inferred from the module's
+    logical axes (the AutoTP-analog path, inference/engine.py:86)."""
+    from deepspeed_tpu.parallel import partition
+    from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
+    dummy = jnp.zeros((1, min(8, max_seq_len)), jnp.int32)
+    boxed = jax.eval_shape(lambda r: module.init(r, dummy),
+                           jax.random.PRNGKey(0))
+    shardings = partition.param_shardings(
+        annotate_abstract(boxed["params"]), mesh, zero_stage=0)
+    with mesh:
+        return {"params": jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(jnp.asarray(p), s),
+            unbox(params), shardings)}
+
+
+def _coerce_ids(input_ids, max_seq_len):
+    ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+    if ids.ndim == 1:
+        ids = ids[None]
+    if ids.shape[1] > max_seq_len:
+        raise ValueError(f"input length {ids.shape[1]} exceeds max_seq_len "
+                         f"{max_seq_len}")
+    return ids
+
+
 class EncoderInferenceEngine:
     """``forward(input_ids, token_type_ids, attention_mask) -> output``.
 
@@ -35,22 +79,9 @@ class EncoderInferenceEngine:
 
         from deepspeed_tpu.models.bert import (BertEncoder, BertForMaskedLM,
                                                BertForSequenceClassification)
-        from deepspeed_tpu.parallel import mesh as mesh_lib
 
-        config = dict(config or {})
-        # same normalization as the decoder engine (tensor_parallel: N
-        # shorthand, "tp" alias — inference/config.py:75)
-        from deepspeed_tpu.inference.config import parse_inference_config
-        known = parse_inference_config(
-            {k: v for k, v in config.items()
-             if k in ("dtype", "tensor_parallel", "tp")})
-        if mesh is None:
-            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(
-                tp=known.tensor_parallel.tp_size, dp=1, fsdp=1))
+        config, mesh, dtype = _resolve_mesh_dtype(config, mesh)
         self.mesh = mesh
-        dtype = _DTYPES.get(str(config.get("dtype", "fp32")).lower())
-        if dtype is None:
-            raise ValueError(f"unknown dtype {config.get('dtype')!r}")
         self.model_config = dataclasses.replace(model_cfg, dtype=dtype)
         self.has_mlm_head = "transform_w" in params
         self.has_cls_head = "cls_w" in params
@@ -65,21 +96,8 @@ class EncoderInferenceEngine:
             self._module = BertEncoder(self.model_config)
             params = params.get("encoder", params)
 
-        # TP sharding from the modules' logical axes (same AutoTP-analog
-        # path as the decoder engine, inference/engine.py:86)
-        from deepspeed_tpu.parallel import partition
-        from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
-        dummy = jnp.zeros((1, min(8, self.model_config.max_seq_len)),
-                          jnp.int32)
-        boxed = jax.eval_shape(
-            lambda r: self._module.init(r, dummy), jax.random.PRNGKey(0))
-        shardings = partition.param_shardings(
-            annotate_abstract(boxed["params"]), mesh, zero_stage=0)
-        params = unbox(params)
-        with mesh:
-            self.params = {"params": jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(jnp.asarray(p), s),
-                params, shardings)}
+        self.params = _shard_module_params(self._module, params, mesh,
+                                           self.model_config.max_seq_len)
 
         headless = not (self.has_mlm_head or self.has_cls_head)
 
@@ -99,13 +117,7 @@ class EncoderInferenceEngine:
                  f"dtype={dtype.__name__}", ranks=[0])
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
-        if ids.ndim == 1:
-            ids = ids[None]
-        if ids.shape[1] > self.model_config.max_seq_len:
-            raise ValueError(
-                f"input length {ids.shape[1]} exceeds max_seq_len "
-                f"{self.model_config.max_seq_len}")
+        ids = _coerce_ids(input_ids, self.model_config.max_seq_len)
         if (token_type_ids is not None
                 and not self.model_config.type_vocab_size):
             raise ValueError(
@@ -118,5 +130,63 @@ class EncoderInferenceEngine:
                 else jnp.asarray(np.asarray(attention_mask), jnp.int32))
         with self.mesh:
             return self._fwd(self.params, ids, types, mask)
+
+    __call__ = forward
+
+
+class ClipTextEngine:
+    """CLIP text-tower serving (reference module_inject/containers/clip.py —
+    the text leg of the stable-diffusion stack): jitted causal encoder
+    forward over the GPT backbone, returning (last_hidden_state,
+    text_embeds-or-pooled)."""
+
+    def __init__(self, model_cfg, tree, extras, config=None, mesh=None):
+        import dataclasses
+
+        from deepspeed_tpu.models.gpt import GPTBackbone
+
+        config, mesh, dtype = _resolve_mesh_dtype(config, mesh)
+        self.mesh = mesh
+        self.model_config = dataclasses.replace(model_cfg, dtype=dtype)
+        self.eos_token_id = int(extras["eos_token_id"])
+        proj = extras.get("text_projection")
+        self._module = GPTBackbone(self.model_config, mesh)
+        self.params = _shard_module_params(self._module, tree["backbone"],
+                                           mesh,
+                                           self.model_config.max_seq_len)
+        with mesh:
+            self._proj = (jax.device_put(jnp.asarray(proj))
+                          if proj is not None else None)
+
+        eos = self.eos_token_id
+        projection = self._proj
+
+        def fwd(p, pr, ids):
+            hidden, _, _ = self._module.apply(p, ids, True)
+            hidden = hidden.astype(jnp.float32)
+            # HF CLIPTextModel pooling: eos_token_id==2 takes the LEGACY
+            # argmax-of-token-ids position (openai checkpoints assume the eot
+            # token has the highest id); otherwise the first eos position
+            if eos == 2:
+                pool_idx = jnp.argmax(ids, axis=-1)
+            else:
+                pool_idx = jnp.argmax((ids == eos).astype(jnp.int32),
+                                      axis=-1)
+            pooled = hidden[jnp.arange(ids.shape[0]), pool_idx]
+            if pr is not None:
+                pooled = pooled @ pr.astype(jnp.float32)   # text_embeds
+            return hidden, pooled
+
+        self._fwd = jax.jit(fwd)
+        n = sum(int(np.prod(x.shape))
+                for x in jax.tree_util.tree_leaves(self.params))
+        log_dist(f"clip text engine ready: params={n/1e6:.1f}M "
+                 f"proj={projection is not None} tp={mesh.shape['tp']} "
+                 f"dtype={dtype.__name__}", ranks=[0])
+
+    def forward(self, input_ids):
+        ids = _coerce_ids(input_ids, self.model_config.max_seq_len)
+        with self.mesh:
+            return self._fwd(self.params, self._proj, ids)
 
     __call__ = forward

@@ -251,10 +251,12 @@ def mlp_activation(name: str):
     try:
         return {"gelu": nn.gelu,
                 "gelu_exact": lambda x: nn.gelu(x, approximate=False),
-                "relu": nn.relu}[name]
+                "relu": nn.relu,
+                # clip text encoder: x·sigmoid(1.702x)
+                "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x)}[name]
     except KeyError:
         raise ValueError(f"unknown MLP activation {name!r}; expected "
-                         "gelu|gelu_exact|relu") from None
+                         "gelu|gelu_exact|relu|quick_gelu") from None
 
 
 class Norm(nn.Module):

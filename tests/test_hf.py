@@ -764,3 +764,50 @@ class TestEncoderTP:
         with torch.no_grad():
             want = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
         np.testing.assert_allclose(got2, want, atol=2e-3, rtol=1e-3)
+
+
+class TestClipText:
+    """CLIP text tower (reference module_inject/containers/clip.py):
+    last-hidden-state and text_embeds parity vs transformers."""
+
+    def _cfg(self, eos=2):
+        return transformers.CLIPTextConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=32, eos_token_id=eos, bos_token_id=1)
+
+    def test_clip_text_with_projection(self, tmp_models, rng):
+        """eos_token_id=2 → HF's LEGACY argmax-of-ids pooling path."""
+        torch.manual_seed(31)
+        model = transformers.CLIPTextModelWithProjection(self._cfg()).eval()
+        path = _save(tmp_models, model, "clip_text_proj")
+        ids = rng.integers(3, 128, (2, 10)).astype(np.int32)
+        ids[:, -1] = 2                      # eos terminates each prompt
+        with torch.no_grad():
+            out = model(torch.tensor(ids, dtype=torch.long))
+            want_h = out.last_hidden_state.numpy()
+            want_e = out.text_embeds.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        hidden, embeds = eng.forward(ids)
+        np.testing.assert_allclose(np.asarray(hidden), want_h, atol=2e-3,
+                                   rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(embeds), want_e, atol=2e-3,
+                                   rtol=1e-3)
+
+    def test_clip_text_plain_pooled(self, tmp_models, rng):
+        """non-legacy eos (≠2) → pool at the FIRST eos position."""
+        torch.manual_seed(32)
+        model = transformers.CLIPTextModel(self._cfg(eos=100)).eval()
+        path = _save(tmp_models, model, "clip_text")
+        ids = rng.integers(3, 100, (2, 10)).astype(np.int32)
+        ids[:, 6] = 100                     # eos mid-sequence: pool there
+        with torch.no_grad():
+            out = model(torch.tensor(ids, dtype=torch.long))
+            want_h = out.last_hidden_state.numpy()
+            want_p = out.pooler_output.numpy()
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        hidden, pooled = eng.forward(ids)
+        np.testing.assert_allclose(np.asarray(hidden), want_h, atol=2e-3,
+                                   rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(pooled), want_p, atol=2e-3,
+                                   rtol=1e-3)
