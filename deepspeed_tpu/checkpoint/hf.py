@@ -40,10 +40,12 @@ _FALCON_LIKE = {"FalconForCausalLM"}
 _GPTJ_LIKE = {"GPTJForCausalLM"}
 _NEOX_LIKE = {"GPTNeoXForCausalLM"}
 _GPTNEO_LIKE = {"GPTNeoForCausalLM"}
+_STABLELM_LIKE = {"StableLmForCausalLM"}
 _BLOOM_LIKE = {"BloomForCausalLM"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
                                  | _PHI_LIKE | _FALCON_LIKE | _GPTJ_LIKE
-                                 | _NEOX_LIKE | _BLOOM_LIKE | _GPTNEO_LIKE)
+                                 | _NEOX_LIKE | _BLOOM_LIKE | _GPTNEO_LIKE
+                                 | _STABLELM_LIKE)
 
 
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
@@ -376,6 +378,40 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             attn_out_bias=True, mlp_bias=True,
             dtype=dtype or jnp.bfloat16,
         )
+    if arch in _STABLELM_LIKE:
+        # stablelm-2/zephyr: llama weight layout with LayerNorm (scale+bias)
+        # and partial rotary; SwiGLU MLP
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
+        if hf.get("use_parallel_residual"):
+            raise ValueError(f"{arch}: use_parallel_residual=true "
+                             "(stablelm-alpha) is not implemented")
+        if hf.get("qk_layernorm"):
+            raise ValueError(f"{arch}: qk_layernorm=true is not implemented")
+        if hf.get("hidden_act", "silu") != "silu":
+            raise ValueError(
+                f"{arch}: hidden_act={hf['hidden_act']!r} is not implemented "
+                "(the gated MLP gate is silu); logits would be silently "
+                "wrong")
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        msl = hf.get("max_position_embeddings", 4096)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf["intermediate_size"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=False, gated_mlp=True,
+            rope_pct=float(hf.get("partial_rotary_factor", 0.25)),
+            num_kv_heads=hf.get("num_key_value_heads", heads),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            qkv_bias=bool(hf.get("use_qkv_bias", False)),
+            dtype=dtype or jnp.bfloat16,
+        )
     if arch in _BLOOM_LIKE:
         # reference module_inject/containers/bloom.py: alibi positions (no
         # table), embedding LayerNorm, fused per-head qkv, tied embeddings
@@ -459,8 +495,15 @@ def _llama_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
         w = r.get(name)          # torch Linear: [out, in]
         return w.T               # → [in, out]
 
+    def norm(name):
+        # rmsnorm = scale only; stablelm-style LayerNorm adds a bias
+        out = {"scale": r.get(name + ".weight")}
+        if not cfg.use_rmsnorm:
+            out["bias"] = r.get(name + ".bias")
+        return out
+
     bb: Dict[str, Any] = {"wte": r.get("model.embed_tokens.weight"),
-                          "final_norm": {"scale": r.get("model.norm.weight")}}
+                          "final_norm": norm("model.norm")}
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
         att = {
@@ -477,8 +520,8 @@ def _llama_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
             att["bo"] = r.get(p + "self_attn.o_proj.bias")
         blk = {
             "Attention_0": att,
-            "Norm_0": {"scale": r.get(p + "input_layernorm.weight")},
-            "Norm_1": {"scale": r.get(p + "post_attention_layernorm.weight")},
+            "Norm_0": norm(p + "input_layernorm"),
+            "Norm_1": norm(p + "post_attention_layernorm"),
         }
         if cfg.num_experts and i % cfg.moe_every == cfg.moe_every - 1:
             # Mixtral MoE block (modeling_mixtral.py MixtralSparseMoeBlock):
@@ -989,7 +1032,9 @@ def load_hf_clip_text(model_path: str, *, dtype=None):
     from deepspeed_tpu.models.gpt import GPTConfig
 
     full = _read_json(os.path.join(model_path, "config.json"))
-    tc = full.get("text_config", full)      # CLIPModel nests the text config
+    # CLIPModel nests the text config ("text_config_dict" on legacy openai
+    # hub checkpoints, CLIPConfig back-compat)
+    tc = full.get("text_config") or full.get("text_config_dict") or full
     hidden = tc["hidden_size"]
     heads = tc["num_attention_heads"]
     cfg = GPTConfig(

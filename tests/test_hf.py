@@ -811,3 +811,38 @@ class TestClipText:
                                    rtol=1e-3)
         np.testing.assert_allclose(np.asarray(pooled), want_p, atol=2e-3,
                                    rtol=1e-3)
+
+
+class TestStableLM:
+    def test_stablelm_logits_match(self, tmp_models, rng):
+        """StableLM-2 lineage: llama weight layout + LayerNorm(+bias) +
+        partial rotary + SwiGLU."""
+        cfg = transformers.StableLmConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, partial_rotary_factor=0.25,
+            max_position_embeddings=64, tie_word_embeddings=False)
+        torch.manual_seed(33)
+        model = transformers.StableLmForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "stablelm")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        c = config_from_hf(path)
+        assert not c.use_rmsnorm and c.gated_mlp and c.rope_pct == 0.25
+        _check(path, model, rng, 128)
+
+    def test_stablelm_qkv_bias_variant(self, tmp_models, rng):
+        cfg = transformers.StableLmConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, partial_rotary_factor=0.5,
+            use_qkv_bias=True, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        torch.manual_seed(34)
+        model = transformers.StableLmForCausalLM(cfg).eval()
+        with torch.no_grad():
+            for layer in model.model.layers:
+                for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                             layer.self_attn.v_proj):
+                    proj.bias.normal_(0, 0.02)
+        path = _save(tmp_models, model, "stablelm_bias")
+        _check(path, model, rng, 128)
