@@ -41,11 +41,12 @@ _GPTJ_LIKE = {"GPTJForCausalLM"}
 _NEOX_LIKE = {"GPTNeoXForCausalLM"}
 _GPTNEO_LIKE = {"GPTNeoForCausalLM"}
 _STABLELM_LIKE = {"StableLmForCausalLM"}
+_BIGCODE_LIKE = {"GPTBigCodeForCausalLM"}
 _BLOOM_LIKE = {"BloomForCausalLM"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
                                  | _PHI_LIKE | _FALCON_LIKE | _GPTJ_LIKE
                                  | _NEOX_LIKE | _BLOOM_LIKE | _GPTNEO_LIKE
-                                 | _STABLELM_LIKE)
+                                 | _STABLELM_LIKE | _BIGCODE_LIKE)
 
 
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
@@ -410,6 +411,30 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=bool(hf.get("use_qkv_bias", False)),
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _BIGCODE_LIKE:
+        # starcoder/santacoder (reference v1 injection served these as
+        # gpt2-family): gpt2 layout with torch-Linear weights, MQA fused
+        # q|k|v rows, tanh-gelu
+        hidden = hf["n_embd"]
+        heads = hf["n_head"]
+        msl = hf.get("n_positions", 2048)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["n_layer"],
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf.get("n_inner") or 4 * hidden,
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=False, use_rmsnorm=False, gated_mlp=False,
+            activation=_map_activation(arch, hf.get("activation_function",
+                                                    "gelu_pytorch_tanh")),
+            num_kv_heads=1 if hf.get("multi_query", True) else heads,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=True, attn_out_bias=True, mlp_bias=True,
             dtype=dtype or jnp.bfloat16,
         )
     if arch in _BLOOM_LIKE:
@@ -900,6 +925,62 @@ def _gptneo_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return tree
 
 
+def _bigcode_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """GPT-BigCode (starcoder) → flax tree: fused c_attn rows are
+    q[H] | k[nkv·hd] | v[nkv·hd] (MQA: nkv=1)."""
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+
+    def g(name):
+        return r.get(name if r.has(name) else name[len("transformer."):])
+
+    bb: Dict[str, Any] = {
+        "wte": g("transformer.wte.weight"),
+        "wpe": g("transformer.wpe.weight")[:cfg.max_seq_len],
+        "final_norm": {"scale": g("transformer.ln_f.weight"),
+                       "bias": g("transformer.ln_f.bias")},
+    }
+    kvw = nkv * hd
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        w = g(p + "attn.c_attn.weight").T          # [H, H + 2·nkv·hd]
+        b = g(p + "attn.c_attn.bias")
+        if nkv == nh:
+            # MHA variant interleaves q|k|v WITHIN each head ([nh, 3, hd])
+            w4 = w.reshape(H, nh, 3, hd)
+            b3 = b.reshape(nh, 3, hd)
+            att = {"wq": w4[:, :, 0], "wk": w4[:, :, 1], "wv": w4[:, :, 2],
+                   "bq": b3[:, 0], "bk": b3[:, 1], "bv": b3[:, 2]}
+        else:
+            # MQA: flat q rows then one k stripe and one v stripe
+            att = {"wq": w[:, :H].reshape(H, nh, hd),
+                   "wk": w[:, H:H + kvw].reshape(H, nkv, hd),
+                   "wv": w[:, H + kvw:].reshape(H, nkv, hd),
+                   "bq": b[:H].reshape(nh, hd),
+                   "bk": b[H:H + kvw].reshape(nkv, hd),
+                   "bv": b[H + kvw:].reshape(nkv, hd)}
+        att["wo"] = g(p + "attn.c_proj.weight").T.reshape(nh, hd, H)
+        att["bo"] = g(p + "attn.c_proj.bias")
+        bb[f"block_{i}"] = {
+            "Attention_0": att,
+            "Norm_0": {"scale": g(p + "ln_1.weight"),
+                       "bias": g(p + "ln_1.bias")},
+            "Norm_1": {"scale": g(p + "ln_2.weight"),
+                       "bias": g(p + "ln_2.bias")},
+            "MLP_0": {
+                "wi": g(p + "mlp.c_fc.weight").T,
+                "bi": g(p + "mlp.c_fc.bias"),
+                "wo": g(p + "mlp.c_proj.weight").T,
+                "bo": g(p + "mlp.c_proj.bias"),
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    return tree
+
+
 def _bloom_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     """BLOOM → flax tree (reference module_inject/containers/bloom.py).
     Fused qkv interleaves q/k/v WITHIN each head: [nh, 3, hd]."""
@@ -1260,6 +1341,8 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
         tree = _bloom_tree(r, cfg)
     elif arch in _GPTNEO_LIKE:
         tree = _gptneo_tree(r, cfg)
+    elif arch in _BIGCODE_LIKE:
+        tree = _bigcode_tree(r, cfg)
     else:
         tree = _llama_tree(r, cfg)
     n = sum(int(np.prod(l.shape))

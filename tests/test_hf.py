@@ -846,3 +846,26 @@ class TestStableLM:
                     proj.bias.normal_(0, 0.02)
         path = _save(tmp_models, model, "stablelm_bias")
         _check(path, model, rng, 128)
+
+
+class TestGPTBigCode:
+    def test_starcoder_mqa_logits_match(self, tmp_models, rng):
+        """starcoder lineage: MQA (one kv head) fused q|k|v rows."""
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            multi_query=True)
+        torch.manual_seed(35)
+        model = transformers.GPTBigCodeForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "bigcode_mqa")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        assert config_from_hf(path).kv_heads == 1
+        _check(path, model, rng, 128)
+
+    def test_bigcode_mha_variant(self, tmp_models, rng):
+        cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            multi_query=False)
+        torch.manual_seed(36)
+        model = transformers.GPTBigCodeForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "bigcode_mha")
+        _check(path, model, rng, 128)
