@@ -42,11 +42,13 @@ _NEOX_LIKE = {"GPTNeoXForCausalLM"}
 _GPTNEO_LIKE = {"GPTNeoForCausalLM"}
 _STABLELM_LIKE = {"StableLmForCausalLM"}
 _BIGCODE_LIKE = {"GPTBigCodeForCausalLM"}
+_GEMMA_LIKE = {"GemmaForCausalLM"}
 _BLOOM_LIKE = {"BloomForCausalLM"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
                                  | _PHI_LIKE | _FALCON_LIKE | _GPTJ_LIKE
                                  | _NEOX_LIKE | _BLOOM_LIKE | _GPTNEO_LIKE
-                                 | _STABLELM_LIKE | _BIGCODE_LIKE)
+                                 | _STABLELM_LIKE | _BIGCODE_LIKE
+                                 | _GEMMA_LIKE)
 
 
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
@@ -411,6 +413,35 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=bool(hf.get("use_qkv_bias", False)),
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _GEMMA_LIKE:
+        # gemma: llama layout with (1+w) RMSNorm scales (absorbed at load),
+        # √H-scaled embeddings (unembed unscaled), GeGLU, explicit head_dim
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        msl = hf.get("max_position_embeddings", 8192)
+        # HF IGNORES gemma's legacy hidden_act field and forces
+        # gelu_pytorch_tanh when hidden_activation is absent (GemmaMLP warns)
+        act = hf.get("hidden_activation") or "gelu_pytorch_tanh"
+        gemma_bias = bool(hf.get("attention_bias", False))
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=hf.get("head_dim") or hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf["intermediate_size"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=True, gated_mlp=True,
+            gate_act=_map_activation(arch, act),
+            embed_scale=float(hidden) ** 0.5,
+            num_kv_heads=hf.get("num_key_value_heads", heads),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+            qkv_bias=gemma_bias, attn_out_bias=gemma_bias,
             dtype=dtype or jnp.bfloat16,
         )
     if arch in _BIGCODE_LIKE:
@@ -925,6 +956,25 @@ def _gptneo_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return tree
 
 
+def _gemma_absorb_norm_offset(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Gemma's RMSNorm multiplies by (1 + weight) in fp32
+    (modeling_gemma GemmaRMSNorm) — absorb the +1 into the stored scales
+    (fp32 so the offset is exact) and the stock rms_norm serves it."""
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k.startswith(("Norm_", "final_norm")) and "scale" in v:
+                    out[k] = dict(v, scale=np.asarray(v["scale"],
+                                                      np.float32) + 1.0)
+                else:
+                    out[k] = walk(v)
+            return out
+        return node
+
+    return walk(tree)
+
+
 def _bigcode_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     """GPT-BigCode (starcoder) → flax tree: fused c_attn rows are
     q[H] | k[nkv·hd] | v[nkv·hd] (MQA: nkv=1)."""
@@ -1343,6 +1393,8 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
         tree = _gptneo_tree(r, cfg)
     elif arch in _BIGCODE_LIKE:
         tree = _bigcode_tree(r, cfg)
+    elif arch in _GEMMA_LIKE:
+        tree = _gemma_absorb_norm_offset(_llama_tree(r, cfg))
     else:
         tree = _llama_tree(r, cfg)
     n = sum(int(np.prod(l.shape))
@@ -1373,6 +1425,12 @@ def save_hf_checkpoint(cfg, params, model_path: str) -> None:
     import torch
     from safetensors.torch import save_file
 
+    if getattr(cfg, "embed_scale", None) or \
+            getattr(cfg, "gate_act", "silu") != "silu":
+        raise ValueError(
+            "export supports llama/gpt2 semantics only: embed_scale/GeGLU "
+            "(gemma) configs would silently export a DIFFERENT model under "
+            "a llama architecture tag")
     params = dict(params)
     if "params" in params:
         params = params["params"]

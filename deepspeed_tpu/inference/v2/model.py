@@ -59,7 +59,7 @@ def _mlp(p, x, cfg):
     if cfg.mlp_bias:
         h = h + p["bi"].astype(x.dtype)
     if cfg.gated_mlp:
-        h = jax.nn.silu(x @ p["wg"].astype(x.dtype)) * h
+        h = mlp_activation(cfg.gate_act)(x @ p["wg"].astype(x.dtype)) * h
     else:
         h = mlp_activation(cfg.activation)(h)
     y = h @ p["wo"].astype(x.dtype)
@@ -143,6 +143,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
     # ---- embed (reference ragged_ops/embed) ----
     x = bb["wte"].astype(dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.embed_scale, dtype)
     if cfg.embed_norm:
         x = _norm(bb["embed_norm"], x, cfg)
     if not cfg.use_rope and not cfg.use_alibi:
@@ -260,6 +262,8 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     g = nh // nkv
 
     x = bb["wte"].astype(dtype)[tokens]                       # [S, H]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.embed_scale, dtype)
     if cfg.embed_norm:
         x = _norm(bb["embed_norm"], x, cfg)
     if not cfg.use_rope and not cfg.use_alibi:

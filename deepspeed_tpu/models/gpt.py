@@ -84,6 +84,10 @@ class GPTConfig:
     unembed_bias: bool = False          # lm_head bias (phi)
     use_alibi: bool = False             # alibi attention bias, no positional
     #                                     table (bloom/falcon-rw)
+    gate_act: str = "silu"              # gated-MLP gate: silu (SwiGLU) or
+    #                                     gelu (gemma GeGLU)
+    embed_scale: Optional[float] = None  # gemma: x·√H after the embedding
+    #                                      gather (unembed stays unscaled)
     sliding_window: Optional[int] = None  # each token sees the last W keys
     #                                       (mistral; gpt-neo local layers)
     local_attn_layers: tuple = ()       # layers the window applies to; empty
@@ -252,11 +256,12 @@ def mlp_activation(name: str):
         return {"gelu": nn.gelu,
                 "gelu_exact": lambda x: nn.gelu(x, approximate=False),
                 "relu": nn.relu,
+                "silu": nn.silu,
                 # clip text encoder: x·sigmoid(1.702x)
                 "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x)}[name]
     except KeyError:
         raise ValueError(f"unknown MLP activation {name!r}; expected "
-                         "gelu|gelu_exact|relu|quick_gelu") from None
+                         "gelu|gelu_exact|relu|silu|quick_gelu") from None
 
 
 class Norm(nn.Module):
@@ -456,7 +461,7 @@ class MLP(nn.Module):
         if c.gated_mlp:
             wg = self.param("wg", _part(_kernel_init(), ("embed", "mlp")),
                             (H, M), c.param_dtype)
-            h = nn.silu(x @ wg.astype(x.dtype)) * h
+            h = mlp_activation(c.gate_act)(x @ wg.astype(x.dtype)) * h
         else:
             h = mlp_activation(c.activation)(h)
         if c.dropout > 0 and not deterministic:
@@ -568,6 +573,8 @@ class GPTBackbone(nn.Module):
         emb = self.param("wte", _part(_kernel_init(), ("vocab", "embed")),
                          (c.vocab_size, c.hidden_size), c.param_dtype)
         x = _gather_table(emb.astype(c.dtype), self.mesh)[input_ids]
+        if c.embed_scale:    # gemma √H normalizer (unembed stays unscaled)
+            x = x * jnp.asarray(c.embed_scale, c.dtype)
         x = _pin_activations(x, self.mesh, c.sequence_parallel)
         if c.embed_norm:     # bloom word_embeddings_layernorm
             x = Norm(c, name="embed_norm")(x)

@@ -869,3 +869,41 @@ class TestGPTBigCode:
         model = transformers.GPTBigCodeForCausalLM(cfg).eval()
         path = _save(tmp_models, model, "bigcode_mha")
         _check(path, model, rng, 128)
+
+
+class TestGemma:
+    def test_gemma_logits_match(self, tmp_models, rng):
+        """Gemma: (1+w) rmsnorm absorbed at load, sqrt(H)-scaled embeddings
+        with UNSCALED tied unembed, GeGLU, explicit head_dim != H/heads."""
+        cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=32,
+            max_position_embeddings=64, rms_norm_eps=1e-6)
+        torch.manual_seed(37)
+        model = transformers.GemmaForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "gemma")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        c = config_from_hf(path)
+        assert c.gate_act == "gelu" and c.head_dim == 32
+        assert c.embed_scale == pytest.approx(8.0)
+        _check(path, model, rng, 128)
+
+    def test_gemma_generate_token_exact(self, tmp_models, rng):
+        cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=32,
+            max_position_embeddings=64)
+        torch.manual_seed(37)
+        model = transformers.GemmaForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "gemma")
+        prompt = rng.integers(3, 128, (1, 9)).astype(np.int32)
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                do_sample=False).numpy()[0, 9:]
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        got = np.asarray(eng.generate(prompt, max_new_tokens=6,
+                                      do_sample=False))[0]
+        np.testing.assert_array_equal(got, want)
