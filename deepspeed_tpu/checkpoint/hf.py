@@ -43,12 +43,13 @@ _GPTNEO_LIKE = {"GPTNeoForCausalLM"}
 _STABLELM_LIKE = {"StableLmForCausalLM"}
 _BIGCODE_LIKE = {"GPTBigCodeForCausalLM"}
 _GEMMA_LIKE = {"GemmaForCausalLM"}
+_PHI3_LIKE = {"Phi3ForCausalLM"}
 _BLOOM_LIKE = {"BloomForCausalLM"}
 SUPPORTED_ARCHITECTURES = sorted(_LLAMA_LIKE | _GPT2_LIKE | _OPT_LIKE
                                  | _PHI_LIKE | _FALCON_LIKE | _GPTJ_LIKE
                                  | _NEOX_LIKE | _BLOOM_LIKE | _GPTNEO_LIKE
                                  | _STABLELM_LIKE | _BIGCODE_LIKE
-                                 | _GEMMA_LIKE)
+                                 | _GEMMA_LIKE | _PHI3_LIKE)
 
 
 # HF ACT2FN name → models.gpt.mlp_activation name (HF "gelu" is exact erf;
@@ -413,6 +414,31 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=bool(hf.get("use_qkv_bias", False)),
+            dtype=dtype or jnp.bfloat16,
+        )
+    if arch in _PHI3_LIKE:
+        # phi-3 (reference inference/v2/model_implementations/phi3): llama
+        # semantics with FUSED qkv_proj and gate_up_proj (split in the tree
+        # builder); longrope scaling rejected by the shared guard
+        _reject_unsupported_semantics(hf, arch, max_seq_len)
+        hidden = hf["hidden_size"]
+        heads = hf["num_attention_heads"]
+        msl = hf.get("max_position_embeddings", 4096)
+        return GPTConfig(
+            vocab_size=hf["vocab_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=heads,
+            head_dim=hidden // heads,
+            hidden_size=hidden,
+            mlp_dim_override=hf["intermediate_size"],
+            max_seq_len=min(msl, max_seq_len or msl),
+            use_rope=True, use_rmsnorm=True, gated_mlp=True,
+            rope_pct=float(hf.get("partial_rotary_factor", 1.0)),
+            num_kv_heads=hf.get("num_key_value_heads", heads),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+            sliding_window=_sliding_window_of(hf, max_seq_len),
             dtype=dtype or jnp.bfloat16,
         )
     if arch in _GEMMA_LIKE:
@@ -956,6 +982,45 @@ def _gptneo_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
     return tree
 
 
+def _phi3_tree(r: _ShardReader, cfg) -> Dict[str, Any]:
+    """Phi-3 → flax tree: llama layout with fused qkv_proj
+    (q[nh·hd] | k[nkv·hd] | v[nkv·hd] rows) and gate_up_proj
+    (gate[M] | up[M] rows)."""
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+    M = cfg.mlp_dim
+    qw, kvw = nh * hd, nkv * hd
+
+    bb: Dict[str, Any] = {"wte": r.get("model.embed_tokens.weight"),
+                          "final_norm": {"scale": r.get("model.norm.weight")}}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        w = r.get(p + "self_attn.qkv_proj.weight").T   # [H, qw + 2·kvw]
+        gu = r.get(p + "mlp.gate_up_proj.weight").T    # [H, 2M]
+        bb[f"block_{i}"] = {
+            "Attention_0": {
+                "wq": w[:, :qw].reshape(H, nh, hd),
+                "wk": w[:, qw:qw + kvw].reshape(H, nkv, hd),
+                "wv": w[:, qw + kvw:].reshape(H, nkv, hd),
+                "wo": r.get(p + "self_attn.o_proj.weight").T.reshape(nh, hd,
+                                                                     H),
+            },
+            "Norm_0": {"scale": r.get(p + "input_layernorm.weight")},
+            "Norm_1": {
+                "scale": r.get(p + "post_attention_layernorm.weight")},
+            "MLP_0": {
+                "wg": gu[:, :M],
+                "wi": gu[:, M:],
+                "wo": r.get(p + "mlp.down_proj.weight").T,
+            },
+        }
+    tree: Dict[str, Any] = {"backbone": bb}
+    if not cfg.tie_embeddings:
+        tree["lm_head"] = (r.get("lm_head.weight").T
+                           if r.has("lm_head.weight") else bb["wte"].T)
+    return tree
+
+
 def _gemma_absorb_norm_offset(tree: Dict[str, Any]) -> Dict[str, Any]:
     """Gemma's RMSNorm multiplies by (1 + weight) in fp32
     (modeling_gemma GemmaRMSNorm) — absorb the +1 into the stored scales
@@ -1395,6 +1460,8 @@ def load_hf_checkpoint(model_path: str, *, max_seq_len: Optional[int] = None,
         tree = _bigcode_tree(r, cfg)
     elif arch in _GEMMA_LIKE:
         tree = _gemma_absorb_norm_offset(_llama_tree(r, cfg))
+    elif arch in _PHI3_LIKE:
+        tree = _phi3_tree(r, cfg)
     else:
         tree = _llama_tree(r, cfg)
     n = sum(int(np.prod(l.shape))

@@ -907,3 +907,53 @@ class TestGemma:
         got = np.asarray(eng.generate(prompt, max_new_tokens=6,
                                       do_sample=False))[0]
         np.testing.assert_array_equal(got, want)
+
+
+class TestPhi3:
+    def test_phi3_logits_match(self, tmp_models, rng):
+        """Phi-3: llama semantics with fused qkv_proj / gate_up_proj."""
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            pad_token_id=0, eos_token_id=1, bos_token_id=2,
+            tie_word_embeddings=False)
+        torch.manual_seed(38)
+        model = transformers.Phi3ForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "phi3")
+        _check(path, model, rng, 128)
+
+    def test_phi3_generate_token_exact(self, tmp_models, rng):
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            pad_token_id=0, eos_token_id=1, bos_token_id=2,
+            tie_word_embeddings=False)
+        torch.manual_seed(38)
+        model = transformers.Phi3ForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "phi3")
+        prompt = rng.integers(3, 128, (1, 9)).astype(np.int32)
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                do_sample=False).numpy()[0, 9:]
+        eng = deepspeed_tpu.init_inference(path, config={"dtype": "fp32"})
+        got = np.asarray(eng.generate(prompt, max_new_tokens=6,
+                                      do_sample=False))[0]
+        np.testing.assert_array_equal(got, want)
+
+    def test_phi3_partial_rotary_variant(self, tmp_models, rng):
+        """phi-4-mini-style partial_rotary_factor under the Phi3 arch."""
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            pad_token_id=0, eos_token_id=1, bos_token_id=2,
+            partial_rotary_factor=0.75, tie_word_embeddings=False)
+        torch.manual_seed(39)
+        model = transformers.Phi3ForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "phi3_partial")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        assert config_from_hf(path).rope_pct == 0.75
+        _check(path, model, rng, 128)
