@@ -82,10 +82,24 @@ def initialize(model=None,
         raise ValueError("initialize() needs example_batch or training_data "
                          "to trace model.init")
 
-    engine = DeepSpeedTPUEngine(model=model, config=cfg,
+    if cfg.zero_optimization.offload_param.device != "none":
+        # ZeRO-Infinity param offload: engine dispatch at initialize() time,
+        # as the reference dispatches PipelineEngine vs DeepSpeedEngine
+        # (deepspeed/__init__.py:166-208)
+        from deepspeed_tpu.runtime.infinity import InfinityEngine
+        if optimizer is not None:
+            raise ValueError(
+                "offload_param builds its own host Adam (the reference "
+                "likewise swaps in DeepSpeedCPUAdam); drop the client "
+                "optimizer or the offload")
+        engine = InfinityEngine(model=model, config=cfg,
                                 example_batch=example_batch, mesh=mesh,
-                                lr_scheduler=lr_scheduler,
-                                client_optimizer=optimizer)
+                                lr_scheduler=lr_scheduler)
+    else:
+        engine = DeepSpeedTPUEngine(model=model, config=cfg,
+                                    example_batch=example_batch, mesh=mesh,
+                                    lr_scheduler=lr_scheduler,
+                                    client_optimizer=optimizer)
 
     if training_data is not None:
         dataloader = DeepSpeedDataLoader(

@@ -118,6 +118,9 @@ def _flatten_params(params) -> Dict[str, Any]:
         if isinstance(node, dict):
             for k in sorted(node):
                 walk(node[k], prefix + (str(k),))
+        elif isinstance(node, (list, tuple)):   # infinity layout: layers list
+            for i, v in enumerate(node):
+                walk(v, prefix + (str(i),))
         else:
             flat[".".join(prefix)] = node
 
@@ -178,7 +181,7 @@ def export_universal(state, out_dir: str, *, step: Optional[int] = None
                     np.asarray(jax.device_get(mu_flat[p]), np.float32))
             np.save(os.path.join(d, "exp_avg_sq.npy"),
                     np.asarray(jax.device_get(nu_flat[p]), np.float32))
-        manifest[p] = {"shape": list(w.shape), "dtype": "float32",
+        manifest[p] = {"shape": list(w.shape), "dtype": str(w.dtype),
                        "has_moments": mu_flat is not None}
 
     if step is None:
@@ -330,10 +333,13 @@ def export_universal_offload(params, offload_opt, out_dir: str, *,
             np.save(os.path.join(d, "exp_avg_sq.npy"),
                     np.asarray(sd[f"{key}::v"], np.float32).reshape(shape))
             has_m = True
+            saved_dtype = "float32"
         else:                                 # non-trainable leaf
-            np.save(os.path.join(d, "fp32.npy"), np.asarray(leaf))
+            arr = np.asarray(leaf)
+            np.save(os.path.join(d, "fp32.npy"), arr)
             has_m = False
-        manifest[path] = {"shape": list(shape), "dtype": "float32",
+            saved_dtype = str(arr.dtype)
+        manifest[path] = {"shape": list(shape), "dtype": saved_dtype,
                           "has_moments": has_m}
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump({"format": FORMAT, "step": int(step),

@@ -378,9 +378,6 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
     from deepspeed_tpu.utils.logging import logger
     inert = []
     z = cfg.zero_optimization
-    if z.offload_param.device != "none":
-        inert.append("zero_optimization.offload_param (param offload to "
-                     "cpu/nvme)")
     if z.zero_quantized_weights and z.stage < 3:
         inert.append("zero_optimization.zero_quantized_weights (qwZ is the "
                      "stage-3 weight all-gather; inert at stage "

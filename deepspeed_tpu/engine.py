@@ -125,6 +125,12 @@ class DeepSpeedTPUEngine:
         # runs a grads-only program each step
         off = config.zero_optimization.offload_optimizer
         self.offloading = off.device != "none"
+        if config.zero_optimization.offload_param.device != "none":
+            raise ValueError(
+                "offload_param is served by the Infinity engine — build via "
+                "deepspeed_tpu.initialize() (which dispatches to "
+                "runtime.infinity.InfinityEngine), not DeepSpeedTPUEngine "
+                "directly")
         # master-weight mode iff low-precision params (reference: BF16_Optimizer /
         # fp16 fused optimizer wrap client optimizer the same way); under
         # offload the fp32 master lives host-side instead of in the opt state
@@ -1015,8 +1021,9 @@ class DeepSpeedTPUEngine:
         :1797 flops profiler hook, :145 EngineTimers)."""
         if self.pld is not None:
             # keep the host mirror in sync with the in-graph schedule so
-            # get_theta()/get_state() report the effective value
-            self.pld.update_state(self.global_steps)
+            # get_theta()/get_state() report the effective value; the theta
+            # applied THIS step was computed from the pre-increment state.step
+            self.pld.update_state(self.global_steps - 1)
         self._maybe_print(metrics)
         spp = self.config.steps_per_print
         at_cadence = spp and self.global_steps % spp == 0

@@ -1,0 +1,869 @@
+"""ZeRO-Infinity parameter offload: train models whose params exceed HBM.
+
+Reference parity:
+- ``runtime/zero/partitioned_param_swapper.py:36`` (AsyncPartitionedParameterSwapper)
+  — fp16 params live on NVMe, swapped into device memory just-in-time;
+- ``runtime/zero/parameter_offload.py:83`` + ``partitioned_param_coordinator.py:262``
+  (fetch) / ``:521`` (``__prefetch_nvme_param_partitions``) — per-(sub)module
+  fetch with lookahead prefetch, release after use;
+- ``runtime/zero/offload_config.py`` — ``offload_param: {device: cpu|nvme}``.
+
+TPU-native shape of the flow: the reference intercepts ``nn.Module`` forwards
+with hooks and mutates ``param.data`` in place.  Here the model is decomposed
+into (embed, layer*, head) segments — the same decomposition the pipeline
+container uses — and the engine drives a **Python loop over jitted per-segment
+programs**, streaming each layer's params host→device right before use and
+dropping them after:
+
+    fwd:  x = embed(ep, batch); for i: put(i+1); x_i+1 = layer(lp_i, x_i)
+    bwd:  head grads; for i reversed: put(i-1); (dlp_i, dx) = vjp_i
+
+``jax.device_put`` dispatches asynchronously, so the *next* layer's host→device
+copy overlaps the *current* layer's compute — the double-buffered prefetch the
+reference builds by hand with CUDA streams falls out of the runtime.  Only two
+layers' params are device-resident at any point; the full tree never exists in
+HBM.  The backward recomputes each layer's forward inside its VJP (activation
+checkpointing per layer is forced — exactly the reference's
+``"offload_param" implies remat`` regime at Infinity scale).
+
+The optimizer step runs on the host over fp32 masters (runtime/offload.py
+OffloadAdam — AVX2 ``csrc/cpu_adam.cpp``), and the updated compute-dtype
+params are written back to the param store (RAM, or per-layer NVMe files via
+``csrc/aio.cpp``) — never to the device.  Tiers:
+
+    masters        : host RAM, fp32 (reference pins masters in RAM)
+    Adam moments   : ``offload_optimizer.device`` (cpu RAM | nvme files)
+    compute params : ``offload_param.device``     (cpu RAM | nvme files)
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
+from deepspeed_tpu.engine import StepMetrics
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.parallel import partition
+from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
+from deepspeed_tpu.runtime.precision import (init_loss_scale,
+                                             update_loss_scale_host)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _tree_nbytes(tree) -> int:
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+# --------------------------------------------------------------------- store
+
+class LayerParamStore:
+    """Host-side store for per-layer compute-dtype param trees.
+
+    cpu: a list of numpy trees in RAM.
+    nvme: one file per layer (leaves concatenated at fixed offsets, reference
+    partitioned_param_swapper's per-param swap files), read into a small pool
+    of reusable host buffers with an IO-thread prefetch running ahead of the
+    compute loop (reference ``__prefetch_nvme_param_partitions``).
+    """
+
+    def __init__(self, n_layers: int, example_tree, *, device: str = "cpu",
+                 nvme_path: Optional[str] = None, buffer_count: int = 2,
+                 aio_threads: int = 4):
+        self.n_layers = n_layers
+        self.device = device
+        leaves, self._treedef = jax.tree_util.tree_flatten(example_tree)
+        self._shapes = [np.asarray(l).shape for l in leaves]
+        self._dtypes = [np.asarray(l).dtype for l in leaves]
+        self._sizes = [int(np.prod(s)) * d.itemsize
+                       for s, d in zip(self._shapes, self._dtypes)]
+        self._offsets = np.concatenate([[0], np.cumsum(self._sizes)])
+        self.layer_nbytes = int(self._offsets[-1])
+        if device == "cpu":
+            self._trees: List[Any] = [None] * n_layers
+        elif device == "nvme":
+            from deepspeed_tpu.ops.aio import AIOFile
+            root = os.path.join(nvme_path or "/tmp/ds_tpu_nvme", "params")
+            os.makedirs(root, exist_ok=True)
+            self._files = [AIOFile(os.path.join(root, f"layer_{i}.bin"),
+                                   self.layer_nbytes, threads=aio_threads)
+                           for i in range(n_layers)]
+            self._bufs = [np.empty(self.layer_nbytes, np.uint8)
+                          for _ in range(max(2, buffer_count))]
+            # device trees built from each buffer — the next read into a
+            # buffer must wait until its previous device copy completed
+            self._buf_guard: List[Any] = [None] * len(self._bufs)
+            self._pending: Dict[int, Any] = {}   # layer → (buf_idx, future)
+            self._io = ThreadPoolExecutor(max_workers=2)
+            self._next_buf = 0
+        else:
+            raise ValueError(f"offload_param.device must be cpu|nvme, "
+                             f"got {device!r}")
+
+    # -- views
+    def _buf_tree(self, buf):
+        views = [np.frombuffer(buf, dtype=d, count=int(np.prod(s)),
+                               offset=int(o)).reshape(s)
+                 for s, d, o in zip(self._shapes, self._dtypes,
+                                    self._offsets[:-1])]
+        return jax.tree_util.tree_unflatten(self._treedef, views)
+
+    # -- API
+    def write(self, i: int, host_tree) -> None:
+        if self.device == "cpu":
+            self._trees[i] = jax.tree_util.tree_map(
+                lambda l, d: np.ascontiguousarray(np.asarray(l), dtype=d),
+                host_tree,
+                jax.tree_util.tree_unflatten(self._treedef, self._dtypes))
+            return
+        self._pending.pop(i, None)   # cached read is stale now
+        for leaf, dt, off in zip(jax.tree_util.tree_leaves(host_tree),
+                                 self._dtypes, self._offsets[:-1]):
+            flat = np.ascontiguousarray(np.asarray(leaf, dt)).view(np.uint8
+                                                                   ).reshape(-1)
+            self._files[i].pwrite(flat, int(off))
+
+    def _read_into(self, i: int, buf_idx: int):
+        guard = self._buf_guard[buf_idx]
+        if guard is not None:
+            # EVERY device copy out of this buffer must have landed — a small
+            # leaf can finish long before a large one's DMA completes
+            jax.block_until_ready(guard)
+            self._buf_guard[buf_idx] = None
+        self._files[i].pread(self._bufs[buf_idx], 0)
+        return buf_idx
+
+    def prefetch(self, i: int) -> None:
+        """Issue the NVMe→RAM read for layer ``i`` on the IO pool (no-op for
+        the cpu tier — RAM is already the staging area)."""
+        if self.device != "nvme" or not (0 <= i < self.n_layers):
+            return
+        if i in self._pending:
+            return
+        buf_idx = self._next_buf
+        self._next_buf = (self._next_buf + 1) % len(self._bufs)
+        self._pending[i] = (buf_idx, self._io.submit(self._read_into, i,
+                                                     buf_idx))
+
+    def get(self, i: int):
+        """Host tree for layer ``i`` (blocking if its read is in flight)."""
+        if self.device == "cpu":
+            return self._trees[i]
+        if i not in self._pending:
+            self.prefetch(i)
+        buf_idx, fut = self._pending.pop(i)
+        fut.result()
+        return self._buf_tree(self._bufs[buf_idx]), buf_idx
+
+    def mark_consumed(self, buf_idx: int, device_tree) -> None:
+        """Record the device arrays created from a buffer so the next read
+        into it waits for ALL their host→device copies (nvme tier only)."""
+        if self.device == "nvme":
+            self._buf_guard[buf_idx] = (jax.tree_util.tree_leaves(device_tree)
+                                        or None)
+
+
+# --------------------------------------------------------------- GPT adapter
+
+class InfinityGPT:
+    """Layered view of the flagship GPT for the Infinity engine: the same
+    parameters as ``models/gpt.py`` GPT, split into streamable segments
+    {embed, layers[i], head}.  ``gpt_params_to_infinity`` converts a trained
+    flax GPT tree into this layout (and back via ``infinity_params_to_gpt``)."""
+
+    is_infinity = True
+
+    def __init__(self, cfg, mesh=None):
+        from deepspeed_tpu.models.gpt import Block
+        if cfg.num_experts:
+            raise NotImplementedError(
+                "MoE under ZeRO-Infinity param offload is unsupported; use "
+                "the ep mesh axis with the in-HBM engine")
+        if cfg.sequence_parallel:
+            raise NotImplementedError(
+                "sequence parallelism under param offload is unsupported")
+        if cfg.embed_norm:
+            raise NotImplementedError(
+                "embed_norm (bloom) under param offload is unsupported")
+        self.cfg = cfg
+        self.mesh = mesh
+        self._block = Block(cfg)
+
+    # -- per-segment inits (device → host, one segment resident at a time)
+    def init_embed(self, rng, ids):
+        from deepspeed_tpu.models.gpt import _kernel_init
+        c = self.cfg
+        k_e, k_p = jax.random.split(rng)
+        init = _kernel_init()
+        ep = {"wte": init(k_e, (c.vocab_size, c.hidden_size), c.param_dtype)}
+        if not c.use_rope and not c.use_alibi:
+            ep["wpe"] = init(k_p, (c.max_seq_len, c.hidden_size),
+                             c.param_dtype)
+        return ep
+
+    def init_layer(self, rng, x, positions):
+        return unbox(self._block.init(rng, x, positions, True))["params"]
+
+    def init_head(self, rng, hidden_size):
+        from deepspeed_tpu.models.gpt import _kernel_init
+        c = self.cfg
+        hp = {"final_norm_scale": jnp.ones((hidden_size,), c.param_dtype)}
+        if not c.use_rmsnorm:
+            hp["final_norm_bias"] = jnp.zeros((hidden_size,), c.param_dtype)
+        if not c.tie_embeddings:
+            hp["lm_head"] = _kernel_init()(rng, (hidden_size, c.vocab_size),
+                                           c.param_dtype)
+        if c.unembed_bias:
+            hp["lm_head_bias"] = jnp.zeros((c.vocab_size,), c.param_dtype)
+        return hp
+
+    # -- forward segments (pure functions, jitted by the engine)
+    def embed_apply(self, ep, ids, rng):
+        c = self.cfg
+        T = ids.shape[1]
+        x = ep["wte"].astype(c.dtype)[ids]
+        if c.embed_scale:
+            x = x * jnp.asarray(c.embed_scale, c.dtype)
+        if "wpe" in ep:
+            x = x + ep["wpe"].astype(c.dtype)[None, :T]
+        if c.dropout > 0 and rng is not None:
+            import flax.linen as fnn
+            x = fnn.Dropout(rate=c.dropout).apply(
+                {}, x, deterministic=False, rngs={"dropout": rng})
+        return x
+
+    def layer_apply(self, lp, x, rng, window=None):
+        c = self.cfg
+        B, T = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        if rng is not None and c.dropout > 0:
+            y, _ = self._block.apply({"params": lp}, x, positions, False,
+                                     window=window, rngs={"dropout": rng})
+        else:
+            y, _ = self._block.apply({"params": lp}, x, positions, True,
+                                     window=window)
+        return y
+
+    def head_apply(self, hp, ep, y, labels, mask):
+        # dtype discipline mirrors GPT.__call__ exactly (final Norm on the
+        # compute-dtype activations, unembed cast to the activation dtype) so
+        # the streamed path is numerically identical to the in-HBM engine
+        from deepspeed_tpu.ops import lm_cross_entropy, layer_norm, rms_norm
+        from deepspeed_tpu.ops.norms import LN_EPS, RMS_EPS
+        c = self.cfg
+        if c.use_rmsnorm:
+            h = rms_norm(y, hp["final_norm_scale"],
+                         eps=c.norm_eps or RMS_EPS)
+        else:
+            h = layer_norm(y, hp["final_norm_scale"], hp["final_norm_bias"],
+                           eps=c.norm_eps or LN_EPS)
+        if c.tie_embeddings:
+            unembed = ep["wte"].astype(h.dtype).T
+        else:
+            unembed = hp["lm_head"].astype(h.dtype)
+        bias = (hp["lm_head_bias"] if c.unembed_bias else None)
+        return lm_cross_entropy(h, unembed, labels, mask,
+                                chunk_size=c.loss_chunk or None, bias=bias)
+
+
+def gpt_params_to_infinity(variables, cfg):
+    """flax GPT variables → {embed, layers: [...], head} host trees (the
+    infinity layout).  Counterpart of pipe.module.gpt_params_to_pipe."""
+    src = unbox(variables)["params"]
+    bb = src["backbone"]
+    ep = {"wte": bb["wte"]}
+    if "wpe" in bb:
+        ep["wpe"] = bb["wpe"]
+    layers = [bb[f"block_{i}"] for i in range(cfg.num_layers)]
+    hp = {"final_norm_scale": bb["final_norm"]["scale"]}
+    if "bias" in bb["final_norm"]:
+        hp["final_norm_bias"] = bb["final_norm"]["bias"]
+    if "lm_head" in src:
+        hp["lm_head"] = src["lm_head"]
+    if "lm_head_bias" in src:
+        hp["lm_head_bias"] = src["lm_head_bias"]
+    return {"embed": ep, "layers": layers, "head": hp}
+
+
+def infinity_params_to_gpt(tree, cfg):
+    """Inverse of ``gpt_params_to_infinity`` (for export / serving)."""
+    bb = {"wte": tree["embed"]["wte"],
+          "final_norm": {"scale": tree["head"]["final_norm_scale"]}}
+    if "wpe" in tree["embed"]:
+        bb["wpe"] = tree["embed"]["wpe"]
+    if "final_norm_bias" in tree["head"]:
+        bb["final_norm"]["bias"] = tree["head"]["final_norm_bias"]
+    for i, lp in enumerate(tree["layers"]):
+        bb[f"block_{i}"] = lp
+    out = {"backbone": bb}
+    if "lm_head" in tree["head"]:
+        out["lm_head"] = tree["head"]["lm_head"]
+    if "lm_head_bias" in tree["head"]:
+        out["lm_head_bias"] = tree["head"]["lm_head_bias"]
+    return {"params": out}
+
+
+# --------------------------------------------------------------------- engine
+
+class InfinityEngine:
+    """Training engine for ``zero_optimization.offload_param`` — the
+    ZeRO-Infinity regime where the full parameter set never fits in HBM.
+
+    Public surface mirrors the in-HBM engine where it transfers:
+    ``train_batch`` / ``eval_batch`` / ``get_lr`` / ``save_checkpoint`` /
+    ``load_checkpoint`` / ``export_universal_checkpoint``.  The
+    forward/backward/step trio is not supported (as with the pipeline engine —
+    the streaming schedule owns the loop).
+    """
+
+    def __init__(self, model, config: DeepSpeedTPUConfig, example_batch,
+                 mesh: Optional[Mesh] = None, lr_scheduler=None):
+        self.config = config = parse_config(config)
+        comm.init_distributed()
+        z = config.zero_optimization
+        if z.stage != 3:
+            raise ValueError(
+                f"offload_param requires ZeRO stage 3 (got stage {z.stage}) — "
+                f"the reference enforces the same (zero/config.py)")
+        if getattr(model, "is_infinity", False):
+            self.module = model
+        elif hasattr(model, "cfg"):   # flax GPT
+            self.module = InfinityGPT(model.cfg)
+        else:
+            raise TypeError(
+                "offload_param needs a layered model: models.GPT or an "
+                "object with is_infinity=True (embed/layer/head segments); "
+                f"got {type(model)!r}")
+        c = self.module.cfg
+
+        # mesh: batch over (dp, fsdp); tp shards the streamed layer params
+        if mesh is None:
+            m = config.mesh
+            if m.pp != 1 or m.ep != 1 or m.sp != 1:
+                raise NotImplementedError(
+                    "offload_param composes with dp/fsdp/tp meshes only")
+            fsdp = m.fsdp if isinstance(m.fsdp, int) else -1
+            mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(
+                pp=1, dp=m.dp if fsdp != -1 else 1, fsdp=fsdp, ep=1, sp=1,
+                tp=m.tp))
+        self.mesh = mesh
+        self.dp_world_size = mesh.shape["dp"] * mesh.shape["fsdp"]
+        config.resolve_batch_size(self.dp_world_size)
+        self.gas = int(config.gradient_accumulation_steps)
+        self.compute_dtype = config.compute_dtype
+        self.zero_stage = 3
+
+        off_p = z.offload_param
+        off_o = z.offload_optimizer
+        moments_device = off_o.device if off_o.device != "none" else "cpu"
+        if off_o.device == "none":
+            log_dist("offload_param without offload_optimizer: the optimizer "
+                     "step is host-side by construction — moments tier "
+                     "defaults to cpu RAM", ranks=[0])
+
+        # activation offload (reference activation_checkpointing
+        # cpu_checkpointing): saved layer inputs round-trip to host RAM
+        self.cpu_checkpointing = bool(
+            config.activation_checkpointing.cpu_checkpointing)
+
+        from deepspeed_tpu.runtime.offload import OffloadAdam
+        self.offload_opt = OffloadAdam(
+            config.optimizer.type, config.optimizer.params,
+            device=moments_device, nvme_path=off_o.nvme_path)
+        self.optimizer = self.offload_opt
+        self._opt_params = dict(config.optimizer.params)
+        self.lr_schedule = lr_scheduler
+        if self.lr_schedule is None and config.scheduler is not None:
+            from deepspeed_tpu.runtime import lr_schedules
+            self.lr_schedule = lr_schedules.build_schedule(
+                config.scheduler.type, config.scheduler.params)
+
+        # ---- shapes, shardings, jitted segment programs ----
+        leaves = jax.tree_util.tree_leaves(example_batch)
+        T = np.asarray(leaves[0]).shape[-1]
+        micro_global = (int(config.train_micro_batch_size_per_gpu)
+                        * self.dp_world_size)
+        self._ids_shape = (micro_global, T)
+        ids0 = jnp.zeros(self._ids_shape, jnp.int32)
+        x0 = jnp.zeros(self._ids_shape + (c.hidden_size,), self.compute_dtype)
+        pos0 = jnp.broadcast_to(jnp.arange(T), self._ids_shape)
+
+        def shardings_for(abstract_tree):
+            annotated = annotate_abstract(abstract_tree)
+            return partition.param_shardings(annotated, mesh, 3)
+
+        block = self.module._block
+        abstract_layer = jax.eval_shape(
+            lambda k: unbox(block.init(k, x0, pos0, True))["params"],
+            jax.random.PRNGKey(0))
+        self.layer_shardings = shardings_for(abstract_layer)
+        self._batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+        self._x_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+        # embed/head segments: replicated puts (vocab tables under tp would
+        # shard via the same machinery once boxed — GPT's init_embed returns
+        # raw arrays, so replicate; layer params carry the tp annotations)
+        self._repl = NamedSharding(mesh, P())
+
+        self.n_layers = c.num_layers
+        self._windows = [c.window_for_layer(i) for i in range(self.n_layers)]
+
+        # jitted programs (one compile per distinct attention window)
+        mod = self.module
+        self._jit_embed = jax.jit(mod.embed_apply)
+        self._jit_layer = {}
+        self._jit_layer_vjp = {}
+        for w in set(self._windows):
+            def fwd(lp, x, rng, _w=w):
+                return mod.layer_apply(lp, x, rng, window=_w)
+
+            def vjp(lp, x, dy, rng, _w=w):
+                _, f = jax.vjp(
+                    lambda lp_, x_: mod.layer_apply(lp_, x_, rng, window=_w),
+                    lp, x)
+                dlp, dx = f(dy)
+                return dlp, dx
+            self._jit_layer[w] = jax.jit(fwd)
+            self._jit_layer_vjp[w] = jax.jit(vjp)
+
+        def head_grad(hp, ep, y, labels, mask, scale):
+            def f(hp_, ep_, y_):
+                loss = mod.head_apply(hp_, ep_, y_, labels, mask)
+                return (loss * scale).astype(jnp.float32), loss
+            (_, loss), grads = jax.value_and_grad(
+                f, argnums=(0, 1, 2), has_aux=True)(hp, ep, y)
+            return loss, grads
+        self._jit_head_grad = jax.jit(head_grad)
+
+        def head_loss(hp, ep, y, labels, mask):
+            return mod.head_apply(hp, ep, y, labels, mask)
+        self._jit_head_loss = jax.jit(head_loss)
+
+        def embed_vjp(ep, ids, dx, rng):
+            _, f = jax.vjp(lambda e: mod.embed_apply(e, ids, rng), ep)
+            return f(dx)[0]
+        self._jit_embed_vjp = jax.jit(embed_vjp)
+
+        from deepspeed_tpu.models.gpt import shift_labels
+        self._jit_shift = jax.jit(shift_labels)
+
+        # ---- init params segment-by-segment (never all on device) ----
+        rng = jax.random.PRNGKey(config.seed)
+        k_embed, k_layers, k_head, self._rng = jax.random.split(rng, 4)
+        store_kw = dict(device=off_p.device, nvme_path=off_p.nvme_path,
+                        buffer_count=off_p.buffer_count)
+
+        def to_host_compute(tree):
+            return jax.tree_util.tree_map(
+                lambda l: np.asarray(l.astype(self.compute_dtype)
+                                     if jnp.issubdtype(l.dtype, jnp.floating)
+                                     else l), _host(tree))
+
+        self.embed_host = to_host_compute(mod.init_embed(k_embed, ids0))
+        self.head_host = to_host_compute(mod.init_head(k_head, c.hidden_size))
+        jit_layer_init = jax.jit(
+            lambda k: unbox(block.init(k, x0, pos0, True))["params"])
+        self.store: Optional[LayerParamStore] = None
+        for i in range(self.n_layers):
+            lp = jit_layer_init(jax.random.fold_in(k_layers, i))
+            lp_host = to_host_compute(lp)
+            del lp
+            if self.store is None:
+                self.store = LayerParamStore(self.n_layers, lp_host,
+                                             **store_kw)
+            self.store.write(i, lp_host)
+        self.layer_nbytes = int(self.store.layer_nbytes)
+        self.total_param_bytes = (self.layer_nbytes * self.n_layers
+                                  + _tree_nbytes(self.embed_host)
+                                  + _tree_nbytes(self.head_host))
+
+        # host Adam over the full logical tree
+        self.offload_opt.initialize(self._assemble_host_tree())
+
+        # bookkeeping / observability
+        self.global_steps = 0
+        self.loss_scale_state = init_loss_scale(config.fp16)
+        self._last_metrics: Optional[StepMetrics] = None
+        self.schedule_log: List[tuple] = []   # (event, layer) dispatch order
+        self.record_schedule = False
+        self.serial_transfers = False         # True = no prefetch (tests)
+        self.live_param_bytes = 0
+        self.max_live_param_bytes = 0
+        n_params = self.total_param_bytes // np.dtype(
+            self.compute_dtype).itemsize
+        self.num_parameters = int(n_params)
+        log_dist(
+            f"Infinity engine ready: params={n_params/1e6:.1f}M "
+            f"({self.total_param_bytes/2**20:.1f}MiB total, "
+            f"{self.layer_nbytes/2**20:.2f}MiB/layer streamed, param tier="
+            f"{off_p.device}, moments tier={moments_device}) "
+            f"mesh={dict(mesh.shape)} dtype={self.compute_dtype.__name__}",
+            ranks=[0])
+
+    # ----------------------------------------------------------------- params
+
+    def _assemble_host_tree(self):
+        layers = []
+        for i in range(self.n_layers):
+            got = self.store.get(i)
+            if self.store.device == "nvme":
+                tree, _ = got
+                # copy out of the rotating buffer — this tree is long-lived
+                layers.append(jax.tree_util.tree_map(np.array, tree))
+            else:
+                layers.append(got)
+        return {"embed": self.embed_host, "layers": layers,
+                "head": self.head_host}
+
+    def load_params(self, host_tree) -> None:
+        """Install a full host param tree (infinity layout — see
+        ``gpt_params_to_infinity``) and rebuild the fp32 masters from it."""
+        def conv(t):
+            return jax.tree_util.tree_map(
+                lambda l: np.asarray(l, self.compute_dtype)
+                if np.asarray(l).dtype.kind == "f" else np.asarray(l), t)
+        self.embed_host = conv(host_tree["embed"])
+        self.head_host = conv(host_tree["head"])
+        for i, lp in enumerate(host_tree["layers"]):
+            self.store.write(i, conv(lp))
+        self.offload_opt = type(self.offload_opt)(
+            self.config.optimizer.type, self.config.optimizer.params,
+            device=self.offload_opt.device,
+            nvme_path=self.offload_opt.nvme_path)
+        self.offload_opt.initialize(self._assemble_host_tree())
+
+    def current_params_gpt(self):
+        """Assembled params in the flax GPT layout (for export/serving)."""
+        return infinity_params_to_gpt(self._assemble_host_tree(),
+                                      self.module.cfg)
+
+    # ------------------------------------------------------------- transfers
+
+    def _log(self, event, i):
+        if self.record_schedule:
+            self.schedule_log.append((event, i))
+
+    def _put_layer(self, i: int):
+        got = self.store.get(i)
+        buf_idx = None
+        if self.store.device == "nvme":
+            tree, buf_idx = got
+        else:
+            tree = got
+        self._log("put", i)
+        dev = jax.device_put(tree, self.layer_shardings)
+        if buf_idx is not None:
+            self.store.mark_consumed(buf_idx, dev)
+        if self.serial_transfers:
+            jax.block_until_ready(dev)
+        self.live_param_bytes += self.layer_nbytes
+        self.max_live_param_bytes = max(self.max_live_param_bytes,
+                                        self.live_param_bytes)
+        return dev
+
+    def _drop_layer(self, dev) -> None:
+        del dev
+        self.live_param_bytes -= self.layer_nbytes
+
+    # ------------------------------------------------------------------ step
+
+    def _micro_fwd_bwd(self, ep_dev, hp_dev, ids, labels, mask, rng, scale,
+                       accum):
+        """One microbatch: streamed forward, head grads, streamed backward.
+        Accumulates fp32 grads into the host ``accum`` tree; returns loss."""
+        L = self.n_layers
+        rngs = (jax.random.split(rng, L + 1)
+                if self.module.cfg.dropout > 0 else [None] * (L + 1))
+
+        self._log("fwd_embed", -1)
+        x = self._jit_embed(ep_dev, ids, rngs[L])
+        saved = []
+        self.store.prefetch(0)
+        nxt = self._put_layer(0)
+        for i in range(L):
+            cur = nxt
+            if i + 1 < L and not self.serial_transfers:
+                self.store.prefetch(i + 1)
+                nxt = self._put_layer(i + 1)   # overlaps layer i's compute
+            saved.append(jax.device_get(x) if self.cpu_checkpointing else x)
+            self._log("fwd", i)
+            x = self._jit_layer[self._windows[i]](cur, x, rngs[i])
+            if i + 1 < L and self.serial_transfers:
+                jax.block_until_ready(x)
+                nxt = self._put_layer(i + 1)
+            self._drop_layer(cur)
+
+        self._log("head", -1)
+        loss, (dhp, dep, dx) = self._jit_head_grad(hp_dev, ep_dev, x, labels,
+                                                   mask, scale)
+        self._acc(accum["head"], dhp)
+        self._acc(accum["embed"], dep)
+
+        # streamed backward: layer i's params re-fetched (they were dropped
+        # after the forward); layer i-1's fetch is issued before i's VJP so
+        # the copy rides under the recompute+backward matmuls.  Grad fetch is
+        # one layer DEFERRED: layer i+1's device→host grad copy + host fp32
+        # accumulation happen while layer i's VJP runs, so the device never
+        # idles on the D2H transfer.
+        self.store.prefetch(L - 1)
+        nxt = self._put_layer(L - 1)
+        pending = None                       # (layer idx, device grads)
+        for i in reversed(range(L)):
+            cur = nxt
+            if i > 0 and not self.serial_transfers:
+                self.store.prefetch(i - 1)
+                nxt = self._put_layer(i - 1)
+            x_in = saved[i]
+            if self.cpu_checkpointing:
+                x_in = jax.device_put(x_in, self._x_sharding)
+            self._log("bwd", i)
+            dlp, dx = self._jit_layer_vjp[self._windows[i]](cur, x_in, dx,
+                                                            rngs[i])
+            if i > 0 and self.serial_transfers:
+                jax.block_until_ready(dx)
+                nxt = self._put_layer(i - 1)
+            if pending is not None:
+                self._acc(accum["layers"][pending[0]], pending[1])
+            pending = (i, dlp)
+            self._drop_layer(cur)
+            saved[i] = None
+        if pending is not None:
+            self._acc(accum["layers"][pending[0]], pending[1])
+
+        self._log("bwd_embed", -1)
+        dep2 = self._jit_embed_vjp(ep_dev, ids, dx, rngs[L])
+        self._acc(accum["embed"], dep2)
+        return loss
+
+    @staticmethod
+    def _acc(acc_tree, dev_grads):
+        flat_acc = jax.tree_util.tree_leaves(acc_tree)
+        flat_g = jax.tree_util.tree_leaves(jax.device_get(dev_grads))
+        for a, g in zip(flat_acc, flat_g):
+            a += np.asarray(g, np.float32)
+
+    def _zeros_like_host(self, tree):
+        return jax.tree_util.tree_map(
+            lambda l: np.zeros(np.asarray(l).shape, np.float32), tree)
+
+    def _zeros_layer_grads(self):
+        """fp32 grad accumulators shaped like one layer's tree — built from
+        the store's metadata (no NVMe read just to learn shapes)."""
+        st = self.store
+        zeros = [np.zeros(s, np.float32) for s in st._shapes]
+        return jax.tree_util.tree_unflatten(st._treedef, zeros)
+
+    def train_batch(self, batch) -> StepMetrics:
+        """One optimizer step over ``gas`` microbatches with every parameter
+        host-resident between uses."""
+        cfg = self.config
+        ids_all = np.asarray(batch["input_ids"])
+        local_bs = cfg.train_batch_size // jax.process_count()
+        micro = local_bs // self.gas
+        if ids_all.shape[0] == self.gas and ids_all.ndim >= 3:
+            pass
+        elif ids_all.shape[0] == local_bs:
+            batch = jax.tree_util.tree_map(
+                lambda x: np.asarray(x).reshape(
+                    (self.gas, micro) + np.asarray(x).shape[1:]), batch)
+        else:
+            raise ValueError(
+                f"train_batch leading dim {ids_all.shape[0]} matches neither "
+                f"gas={self.gas} nor local batch {local_bs}")
+
+        scale = float(self.loss_scale_state.scale)
+        accum = {"embed": self._zeros_like_host(self.embed_host),
+                 "layers": [self._zeros_layer_grads()
+                            for _ in range(self.n_layers)],
+                 "head": self._zeros_like_host(self.head_host)}
+
+        ep_dev = jax.device_put(self.embed_host, self._repl)
+        hp_dev = jax.device_put(self.head_host, self._repl)
+        self.live_param_bytes += (_tree_nbytes(self.embed_host)
+                                  + _tree_nbytes(self.head_host))
+        self.max_live_param_bytes = max(self.max_live_param_bytes,
+                                        self.live_param_bytes)
+
+        losses = []
+        for g in range(self.gas):
+            mb = jax.tree_util.tree_map(lambda x: np.asarray(x)[g], batch)
+            ids = jax.device_put(np.asarray(mb["input_ids"], np.int32),
+                                 self._batch_sharding)
+            labels_np, mask_np = self._jit_shift(
+                {k: jnp.asarray(v) for k, v in mb.items()
+                 if k in ("labels", "loss_mask")},
+                jnp.asarray(mb["input_ids"]))
+            labels = jax.device_put(np.asarray(labels_np),
+                                    self._batch_sharding)
+            mask = jax.device_put(np.asarray(mask_np), self._batch_sharding)
+            rng = jax.random.fold_in(self._rng,
+                                     self.global_steps * self.gas + g)
+            loss = self._micro_fwd_bwd(ep_dev, hp_dev, ids, labels, mask, rng,
+                                       jnp.float32(scale), accum)
+            losses.append(float(jax.device_get(loss)))
+        del ep_dev, hp_dev
+        self.live_param_bytes -= (_tree_nbytes(self.embed_host)
+                                  + _tree_nbytes(self.head_host))
+
+        # ---- host optimizer step (fp32 masters; reference CPU Adam flow) ----
+        sq = 0.0
+        finite = True
+        for leaf in jax.tree_util.tree_leaves(accum):
+            s = float(np.sum(np.square(leaf, dtype=np.float64)))
+            sq += s
+            if not np.isfinite(s):
+                finite = False
+        denom = scale * self.gas
+        raw_norm = float(np.sqrt(sq)) / denom if finite else float("inf")
+        if finite:
+            clip = float(cfg.gradient_clipping or 0.0)
+            coef = 1.0
+            if clip > 0.0 and raw_norm > clip:
+                coef = clip / (raw_norm + 1e-6)
+            lr = (float(self.lr_schedule(self.offload_opt.step_count))
+                  if self.lr_schedule is not None
+                  else float(self._opt_params.get("lr", 1e-3)))
+            new_tree = self.offload_opt.update(accum, lr=lr,
+                                               grad_scale=coef / denom)
+            self.embed_host = jax.tree_util.tree_map(np.asarray,
+                                                     new_tree["embed"])
+            self.head_host = jax.tree_util.tree_map(np.asarray,
+                                                    new_tree["head"])
+            for i, lp in enumerate(new_tree["layers"]):
+                self.store.write(i, lp)
+        self.loss_scale_state = update_loss_scale_host(
+            self.loss_scale_state, finite, cfg.fp16)
+        self.global_steps += 1
+        metrics = StepMetrics(
+            loss=jnp.float32(np.mean(losses)),
+            grad_norm=jnp.float32(raw_norm),
+            loss_scale=self.loss_scale_state.scale,
+            skipped_steps=self.loss_scale_state.skipped)
+        self._last_metrics = metrics
+        spp = cfg.steps_per_print
+        if spp and self.global_steps % spp == 0:
+            log_dist(f"step={self.global_steps} "
+                     f"loss={float(metrics.loss):.4f} "
+                     f"grad_norm={raw_norm:.3f}", ranks=[0])
+        return metrics
+
+    def eval_batch(self, batch):
+        """Streamed forward-only loss (deterministic)."""
+        ids = jax.device_put(np.asarray(batch["input_ids"], np.int32),
+                             self._batch_sharding)
+        labels, mask = self._jit_shift(
+            {k: jnp.asarray(v) for k, v in batch.items()
+             if k in ("labels", "loss_mask")}, jnp.asarray(ids))
+        ep_dev = jax.device_put(self.embed_host, self._repl)
+        x = self._jit_embed(ep_dev, ids, None)
+        self.store.prefetch(0)
+        nxt = self._put_layer(0)
+        for i in range(self.n_layers):
+            cur = nxt
+            self.store.prefetch(i + 1)
+            if i + 1 < self.n_layers:
+                nxt = self._put_layer(i + 1)
+            x = self._jit_layer[self._windows[i]](cur, x, None)
+            self._drop_layer(cur)
+        hp_dev = jax.device_put(self.head_host, self._repl)
+        loss = self._jit_head_loss(hp_dev, ep_dev, x, labels, mask)
+        return loss.astype(jnp.float32)
+
+    # ------------------------------------------------------------------ misc
+
+    def get_lr(self):
+        if self.lr_schedule is not None:
+            return [float(self.lr_schedule(self.offload_opt.step_count))]
+        return [float(self._opt_params.get("lr", 0.0))]
+
+    def get_global_grad_norm(self):
+        return (float(self._last_metrics.grad_norm)
+                if self._last_metrics else None)
+
+    @property
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    @property
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    # ------------------------------------------------------------------ ckpt
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None,
+                        async_save: bool = False):
+        tag = tag or f"global_step{self.global_steps}"
+        out = os.path.join(save_dir, tag)
+        os.makedirs(out, exist_ok=True)
+        if jax.process_index() == 0:
+            ls = self.loss_scale_state
+            np.savez(os.path.join(out, "offload_state.npz"),
+                     **self.offload_opt.state_dict())
+            import json
+            with open(os.path.join(out, "infinity_meta.json"), "w") as f:
+                json.dump({"global_steps": self.global_steps,
+                           "loss_scale": [float(ls.scale),
+                                          int(ls.growth_counter),
+                                          int(ls.hysteresis),
+                                          int(ls.skipped)],
+                           "rng": np.asarray(
+                               jax.random.key_data(self._rng)
+                               if jnp.issubdtype(self._rng.dtype,
+                                                 jax.dtypes.prng_key)
+                               else self._rng).tolist(),
+                           **(client_state or {})}, f)
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+        return tag
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+        import json
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        out = os.path.join(load_dir, tag)
+        with np.load(os.path.join(out, "offload_state.npz")) as sd:
+            self.offload_opt.load_state_dict(dict(sd))
+        # re-derive compute params from the restored masters
+        tree = self.offload_opt.current_params()
+        self.embed_host = jax.tree_util.tree_map(np.asarray, tree["embed"])
+        self.head_host = jax.tree_util.tree_map(np.asarray, tree["head"])
+        for i, lp in enumerate(tree["layers"]):
+            self.store.write(i, lp)
+        with open(os.path.join(out, "infinity_meta.json")) as f:
+            client_state = json.load(f)
+        self.global_steps = int(client_state.get("global_steps", 0))
+        if "loss_scale" in client_state:
+            from deepspeed_tpu.runtime.precision import LossScaleState
+            import jax.numpy as _jnp
+            s, g, h, k = client_state["loss_scale"]
+            self.loss_scale_state = LossScaleState(
+                _jnp.float32(s), _jnp.int32(g), _jnp.int32(h), _jnp.int32(k))
+        if "rng" in client_state:
+            data = np.asarray(client_state["rng"], np.uint32)
+            self._rng = (jax.random.wrap_key_data(data)
+                         if jnp.issubdtype(self._rng.dtype,
+                                           jax.dtypes.prng_key)
+                         else jnp.asarray(data))
+        return tag, client_state
+
+    def export_universal_checkpoint(self, out_dir: str) -> str:
+        from deepspeed_tpu.checkpoint import universal as _u
+        return _u.export_universal_offload(
+            self._assemble_host_tree(), self.offload_opt, out_dir,
+            step=self.global_steps)

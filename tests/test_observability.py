@@ -188,7 +188,6 @@ class TestInertConfigWarnings:
         cfg = parse_config({
             "zero_optimization": {
                 "stage": 2,
-                "offload_param": {"device": "nvme"},
                 # implemented at stage 3 only — inert at stage 2 must warn
                 "zero_quantized_weights": True,
                 "zero_quantized_gradients": True,
@@ -196,9 +195,12 @@ class TestInertConfigWarnings:
         })
         inert = warn_inert_config(cfg)
         joined = " ".join(inert)
-        assert "offload_param" in joined
         assert "zero_quantized_weights" in joined
         assert "zero_quantized_gradients" in joined
+        # offload_param is LIVE now (runtime/infinity.py) — must not warn
+        cfg2 = parse_config({"zero_optimization": {
+            "stage": 3, "offload_param": {"device": "cpu"}}})
+        assert "offload_param" not in " ".join(warn_inert_config(cfg2))
 
     def test_implemented_keys_do_not_warn(self):
         """gradient_compression + stage-3 qwZ are live now (round 2) — the
