@@ -24,7 +24,8 @@ from deepspeed_tpu.ops.registry import dispatch, list_ops, op_report, register_o
 
 
 def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                   mask=None, bias=None, interpret=None):
+                   mask=None, bias=None, window=None, alibi_slopes=None,
+                   interpret=None):
     """Plain attention on [B, T, N, D] — numeric ground truth for the kernel.
 
     The ONE XLA softmax-attention body in the codebase: causal tril masking, or
@@ -32,6 +33,9 @@ def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
     all-False rows produce zeros, not NaN, so left-pad garbage never reaches
     later layers' V inputs).  ``bias`` [B|1, N, Tq|1, S] is added to the fp32
     logits pre-softmax (alibi; reference bloom/falcon-rw baddbmm bias).
+    ``window``/``alibi_slopes`` are the FIRST-CLASS forms of the same
+    semantics over canonical (arange) positions — the forms the Pallas kernel
+    consumes in-kernel.
     """
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
@@ -42,10 +46,21 @@ def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32) * scale
+    if alibi_slopes is not None:
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(q.shape[2])
+        logits = logits + (sl[None, :, None, None]
+                           * jnp.arange(s, dtype=jnp.float32))
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     neg = jnp.finfo(jnp.float32).min
-    if mask is not None:
+    if window is not None:
+        rel = jnp.arange(t)[:, None] - jnp.arange(s)[None, :]
+        wtri = (rel >= 0) & (rel < window)
+        logits = jnp.where(wtri[None, None], logits, neg)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(jnp.any(wtri[None, None], axis=-1, keepdims=True),
+                          probs, 0.0)
+    elif mask is not None:
         m = mask[:, None]                                # [B, 1, Tq, S]
         logits = jnp.where(m, logits, neg)
         probs = jax.nn.softmax(logits, axis=-1)
@@ -62,26 +77,32 @@ def _attention_xla(q, k, v, *, causal=True, scale=None, dropout_fn=None,
 
 
 def _attention_pallas(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                      mask=None, bias=None, interpret=None):
+                      mask=None, bias=None, window=None, alibi_slopes=None,
+                      interpret=None):
     if dropout_fn is not None:
         raise ValueError(
             "the pallas flash-attention kernel has no probs-dropout; use "
             "impl='xla', dropout=0, or output dropout (Ulysses-branch style)")
     if mask is not None:
         raise ValueError("the pallas flash-attention kernel takes no explicit "
-                         "mask; use impl='xla' for the KV-cache/padded path")
+                         "mask; use impl='xla' for the KV-cache/padded path "
+                         "(sliding windows go through window=, not mask=)")
     if bias is not None:
-        raise ValueError("the pallas flash-attention kernel takes no logit "
-                         "bias; use impl='xla' for alibi models")
+        raise ValueError("the pallas flash-attention kernel takes no free-"
+                         "form logit bias; alibi goes through alibi_slopes=, "
+                         "other biases through impl='xla'")
     return flash_attention(q, k, v, causal=causal, scale=scale,
+                           window=window, alibi_slopes=alibi_slopes,
                            interpret=interpret)
 
 
 def _attention_supported(q, k, v, *, causal=True, scale=None, dropout_fn=None,
-                         mask=None, bias=None, interpret=None):
+                         mask=None, bias=None, window=None, alibi_slopes=None,
+                         interpret=None):
     from deepspeed_tpu.ops.flash_attention import supported as flash_supported
     return (dropout_fn is None and mask is None and bias is None
-            and flash_supported(q, k, v, causal=causal))
+            and flash_supported(q, k, v, causal=causal, window=window,
+                                alibi_slopes=alibi_slopes))
 
 
 register_op("causal_attention", xla=_attention_xla, pallas=_attention_pallas,
@@ -101,11 +122,18 @@ register_op("evoformer_attention", xla=evoformer_attention)
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
                      dropout_fn: Optional[Callable] = None,
-                     mask=None, bias=None,
+                     mask=None, bias=None, window: Optional[int] = None,
+                     alibi_slopes=None,
                      impl: Optional[str] = None):
-    """Dispatching attention entry used by the model layer."""
+    """Dispatching attention entry used by the model layer.
+
+    ``window``/``alibi_slopes`` assume canonical positions (query t at
+    position t) — the training fast path; models with gathered/shifted
+    positions (random-LTD, KV-cache) express the same semantics through
+    ``mask``/``bias`` and ride the XLA body."""
     return dispatch("causal_attention", q, k, v, causal=causal, scale=scale,
-                    dropout_fn=dropout_fn, mask=mask, bias=bias, impl=impl)
+                    dropout_fn=dropout_fn, mask=mask, bias=bias,
+                    window=window, alibi_slopes=alibi_slopes, impl=impl)
 
 
 __all__ = ["causal_attention", "flash_attention", "paged_attention",

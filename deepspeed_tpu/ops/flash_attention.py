@@ -8,6 +8,15 @@ inference/v2).  Online-softmax tiling keeps the [T, T] score matrix out of HBM:
 VMEM-resident (bq, bk) tiles stream through the MXU with running max/denominator
 rescaling, forward saves only the logsumexp row stats for the backward pass.
 
+Variants handled IN-KERNEL (round-3: VERDICT item 3):
+- alibi: per-head slope × key-position logit bias (bloom/falcon-rw;
+  reference v1 kernels includes/alibi.h) — slopes ride SMEM, the bias folds
+  into the online softmax and both backward kernels.
+- sliding window (mistral/gpt-neo local attention): in-tile masking PLUS
+  whole-tile skipping — (q, k) tiles wholly outside the window never run, so
+  FLOPs scale with T·window instead of T²/2.  Fully-masked rows (a window
+  that ends before the tile) are guarded so exp(s − m) cannot alias to 1.
+
 Layout convention: public API is [B, T, N, D] (batch, seq, heads, head_dim) to
 match the model code; kernels run on [B, N, T, D].
 """
@@ -19,6 +28,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -34,7 +44,8 @@ def _block_sizes(t: int, prefer: int = DEFAULT_BLOCK_Q):
     return None
 
 
-def supported(q, k, v, *, causal=True, scale=None, **_):
+def supported(q, k, v, *, causal=True, scale=None, window=None,
+              alibi_slopes=None, **_):
     """Shape predicate for the pallas path (registry.OpSpec.supported)."""
     if q.ndim != 4 or q.shape != v.shape[:2] + q.shape[2:]:
         return False
@@ -43,15 +54,55 @@ def supported(q, k, v, *, causal=True, scale=None, **_):
         return False
     if q.shape[2] % k.shape[2] != 0:  # GQA group must divide
         return False
+    if window is not None and (not causal or int(window) <= 0):
+        return False
+    if alibi_slopes is not None and (not causal
+                                     or np.size(alibi_slopes) != q.shape[2]):
+        return False
     return _block_sizes(t) is not None and d % 8 == 0
+
+
+def _run_pred(iq, ik, bq, bk, causal, window):
+    """Static-shape tile liveness: causal reach ∧ window reach.  A (iq, ik)
+    tile is dead when every (qpos, kpos) pair in it is masked — those tiles
+    are skipped entirely (the FLOP saving)."""
+    run = True
+    if causal:
+        run = (iq + 1) * bq > ik * bk
+    if window is not None:
+        # live iff the tile's max kpos reaches past min qpos - window
+        run = jnp.logical_and(run, (ik + 1) * bk + window > iq * bq)
+    return run
+
+
+def _tile_scores(q, k, iq, ik, bq, bk, scale, causal, window, slope):
+    """Scaled logits for one tile with bias and masking applied."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if slope is not None:
+        s = s + slope * kpos.astype(jnp.float32)
+    if causal or window is not None:
+        qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        valid = qpos >= kpos if causal else (qpos == qpos)
+        if window is not None:
+            valid = valid & (kpos > qpos - window)
+        s = jnp.where(valid, s, _NEG_INF)
+    return s
 
 
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, window,
+                has_alibi):
+    if has_alibi:
+        slopes_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        slopes_ref = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
+    slope = slopes_ref[pl.program_id(1)] if has_alibi else None
 
     @pl.when(ik == 0)
     def _init():
@@ -59,23 +110,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    run = (iq + 1) * bq > ik * bk if causal else True
+    run = _run_pred(iq, ik, bq, bk, causal, window)
 
     @pl.when(run)
     def _body():
         q = q_ref[0, 0]                      # [bq, d]
         k = k_ref[0, 0]                      # [bk, d]
         v = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        s = _tile_scores(q, k, iq, ik, bq, bk, scale, causal, window, slope)
         m_prev = m_scr[:, :1]                # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)               # [bq, bk] fp32
+        if window is not None:
+            # a row whose window lies wholly outside this tile: m_new is still
+            # -inf and exp(s - m_new) would alias masked entries to 1
+            p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -92,23 +142,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0, 0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
 
 
-def _fwd(q, k, v, causal, scale, interpret):
+def _fwd(q, k, v, slopes, causal, scale, window, has_alibi, interpret):
     b, n, t, d = q.shape
     group = n // k.shape[1]   # GQA: kv head = q head // group (no expansion)
     bq = bk = _block_sizes(t)
     grid = (b, n, t // bq, t // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk)
+                               bq=bq, bk=bk, window=window,
+                               has_alibi=has_alibi)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h, iq, ik: (b_, h // group, ik, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h, iq, ik: (b_, h // group, ik, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(slopes)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h, iq, ik: (b_, h // group, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h, iq, ik: (b_, h // group, ik, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0)),
             # row stats ride a [B, N, 1, T] layout: a (1, 1, 1, bq) block keeps
@@ -128,22 +184,28 @@ def _fwd(q, k, v, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse
 
 
 # ---------------------------------------------------------------- backward
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, bq, bk):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, bq, bk, window, has_alibi):
+    if has_alibi:
+        slopes_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        slopes_ref = None
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
+    slope = slopes_ref[pl.program_id(1)] if has_alibi else None
 
     @pl.when(ik == 0)
     def _init():
         dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    run = (iq + 1) * bq > ik * bk if causal else True
+    run = _run_pred(iq, ik, bq, bk, causal, window)
 
     @pl.when(run)
     def _body():
@@ -153,13 +215,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0, 0]
         lse = lse_ref[0, 0, 0][:, None]      # [bq, 1]
         delta = delta_ref[0, 0, 0][:, None]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        s = _tile_scores(q, k, iq, ik, bq, bk, scale, causal, window, slope)
         p = jnp.exp(s - lse)                 # [bq, bk]
+        if window is not None:
+            # fully-masked row: lse is -inf and exp(-inf − -inf) aliases to 1
+            p = jnp.where(lse > _NEG_INF / 2, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -172,21 +232,28 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk, nqb):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, bq, bk, nqb, group, window, has_alibi):
     # grid dim 3 fuses (q-head-in-group, q-block): dk/dv for one KV head sum
     # over every q head in its GQA group as well as every q block, so the
     # whole fused loop accumulates into one VMEM scratch
+    if has_alibi:
+        slopes_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        slopes_ref = None
     ik, j = pl.program_id(2), pl.program_id(3)
     nj = pl.num_programs(3)
     iq = j % nqb
+    slope = (slopes_ref[pl.program_id(1) * group + j // nqb]
+             if has_alibi else None)
 
     @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    run = (iq + 1) * bq > ik * bk if causal else True
+    run = _run_pred(iq, ik, bq, bk, causal, window)
 
     @pl.when(run)
     def _body():
@@ -196,13 +263,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0, 0]
         lse = lse_ref[0, 0, 0][:, None]
         delta = delta_ref[0, 0, 0][:, None]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        # NOTE the transpose of roles: scores here are [bq, bk] with q rows
+        s = _tile_scores(q, k, iq, ik, bq, bk, scale, causal, window, slope)
         p = jnp.exp(s - lse)                 # [bq, bk]
+        if window is not None:
+            p = jnp.where(lse > _NEG_INF / 2, p, 0.0)
         # dv += p^T @ do
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -221,7 +286,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
+def _bwd_impl(q, k, v, o, lse, do, slopes, causal, scale, window, has_alibi,
+              interpret):
     b, n, t, d = q.shape
     nkv = k.shape[1]
     group = n // nkv
@@ -232,10 +298,16 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
     kv_spec = pl.BlockSpec((1, 1, bk, d),
                            lambda b_, h, iq, ik: (b_, h // group, ik, 0))
     row_spec = pl.BlockSpec((1, 1, 1, bq), lambda b_, h, iq, ik: (b_, h, 0, iq))
+    dq_in_specs = [qkv_spec, kv_spec, kv_spec, qkv_spec, row_spec, row_spec]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if has_alibi:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_inputs.append(slopes)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          window=window, has_alibi=has_alibi),
         grid=(b, n, t // bq, t // bk),
-        in_specs=[qkv_spec, kv_spec, kv_spec, qkv_spec, row_spec, row_spec],
+        in_specs=dq_in_specs,
         out_specs=qkv_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -243,7 +315,7 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     # kv-major grid over KV heads: (q-head-in-group, q-block) fused innermost so
     # dk/dv accumulate the whole GQA group in VMEM scratch
@@ -255,11 +327,17 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
     row_spec2 = pl.BlockSpec(
         (1, 1, 1, bq),
         lambda b_, h, ik, j: (b_, h * group + j // nqb, 0, j % nqb))
+    dkv_in_specs = [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if has_alibi:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_inputs.append(slopes)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-                          nqb=nqb),
+                          nqb=nqb, group=group, window=window,
+                          has_alibi=has_alibi),
         grid=(b, nkv, t // bk, group * nqb),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=dkv_in_specs,
         out_specs=[kv_spec2, kv_spec2],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
@@ -269,27 +347,29 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
 # ------------------------------------------------------- custom_vjp plumbing
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, scale, interpret):
-    o, _ = _fwd(q, k, v, causal, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, slopes, causal, scale, window, has_alibi, interpret):
+    o, _ = _fwd(q, k, v, slopes, causal, scale, window, has_alibi, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, interpret):
-    o, lse = _fwd(q, k, v, causal, scale, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, slopes, causal, scale, window, has_alibi, interpret):
+    o, lse = _fwd(q, k, v, slopes, causal, scale, window, has_alibi,
+                  interpret)
+    return o, (q, k, v, slopes, o, lse)
 
 
-def _flash_bwd(causal, scale, interpret, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, causal, scale, interpret)
-    return dq, dk, dv
+def _flash_bwd(causal, scale, window, has_alibi, interpret, res, do):
+    q, k, v, slopes, o, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, slopes, causal, scale,
+                           window, has_alibi, interpret)
+    return dq, dk, dv, jnp.zeros_like(slopes)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -297,6 +377,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
+                    window: Optional[int] = None,
+                    alibi_slopes=None,
                     interpret: Optional[bool] = None):
     """Flash attention over [B, T, N, D] inputs (returns same layout).
 
@@ -304,13 +386,19 @@ def flash_attention(q, k, v, *, causal: bool = True,
     ``q_head // group`` so K/V are never expanded in HBM (the reference
     blocked_flash consumes grouped KV the same way), and dk/dv accumulate the
     whole group inside the kv-major backward kernel.
+
+    ``window``: sliding-window causal attention (key within the last
+    ``window`` positions) with dead tiles skipped — FLOPs scale with
+    T·window.  ``alibi_slopes`` [N]: per-head key-position bias.
     """
-    if not supported(q, k, v, causal=causal):
+    if not supported(q, k, v, causal=causal, window=window,
+                     alibi_slopes=alibi_slopes):
         raise ValueError(
             "flash_attention: unsupported shapes "
-            f"q={q.shape} k={k.shape} v={v.shape}; requires [B, T, N, D] with "
-            "equal q/kv seq len, kv heads dividing q heads, seq len divisible "
-            "by a power-of-two block (>=8), and head_dim % 8 == 0 "
+            f"q={q.shape} k={k.shape} v={v.shape} window={window}; requires "
+            "[B, T, N, D] with equal q/kv seq len, kv heads dividing q heads, "
+            "seq len divisible by a power-of-two block (>=8), head_dim % 8 "
+            "== 0, and window/alibi only with causal=True "
             "(ops.causal_attention dispatches to the XLA path for these)")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -319,5 +407,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    o = _flash(qt, kt, vt, causal, float(scale), bool(interpret))
+    has_alibi = alibi_slopes is not None
+    slopes = (jnp.asarray(alibi_slopes, jnp.float32).reshape(q.shape[2])
+              if has_alibi else jnp.zeros((q.shape[2],), jnp.float32))
+    o = _flash(qt, kt, vt, slopes, causal, float(scale),
+               int(window) if window is not None else None, has_alibi,
+               bool(interpret))
     return jnp.transpose(o, (0, 2, 1, 3))

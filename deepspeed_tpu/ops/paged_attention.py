@@ -30,6 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -73,8 +74,7 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
 
 
 def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
-            q_ref, k_hbm, v_hbm, o_ref,            # q/o VMEM; pages stay HBM
-            k_buf, v_buf, sem, *, bs, scale):
+            q_ref, *rest, bs, scale, window, has_alibi):
     """One (slot, kv-head) per grid step; in-kernel double-buffered DMA loop
     over exactly the slot's USED pages.
 
@@ -84,21 +84,41 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
     (~slots×heads steps) and the page loop is a `fori_loop` whose trip count is
     the slot's actual page count, with page b+1's DMA in flight while page b
     computes (pallas_guide.md double-buffering pattern) — bandwidth scales
-    with tokens attended, grid overhead scales with slots."""
+    with tokens attended, grid overhead scales with slots.
+
+    ``window``: the loop STARTS at the first page intersecting the window
+    (pages wholly before it are never DMA'd — a bandwidth win the XLA
+    fallback can't get), and in-window masking handles the partial first
+    page.  ``has_alibi``: per-head slope × key-position bias folded into the
+    online softmax (reference v1 kernels includes/alibi.h)."""
+    if has_alibi:
+        slopes_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+    else:
+        k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+        slopes_ref = None
     s, h = pl.program_id(0), pl.program_id(1)
     length = len_ref[s]
     n_pages = (length + bs - 1) // bs
     g, hd = q_ref.shape[2], q_ref.shape[3]
     q = q_ref[0, 0]                                # [g, hd]
+    if window is None:
+        p_start = 0
+        lo = jnp.int32(0)
+    else:
+        # decode query sits at position length-1; valid keys have
+        # kvpos >= length - window
+        lo = jnp.maximum(length - window, 0)
+        p_start = lo // bs
 
     def dma(hbm, buf, slot, p, way):
         return pltpu.make_async_copy(
             hbm.at[bt_ref[s, p], h], buf.at[slot], sem.at[way * 2 + slot])
 
-    @pl.when(n_pages > 0)
+    @pl.when(n_pages > p_start)
     def _warmup():
-        dma(k_hbm, k_buf, 0, 0, 0).start()
-        dma(v_hbm, v_buf, 0, 0, 1).start()
+        slot0 = jax.lax.rem(p_start, 2)
+        dma(k_hbm, k_buf, slot0, p_start, 0).start()
+        dma(v_hbm, v_buf, slot0, p_start, 1).start()
 
     def body(p, carry):
         m, l, acc = carry
@@ -118,7 +138,13 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [g, bs]
         kvpos = p * bs + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(kvpos < length, scores, _NEG_INF)
+        if has_alibi:
+            scores = scores + (slopes_ref[0, :][:, None]
+                               * kvpos.astype(jnp.float32))
+        valid = kvpos < length
+        if window is not None:
+            valid = valid & (kvpos >= lo)
+        scores = jnp.where(valid, scores, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
         pr = jnp.exp(scores - m_new)               # [g, bs]
         alpha = jnp.exp(m - m_new)
@@ -131,7 +157,7 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
     m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((g, 1), jnp.float32)
     acc0 = jnp.zeros((g, hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(p_start, n_pages, body, (m0, l0, acc0))
     l = jnp.where(l == 0.0, 1.0, l)                # inactive slot -> zeros
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
 
@@ -145,27 +171,38 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     kernel runs per-shard under shard_map (attention is independent per kv
     head, so TP needs no collective here — the reference shards its blocked
     flash the same way, model_implementations/sharding/attn.py)."""
-    if alibi_slopes is not None or window is not None:
-        raise ValueError("the pallas paged-attention kernel has no alibi "
-                         "bias or sliding window; use impl='xla'")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[1] % mesh.shape["tp"] == 0):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
         inner = functools.partial(_pallas_paged_attention_local,
-                                  scale=scale, interpret=interpret)
+                                  scale=scale, window=window,
+                                  interpret=interpret)
         kv_spec = P(None, "tp", None, None)
+        in_specs = [kv_spec, kv_spec, kv_spec, P(None, None), P(None)]
+        args = [q, k_pages, v_pages, block_table, kv_lens]
+        if alibi_slopes is not None:
+            # slopes [nkv, g] shard with the kv-head axis
+            args.append(jnp.asarray(alibi_slopes, jnp.float32).reshape(
+                q.shape[1], q.shape[2]))
+            in_specs.append(P("tp", None))
+
+        def wrapped(q_, k_, v_, bt_, lens_, *sl):
+            return inner(q_, k_, v_, bt_, lens_,
+                         alibi_slopes=sl[0] if sl else None)
         return shard_map(
-            inner, mesh=mesh,
-            in_specs=(kv_spec, kv_spec, kv_spec, P(None, None), P(None)),
+            wrapped, mesh=mesh,
+            in_specs=tuple(in_specs),
             out_specs=kv_spec, check_vma=False,
-        )(q, k_pages, v_pages, block_table, kv_lens)
+        )(*args)
     return _pallas_paged_attention_local(q, k_pages, v_pages, block_table,
-                                         kv_lens, scale=scale,
+                                         kv_lens, alibi_slopes=alibi_slopes,
+                                         window=window, scale=scale,
                                          interpret=interpret)
 
 
 def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
+                                  alibi_slopes=None, window=None,
                                   scale: Optional[float] = None,
                                   interpret: Optional[bool] = None):
     S, nkv, g, hd = q.shape
@@ -177,19 +214,32 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
         interpret = jax.default_backend() != "tpu"
     block_table = block_table.astype(jnp.int32)
     kv_lens = kv_lens.astype(jnp.int32)
+    has_alibi = alibi_slopes is not None
 
     grid = (S, nkv)
-    kernel = functools.partial(_kernel, bs=bs, scale=float(scale))
+    kernel = functools.partial(
+        _kernel, bs=bs, scale=float(scale),
+        window=int(window) if window is not None else None,
+        has_alibi=has_alibi)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, hd), lambda s, h, bt, lens: (s, h, 0, 0)),
+    ]
+    inputs = [q]
+    if has_alibi:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
+        in_specs.append(pl.BlockSpec((1, g), lambda s, h, bt, lens: (h, 0)))
+        inputs.append(slopes)
+    in_specs += [
+        pl.BlockSpec(memory_space=pl.ANY),     # k pages stay in HBM
+        pl.BlockSpec(memory_space=pl.ANY),     # v pages stay in HBM
+    ]
+    inputs += [k_pages, v_pages]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, g, hd), lambda s, h, bt, lens: (s, h, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),     # k pages stay in HBM
-                pl.BlockSpec(memory_space=pl.ANY),     # v pages stay in HBM
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, g, hd),
                                    lambda s, h, bt, lens: (s, h, 0, 0)),
             scratch_shapes=[
@@ -205,18 +255,20 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_table, kv_lens, q, k_pages, v_pages)
+    )(block_table, kv_lens, *inputs)
     return out
 
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
               alibi_slopes=None, window=None, interpret=None, mesh=None):
-    if alibi_slopes is not None or window is not None:
-        return False               # alibi/window ride the XLA fallback
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
     NB, nkv2, bs, hd2 = k_pages.shape
+    if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
+        return False
+    if window is not None and int(window) <= 0:
+        return False
     return (nkv == nkv2 and hd == hd2 and hd % 8 == 0 and bs % 8 == 0
             and block_table.ndim == 2 and block_table.shape[0] == S)
 
