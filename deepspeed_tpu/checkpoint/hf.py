@@ -265,7 +265,11 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             nkv = 1
         else:
             nkv = heads
-        parallel = bool(hf.get("parallel_attn", True))
+        # HF Falcon ignores parallel_attn entirely when
+        # new_decoder_architecture is set (modeling_falcon: the new layout is
+        # always parallel ln_attn/ln_mlp) — honoring a parallel_attn=false
+        # there would silently serve a sequential-residual model
+        parallel = new_arch or bool(hf.get("parallel_attn", True))
         # falcon-40b pairs ln_attn/ln_mlp; falcon-11B (num_ln_in_parallel_attn
         # =1) shares one input_layernorm like the 7b layout
         num_ln = hf.get("num_ln_in_parallel_attn")

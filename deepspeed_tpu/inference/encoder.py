@@ -23,13 +23,22 @@ _DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
 
 def _resolve_mesh_dtype(config, mesh):
     """Shared engine setup: decoder-style config normalization
-    (tensor_parallel int shorthand / tp alias), mesh build, dtype resolve."""
+    (tensor_parallel int shorthand / tp alias), mesh build, dtype resolve.
+
+    Encoders consume only dtype/tensor_parallel — any other key the decoder
+    path honors (max_seq_len, quant, ...) must WARN, not vanish (the same
+    inert-knob policy as config.warn_inert_config)."""
     from deepspeed_tpu.inference.config import parse_inference_config
     from deepspeed_tpu.parallel import mesh as mesh_lib
+    from deepspeed_tpu.utils.logging import logger
     config = dict(config or {})
+    consumed = ("dtype", "tensor_parallel", "tp")
+    for k in sorted(set(config) - set(consumed)):
+        logger.warning(f"inference config key {k!r} is not consumed by the "
+                       f"encoder engines (only {consumed} are) — this run "
+                       f"will NOT honor it")
     known = parse_inference_config(
-        {k: v for k, v in config.items()
-         if k in ("dtype", "tensor_parallel", "tp")})
+        {k: v for k, v in config.items() if k in consumed})
     if mesh is None:
         mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(
             tp=known.tensor_parallel.tp_size, dp=1, fsdp=1))
@@ -63,6 +72,24 @@ def _coerce_ids(input_ids, max_seq_len):
         raise ValueError(f"input length {ids.shape[1]} exceeds max_seq_len "
                          f"{max_seq_len}")
     return ids
+
+
+def _bucket(n: int, cap: Optional[int] = None) -> int:
+    """Next power of two ≥ n (capped) — bounds the jit program count the way
+    the decoder engines' padded shapes do (one compile per bucket, not per
+    raw (batch, seq) pair)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap) if cap else b
+
+
+def _pad_to(x, B, T=None):
+    pads = [(0, B - x.shape[0])]
+    if T is not None:
+        pads.append((0, T - x.shape[1]))
+    pads += [(0, 0)] * (x.ndim - len(pads))
+    return jnp.pad(x, pads)
 
 
 class EncoderInferenceEngine:
@@ -128,8 +155,17 @@ class EncoderInferenceEngine:
                  else jnp.asarray(np.asarray(token_type_ids), jnp.int32))
         mask = (jnp.ones_like(ids) if attention_mask is None
                 else jnp.asarray(np.asarray(attention_mask), jnp.int32))
+        # pad to power-of-two (batch, seq) buckets — one compile per bucket;
+        # padded tokens carry mask=0 so the bidirectional attention never
+        # sees them, and outputs slice back to the raw shape
+        B, T = ids.shape
+        Bb = _bucket(B)
+        Tb = _bucket(T, self.model_config.max_seq_len)
         with self.mesh:
-            return self._fwd(self.params, ids, types, mask)
+            out = self._fwd(self.params, _pad_to(ids, Bb, Tb),
+                            _pad_to(types, Bb, Tb),
+                            _pad_to(mask, Bb, Tb))
+        return out[:B, :T] if out.ndim >= 3 else out[:B]
 
     __call__ = forward
 
@@ -186,7 +222,15 @@ class ClipTextEngine:
 
     def forward(self, input_ids):
         ids = _coerce_ids(input_ids, self.model_config.max_seq_len)
+        # power-of-two buckets; trailing pad is invisible to the causal
+        # attention at real positions, and the pooled index lands on a real
+        # token, so slicing the pads back off is exact
+        B, T = ids.shape
+        Bb = _bucket(B)
+        Tb = _bucket(T, self.model_config.max_seq_len)
         with self.mesh:
-            return self._fwd(self.params, self._proj, ids)
+            hidden, pooled = self._fwd(self.params, self._proj,
+                                       _pad_to(ids, Bb, Tb))
+        return hidden[:B, :T], pooled[:B]
 
     __call__ = forward

@@ -46,27 +46,33 @@ def _conv_flops(eqn) -> int:
     return out_size * kernel_work
 
 
-def _walk(jaxpr, scale: int, acc: Dict[str, int]) -> None:
+def _walk(jaxpr, scale: int, acc: Dict[str, int],
+          meta: Optional[dict] = None) -> None:
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
         if prim == "scan":
             _walk(eqn.params["jaxpr"].jaxpr, scale * int(eqn.params["length"]),
-                  acc)
+                  acc, meta)
         elif prim == "while":
-            # trip count unknown at trace time: count one body iteration
-            _walk(eqn.params["body_jaxpr"].jaxpr, scale, acc)
+            # trip count unknown at trace time: count ONE body iteration and
+            # flag the undercount so the report can disclose it (decode loops
+            # — lax.while_loop generation — are undercounted by their trip
+            # count; transformer train steps contain no while)
+            if meta is not None:
+                meta["has_while"] = True
+            _walk(eqn.params["body_jaxpr"].jaxpr, scale, acc, meta)
         elif prim == "cond":
             for br in eqn.params["branches"]:
-                _walk(br.jaxpr, scale, acc)  # upper bound over branches
+                _walk(br.jaxpr, scale, acc, meta)  # upper bound over branches
         elif prim in ("custom_vjp_call", "custom_jvp_call",
                       "custom_vjp_call_jaxpr", "remat", "checkpoint"):
             inner = (eqn.params.get("fun_jaxpr") or eqn.params.get("call_jaxpr")
                      or eqn.params.get("jaxpr"))
             if inner is not None:
-                _walk(getattr(inner, "jaxpr", inner), scale, acc)
+                _walk(getattr(inner, "jaxpr", inner), scale, acc, meta)
         elif sub is not None:  # pjit / closed_call / named calls
-            _walk(getattr(sub, "jaxpr", sub), scale, acc)
+            _walk(getattr(sub, "jaxpr", sub), scale, acc, meta)
         elif prim == "dot_general":
             path = str(eqn.source_info.name_stack)
             acc[path] = acc.get(path, 0) + scale * _dot_flops(eqn)
@@ -75,15 +81,18 @@ def _walk(jaxpr, scale: int, acc: Dict[str, int]) -> None:
             acc[path] = acc.get(path, 0) + scale * _conv_flops(eqn)
 
 
-def jaxpr_flops_by_module(fn, *args, **kwargs) -> Dict[str, int]:
+def jaxpr_flops_by_module(fn, *args, meta: Optional[dict] = None,
+                          **kwargs) -> Dict[str, int]:
     """Trace ``fn(*args)`` and return {module-path: matmul/conv flops}.
 
     Paths come from equation name stacks (flax module scopes); an empty path
-    collects top-level ops.
+    collects top-level ops.  Pass a ``meta`` dict to receive trace flags
+    (``has_while``: the count visits while bodies once, undercounting
+    data-dependent loops).
     """
     closed = jax.make_jaxpr(fn, **kwargs)(*args)
     acc: Dict[str, int] = {}
-    _walk(closed.jaxpr, 1, acc)
+    _walk(closed.jaxpr, 1, acc, meta)
     return acc
 
 
@@ -114,11 +123,14 @@ class FlopsProfiler:
         self.xla_flops = None       # XLA cost-analysis flops, if available
         self.latency = 0.0          # measured seconds per step
         self.by_module: Dict[str, int] = {}
+        self.has_while = False      # report must disclose loop undercount
 
     def count(self, fn, *args, static_kwargs: Optional[dict] = None):
         """Trace-only flop count (no execution, safe with donated jit args)."""
-        self.by_module = jaxpr_flops_by_module(fn, *args,
+        meta: dict = {}
+        self.by_module = jaxpr_flops_by_module(fn, *args, meta=meta,
                                                **(static_kwargs or {}))
+        self.has_while = bool(meta.get("has_while"))
         self.flops = sum(self.by_module.values())
         return self
 
@@ -165,6 +177,11 @@ class FlopsProfiler:
                            for l in jax.tree_util.tree_leaves(params))
             lines.append(f"params per device:      {_num(n_params)}")
         lines.append(f"flops per step (jaxpr): {_num(self.flops, 'FLOPs')}")
+        if getattr(self, "has_while", False):
+            lines.append(
+                "NOTE: the step contains lax.while_loop(s); their bodies are "
+                "counted ONCE (trip count is data-dependent) — the jaxpr "
+                "figure UNDERCOUNTS loops such as decode generation")
         if self.xla_flops:
             lines.append(f"flops per step (XLA):   "
                          f"{_num(self.xla_flops, 'FLOPs')}")

@@ -24,6 +24,29 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# ---- quick tier (VERDICT r2 weak #10): `pytest -m quick` runs the core-
+# correctness slice in a few minutes, for the fast inner loop; the full
+# suite stays the merge gate.
+QUICK_MODULES = {
+    "test_config.py", "test_mesh_partition.py", "test_engine.py",
+    "test_ops.py", "test_offload.py", "test_observability.py",
+    "test_pipeline.py", "test_moe.py", "test_ulysses.py",
+    "test_infinity.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: fast core-correctness tier (pytest -m quick)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for it in items:
+        mod = it.nodeid.split("::")[0].rsplit("/", 1)[-1]
+        if mod in QUICK_MODULES:
+            it.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
