@@ -5,19 +5,31 @@ Matches BASELINE.md config 2 ("GPT-2-small fine-tune, ZeRO-2, bf16") scaled to t
 single available chip.  Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 vs_baseline = achieved MFU / 0.35 (the driver's north-star MFU target for the
-training path, BASELINE.json).
+training path, BASELINE.json).  "extra" carries secondary legs: long-seq flash,
+ZeRO-3, and the FastGen-analog serving throughput (ragged-vs-static ratio).
+
+Robustness (round-2 VERDICT item 2): the bench body runs in a SUBPROCESS under
+a timeout with bounded retries — the axon TPU backend has been observed both to
+raise UNAVAILABLE at init and to hang indefinitely; either way the driver gets
+a clean one-line JSON verdict (with an "error" field on total failure), never a
+stack trace or a hung process.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import numpy as np
+METRIC = "gpt2s_zero2_bf16_train_tokens_per_sec_per_chip"
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 540))
+RETRIES = int(os.environ.get("BENCH_RETRIES", 3))
 
 
 def peak_flops_per_chip() -> float:
     """bf16 peak for the local chip generation."""
+    import jax
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "").lower()
     if "v5 lite" in kind or "v5e" in kind:
@@ -41,6 +53,7 @@ def _measure(engine, batch, iters=8):
     """Warmup/compile then timed steps.  The value fetch is the sync: step N
     depends on state N-1, so fetching the last loss drains the whole chain
     (block_until_ready is not reliable through the remote-TPU relay)."""
+    import jax
     for _ in range(3):
         m = engine.train_batch(batch)
     jax.device_get(m.loss)
@@ -99,19 +112,69 @@ def _extra_points(GPTChunkedLoss, GPTConfig, initialize):
         del eng
     except Exception as e:  # noqa: BLE001
         out["zero3_error"] = str(e)[:120]
+    out.update(_serving_point())
     return out
 
 
-def main():
+def _serving_point():
+    """FastGen-analog serving leg (compact form of bench_serving.py): ragged
+    continuous-batching generate tokens/s and its ratio over the static v1
+    baseline on the same weights."""
+    import dataclasses
+
+    import numpy as np
+    out = {}
+    try:
+        import jax.numpy as jnp
+        from bench_serving import run_v1, run_v2
+        from deepspeed_tpu.models import GPTConfig
+        cfg = GPTConfig.llama(num_layers=12, hidden=1024, heads=16,
+                              num_kv_heads=4, vocab_size=32000,
+                              max_seq_len=2048, dtype=None)
+        cfg = dataclasses.replace(cfg, dtype=jnp.bfloat16)
+        rng = np.random.default_rng(0)
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        seed_eng = InferenceEngineV2(cfg, {"state_manager": {
+            "max_tracked_sequences": 4, "kv_block_size": 64}}, seed=0)
+        params = seed_eng.params
+        del seed_eng
+        prompts = [rng.integers(0, cfg.vocab_size,
+                                size=int(rng.integers(32, 513))
+                                ).astype(np.int32) for _ in range(16)]
+        v2_tps = run_v2(cfg, params, prompts, 64)
+        v1_tps, _ = run_v1(cfg, params, prompts, 64)
+        out["serving_ragged_tokens_per_sec"] = round(v2_tps, 1)
+        out["serving_static_tokens_per_sec"] = round(v1_tps, 1)
+        out["serving_ragged_vs_static"] = round(v2_tps / v1_tps, 3)
+    except Exception as e:  # noqa: BLE001
+        out["serving_error"] = str(e)[:160]
+    return out
+
+
+def run_bench():
+    """The actual measurement (runs inside the supervised subprocess)."""
+    import jax
+    if os.environ.get("BENCH_SMOKE") or os.environ.get("BENCH_FORCE_CPU"):
+        # plumbing tests run CPU-sized on the host (the axon sitecustomize
+        # forces the TPU platform; this wins it back pre-init)
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
     import deepspeed_tpu
-    from deepspeed_tpu.models import GPT, GPTChunkedLoss, GPTConfig
+    from deepspeed_tpu.models import GPTChunkedLoss, GPTConfig
 
     # chunked cross-entropy (ops/cross_entropy.py) keeps the fp32 logits out of
     # HBM, so batch 32 fits; flash attention (ops/flash_attention.py) keeps the
     # [T, T] scores out of HBM
-    BATCH, SEQ = 32, 1024
-    cfg_model = GPTConfig.gpt2_small(vocab_size=50304, max_seq_len=SEQ,
-                                     dropout=0.0, loss_chunk=1024)
+    smoke = bool(os.environ.get("BENCH_SMOKE"))   # plumbing test (CPU-sized)
+    BATCH, SEQ = (2, 64) if smoke else (32, 1024)
+    if smoke:
+        cfg_model = GPTConfig(num_layers=2, num_heads=4, head_dim=16,
+                              hidden_size=64, vocab_size=512, max_seq_len=SEQ,
+                              dropout=0.0, loss_chunk=64)
+    else:
+        cfg_model = GPTConfig.gpt2_small(vocab_size=50304, max_seq_len=SEQ,
+                                         dropout=0.0, loss_chunk=1024)
     model = GPTChunkedLoss(cfg_model)
     config = {
         "train_micro_batch_size_per_gpu": BATCH,
@@ -124,7 +187,8 @@ def main():
         "steps_per_print": 0,
     }
     rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, 50304, size=(BATCH, SEQ)).astype(np.int32)}
+    batch = {"input_ids": rng.integers(0, cfg_model.vocab_size,
+                                       size=(BATCH, SEQ)).astype(np.int32)}
     example = {"input_ids": np.zeros((BATCH, SEQ), np.int32)}
 
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config,
@@ -141,15 +205,90 @@ def main():
              "params_m": round(engine.num_parameters / 1e6, 1),
              "loss": float(m.loss)}
     del engine
-    extra.update(_extra_points(GPTChunkedLoss, GPTConfig,
-                               deepspeed_tpu.initialize))
+    if not smoke:
+        extra.update(_extra_points(GPTChunkedLoss, GPTConfig,
+                                   deepspeed_tpu.initialize))
     print(json.dumps({
-        "metric": "gpt2s_zero2_bf16_train_tokens_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4),
         "extra": extra,
     }))
+    return 0
+
+
+def _probe_backend():
+    """Can a fresh interpreter see the TPU at all?  (cheap, bounded)"""
+    force_cpu = (os.environ.get("BENCH_SMOKE")
+                 or os.environ.get("BENCH_FORCE_CPU"))
+    pre = ("import jax; "
+           + ("jax.config.update('jax_platforms', 'cpu'); " if force_cpu
+              else ""))
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             pre + "d = jax.devices(); print(len(d), d[0].platform)"],
+            timeout=PROBE_TIMEOUT_S, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        if p.returncode == 0:
+            return True, p.stdout.strip()
+        return False, (p.stderr.strip().splitlines() or ["?"])[-1][:200]
+    except subprocess.TimeoutExpired:
+        return False, f"jax.devices() hung > {PROBE_TIMEOUT_S}s (backend init)"
+
+
+def main():
+    if "--run" in sys.argv:
+        return run_bench()
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    last_err = "unknown"
+    deadline = time.time() + int(os.environ.get("BENCH_TOTAL_BUDGET", 1500))
+    for attempt in range(1, RETRIES + 1):
+        if time.time() > deadline:
+            last_err += " (total budget exhausted)"
+            break
+        ok, info = _probe_backend()
+        if not ok:
+            last_err = info
+            print(f"bench: probe {attempt}/{RETRIES} failed: {info}",
+                  file=sys.stderr)
+            time.sleep(15 * attempt)
+            continue
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                                "--run"],
+                               timeout=ATTEMPT_TIMEOUT_S, capture_output=True,
+                               text=True, cwd=here)
+        except subprocess.TimeoutExpired:
+            last_err = f"bench body hung > {ATTEMPT_TIMEOUT_S}s"
+            print(f"bench: attempt {attempt}/{RETRIES}: {last_err}",
+                  file=sys.stderr)
+            continue
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(obj, dict) and obj.get("metric") == METRIC:
+                print(line)
+                return 0
+        last_err = ((p.stderr.strip().splitlines() or ["no JSON line"])[-1]
+                    [:300])
+        print(f"bench: attempt {attempt}/{RETRIES} rc={p.returncode}: "
+              f"{last_err}", file=sys.stderr)
+        time.sleep(15)
+    # total failure: still ONE clean JSON line, not a stack trace / rc=1
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": f"TPU backend unavailable after {RETRIES} attempts: "
+                 f"{last_err}",
+    }))
+    return 0
 
 
 if __name__ == "__main__":
