@@ -267,10 +267,11 @@ class InferenceEngineV2:
         # small set of compiled programs: a decode-only step (Q=1, Pallas
         # paged attention — the steady-state hot path, ragged_decode_forward)
         # plus one mixed prefill step per power-of-two BLOCK-TABLE-WIDTH
-        # bucket: prefill attention cost scales with the LONGEST sequence in
-        # this step, not the pool-wide per-sequence allocation (reference
-        # atom_builder sizes attention atoms by actual kv length the same
-        # way).  Buckets: ≤ log2(MB) programs.
+        # bucket (≤ log2(MB) programs).  Since round 3 the bucket width only
+        # bounds LAYOUT: the ragged-prefill Pallas kernel skips dead
+        # (slot, q-chunk) tiles and walks each slot's pages up to its actual
+        # kv length, so attention FLOPs/bandwidth scale with Σ live tokens,
+        # not the bucket (reference atom_builder + blocked_flash).
         sm = self.config.state_manager
         if int(rb.q_len.max()) <= 1:
             return self._run_decode(rb)

@@ -158,7 +158,10 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     off = token_pos % block_size                             # [N]
     big = jnp.iinfo(jnp.int32).max
     scat_slot = jnp.where(valid, token_slot, S)              # S = out of range
-    kvpos = jnp.arange(MB * block_size)[None, :]             # [1, Kmax]
+    # per-slot live q rows + their first logical position (each slot's batch
+    # tokens are one CONTIGUOUS span ending at kv_len — SplitFuse chunks)
+    q_counts = jnp.zeros((S,), jnp.int32).at[scat_slot].add(1, mode="drop")
+    q_starts = kv_len - q_counts
 
     # [L * num_blocks, nkv, bs, hd] views updated IN PLACE through the
     # donated cache buffer — never rebuild the whole pool (a jnp.stack of
@@ -186,40 +189,29 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         flat_v_all = flat_v_all.at[page_li, :, off].set(
             v.astype(flat_v_all.dtype), mode="drop")
 
-        # ---- blocked attention (reference blocked_flash), dense-per-slot ----
+        # ---- ragged blocked attention (reference blocked_flash +
+        # atom_builder): dense-per-slot q layout, per-slot contiguous
+        # position spans; the Pallas kernel DMAs only the pages each
+        # (slot, q-chunk) can causally see, so prefill cost scales with
+        # Σ live tokens instead of S × longest (round-3 VERDICT item 4) ----
+        nkv, hd = cfg.kv_heads, cfg.head_dim
+        gq = cfg.num_heads // nkv
         q_dense = jnp.zeros((S, Q) + q.shape[1:], q.dtype).at[
             scat_slot, dense_idx].set(q, mode="drop")
-        qpos_dense = jnp.zeros((S, Q), jnp.int32).at[
-            scat_slot, dense_idx].set(token_pos, mode="drop")
-        # gather this slot's pages: [S, MB, nkv, bs, hd] -> [S, Kmax, nkv, hd]
-        k_pages = jnp.swapaxes(flat_k_all[li * NB + block_table], 2, 3
-                               ).reshape(S, MB * block_size, cfg.kv_heads,
-                                         cfg.head_dim)
-        v_pages = jnp.swapaxes(flat_v_all[li * NB + block_table], 2, 3
-                               ).reshape(S, MB * block_size, cfg.kv_heads,
-                                         cfg.head_dim)
-        # causal over logical positions + kv-length bound; gathered slot j has
-        # logical position j because blocks are appended in order
-        mask = (kvpos[:, None, :] <= qpos_dense[:, :, None]) & \
-               (kvpos[:, None, :] < kv_len[:, None, None])   # [S, Q, Kmax]
-        win = cfg.window_for_layer(li)
-        if win is not None:
-            mask = mask & (kvpos[:, None, :]
-                           > qpos_dense[:, :, None] - win)
         from deepspeed_tpu import ops
-        bias = None
+        win = cfg.window_for_layer(li)
+        slopes = None
         if cfg.use_alibi:
             from deepspeed_tpu.models.gpt import alibi_slopes
-            s = jnp.asarray(alibi_slopes(cfg.num_heads, cfg.head_dim,
-                                         cfg.alibi_prescale))
-            # key logical position == gathered index (pages are in order)
-            bias = s[None, :, None, None] * kvpos[:, None, None, :].astype(
-                jnp.float32)
-        o_dense = ops.causal_attention(q_dense.astype(dtype),
-                                       k_pages.astype(dtype),
-                                       v_pages.astype(dtype),
-                                       causal=False, mask=mask, bias=bias,
-                                       scale=cfg.attn_scale)
+            slopes = jnp.asarray(alibi_slopes(cfg.num_heads, cfg.head_dim,
+                                              cfg.alibi_prescale))
+        k_pool = jax.lax.dynamic_slice_in_dim(flat_k_all, li * NB, NB)
+        v_pool = jax.lax.dynamic_slice_in_dim(flat_v_all, li * NB, NB)
+        o_dense = ops.ragged_prefill_attention(
+            q_dense.reshape(S, Q, nkv, gq, hd).astype(dtype),
+            k_pool.astype(dtype), v_pool.astype(dtype), block_table, kv_len,
+            q_starts, q_counts, scale=cfg.attn_scale, alibi_slopes=slopes,
+            window=win, mesh=mesh).reshape(S, Q, cfg.num_heads, hd)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
         attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")

@@ -109,14 +109,24 @@ register_op("causal_attention", xla=_attention_xla, pallas=_attention_pallas,
             supported=_attention_supported)
 
 from deepspeed_tpu.ops import paged_attention as _paged  # noqa: E402
-from deepspeed_tpu.ops.paged_attention import paged_attention  # noqa: E402
+from deepspeed_tpu.ops.paged_attention import (  # noqa: E402
+    paged_attention, ragged_prefill_attention)
 
 register_op("paged_attention", xla=_paged.xla_paged_attention,
             pallas=_paged.pallas_paged_attention, supported=_paged.supported)
+register_op("ragged_prefill_attention", xla=_paged.xla_ragged_prefill,
+            pallas=_paged.pallas_ragged_prefill,
+            supported=_paged.ragged_prefill_supported)
 
 from deepspeed_tpu.ops.evoformer import evoformer_attention  # noqa: E402
 
 register_op("evoformer_attention", xla=evoformer_attention)
+
+from deepspeed_tpu.ops import sparse_attention as _sparse  # noqa: E402
+
+register_op("sparse_attention", xla=_sparse._sparse_xla,
+            pallas=_sparse._sparse_pallas,
+            supported=_sparse.block_sparse_supported)
 
 
 def causal_attention(q, k, v, *, causal: bool = True,
@@ -137,6 +147,6 @@ def causal_attention(q, k, v, *, causal: bool = True,
 
 
 __all__ = ["causal_attention", "flash_attention", "paged_attention",
-           "evoformer_attention",
+           "ragged_prefill_attention", "evoformer_attention",
            "lm_cross_entropy", "masked_nll_sum", "rms_norm", "layer_norm",
            "op_report", "register_op", "dispatch", "list_ops", "registry"]

@@ -284,3 +284,270 @@ def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     return dispatch("paged_attention", q, k_pages, v_pages, block_table,
                     kv_lens, scale=scale, alibi_slopes=alibi_slopes,
                     window=window, impl=impl, interpret=interpret, mesh=mesh)
+
+
+# ===================================================================
+# Ragged prefill (VERDICT r2 item 4 — reference blocked_flash + atom_builder)
+# ===================================================================
+#
+# Mixed prefill/decode batches arrive as a dense-per-slot query layout
+# [S, Q, nkv, g, hd] where slot s owns ``q_counts[s]`` live rows holding the
+# CONTIGUOUS positions [q_starts[s], q_starts[s] + q_counts[s]); its KV —
+# including the rows just appended — lives in ``kv_lens[s]`` tokens across
+# the slot's block-table pages.  The XLA fallback gathers every slot's full
+# page span and runs one masked-dense attention (cost O(S · Q · MBmax·bs));
+# the Pallas kernel instead grids over (slot, kv head, q-chunk) and runs the
+# decode kernel's double-buffered HBM→VMEM DMA loop over ONLY the pages the
+# chunk can causally see — dead (slot, chunk) pairs are skipped outright, so
+# FLOPs and bandwidth scale with Σ live tokens, not S × longest.
+
+
+def xla_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
+                       q_counts, *, scale: Optional[float] = None,
+                       alibi_slopes=None, window=None, interpret=None,
+                       mesh=None):
+    """Ground-truth gather + masked-dense path (the round-2 prefill body)."""
+    S, Q, nkv, g, hd = q.shape
+    NB, _, bs, _ = k_pages.shape
+    MB = block_table.shape[1]
+    if scale is None:
+        scale = hd ** -0.5
+    k_seq = jnp.swapaxes(k_pages[block_table], 2, 3).reshape(
+        S, MB * bs, nkv, hd)
+    v_seq = jnp.swapaxes(v_pages[block_table], 2, 3).reshape(
+        S, MB * bs, nkv, hd)
+    kvpos = jnp.arange(MB * bs)                                # [K]
+    rows = jnp.arange(Q)
+    qpos = q_starts[:, None] + rows[None, :]                   # [S, Q]
+    live = rows[None, :] < q_counts[:, None]                   # [S, Q]
+    mask = (kvpos[None, None, :] <= qpos[:, :, None]) \
+        & (kvpos[None, None, :] < kv_lens[:, None, None]) \
+        & live[:, :, None]                                     # [S, Q, K]
+    if window is not None:
+        mask = mask & (kvpos[None, None, :] > qpos[:, :, None] - window)
+    s_log = jnp.einsum("sqngd,sknd->snqgk", q, k_seq,
+                       preferred_element_type=jnp.float32) * scale
+    if alibi_slopes is not None:
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
+        s_log = s_log + (sl[None, :, None, :, None]
+                         * kvpos[None, None, None, None, :].astype(
+                             jnp.float32))
+    m = mask[:, None, :, None, :]                              # [S,1,Q,1,K]
+    s_log = jnp.where(m, s_log, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(s_log, axis=-1)
+    probs = jnp.where(m.any(-1, keepdims=True), probs, 0.0)
+    return jnp.einsum("snqgk,sknd->sqngd", probs.astype(q.dtype), v_seq)
+
+
+def _prefill_kernel(bt_ref, len_ref, start_ref, count_ref,   # scalar prefetch
+                    q_ref, *rest, bs, cq, g, scale, window, has_alibi):
+    if has_alibi:
+        slopes_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+    else:
+        k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+        slopes_ref = None
+    s, h, c = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    count = count_ref[s]
+    start = start_ref[s]
+    length = len_ref[s]
+    hd = q_ref.shape[4]
+    row0 = c * cq
+    live = row0 < count
+    # pages the chunk can causally see: up to its LAST live row's position
+    last_pos = start + jnp.minimum(count, row0 + cq) - 1
+    n_pages = jnp.where(live, (last_pos + bs) // bs, 0)
+    if window is None:
+        p_start = jnp.int32(0)
+    else:
+        # the chunk's FIRST row's window start bounds every row's from below
+        p_start = jnp.maximum(start + row0 - window + 1, 0) // bs
+
+    def dma(hbm, buf, slot, p, way):
+        return pltpu.make_async_copy(
+            hbm.at[bt_ref[s, p], h], buf.at[slot], sem.at[way * 2 + slot])
+
+    @pl.when(n_pages > p_start)
+    def _warmup():
+        slot0 = jax.lax.rem(p_start, 2)
+        dma(k_hbm, k_buf, slot0, p_start, 0).start()
+        dma(v_hbm, v_buf, slot0, p_start, 1).start()
+
+    q = q_ref[0, :, 0].reshape(cq * g, hd)         # [cq·g, hd] row r=(j·g+gi)
+    rown = jax.lax.broadcasted_iota(jnp.int32, (cq * g, bs), 0) // g
+    qpos = start + row0 + rown                     # [cq·g, bs]
+    row_live = row0 + rown < count
+    if has_alibi:
+        slope_rows = jnp.broadcast_to(slopes_ref[0, :][None, :],
+                                      (cq, g)).reshape(cq * g, 1)
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p, 2)
+        nxt = jax.lax.rem(p + 1, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _prefetch():
+            dma(k_hbm, k_buf, nxt, p + 1, 0).start()
+            dma(v_hbm, v_buf, nxt, p + 1, 1).start()
+
+        dma(k_hbm, k_buf, slot, p, 0).wait()
+        dma(v_hbm, v_buf, slot, p, 1).wait()
+        k = k_buf[slot]                            # [bs, hd]
+        v = v_buf[slot]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [cq·g, bs]
+        kvpos = p * bs + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        if has_alibi:
+            scores = scores + slope_rows * kvpos.astype(jnp.float32)
+        valid = (kvpos <= qpos) & (kvpos < length) & row_live
+        if window is not None:
+            valid = valid & (kvpos > qpos - window)
+        scores = jnp.where(valid, scores, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+        pr = jnp.exp(scores - m_new)
+        # a row with no valid key in this page AND none so far: m_new is
+        # still -inf and exp aliases to 1 — zero it (dead rows, early rows
+        # of a later page under a window)
+        pr = jnp.where(m_new > _NEG_INF / 2, pr, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(pr, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(pr.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return m_new, l, acc * alpha + pv
+
+    m0 = jnp.full((cq * g, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((cq * g, 1), jnp.float32)
+    acc0 = jnp.zeros((cq * g, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(p_start, n_pages, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)                # dead rows -> zeros
+    o_ref[0, :, 0] = (acc / l).reshape(cq, g, hd).astype(o_ref.dtype)
+
+
+def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
+                          q_counts, *, scale: Optional[float] = None,
+                          alibi_slopes=None, window=None,
+                          interpret: Optional[bool] = None, mesh=None):
+    if (mesh is not None and mesh.shape.get("tp", 1) > 1
+            and q.shape[2] % mesh.shape["tp"] == 0):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        inner = functools.partial(_pallas_ragged_prefill_local, scale=scale,
+                                  window=window, interpret=interpret)
+        q_spec = P(None, None, "tp", None, None)
+        kv_spec = P(None, "tp", None, None)
+        in_specs = [q_spec, kv_spec, kv_spec, P(None, None), P(None),
+                    P(None), P(None)]
+        args = [q, k_pages, v_pages, block_table, kv_lens, q_starts, q_counts]
+        if alibi_slopes is not None:
+            args.append(jnp.asarray(alibi_slopes, jnp.float32).reshape(
+                q.shape[2], q.shape[3]))
+            in_specs.append(P("tp", None))
+
+        def wrapped(q_, k_, v_, bt_, lens_, st_, ct_, *sl):
+            return inner(q_, k_, v_, bt_, lens_, st_, ct_,
+                         alibi_slopes=sl[0] if sl else None)
+        return shard_map(
+            wrapped, mesh=mesh, in_specs=tuple(in_specs),
+            out_specs=q_spec, check_vma=False,
+        )(*args)
+    return _pallas_ragged_prefill_local(
+        q, k_pages, v_pages, block_table, kv_lens, q_starts, q_counts,
+        scale=scale, alibi_slopes=alibi_slopes, window=window,
+        interpret=interpret)
+
+
+def _prefill_chunk(Q: int) -> Optional[int]:
+    for cq in (128, 64, 32, 16, 8, 4, 2, 1):
+        if cq <= Q and Q % cq == 0:
+            return cq
+    return None
+
+
+def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
+                                 q_starts, q_counts, *,
+                                 scale: Optional[float] = None,
+                                 alibi_slopes=None, window=None,
+                                 interpret: Optional[bool] = None):
+    S, Q, nkv, g, hd = q.shape
+    NB, _, bs, _ = k_pages.shape
+    if scale is None:
+        scale = hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    cq = _prefill_chunk(Q)
+    block_table = block_table.astype(jnp.int32)
+    kv_lens = kv_lens.astype(jnp.int32)
+    q_starts = q_starts.astype(jnp.int32)
+    q_counts = q_counts.astype(jnp.int32)
+    has_alibi = alibi_slopes is not None
+
+    grid = (S, nkv, Q // cq)
+    kernel = functools.partial(
+        _prefill_kernel, bs=bs, cq=cq, g=g, scale=float(scale),
+        window=int(window) if window is not None else None,
+        has_alibi=has_alibi)
+    in_specs = [
+        pl.BlockSpec((1, cq, 1, g, hd),
+                     lambda s, h, c, *_: (s, c, h, 0, 0)),
+    ]
+    inputs = [q]
+    if has_alibi:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
+        in_specs.append(pl.BlockSpec((1, g), lambda s, h, c, *_: (h, 0)))
+        inputs.append(slopes)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    inputs += [k_pages, v_pages]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, cq, 1, g, hd),
+                                   lambda s, h, c, *_: (s, c, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, bs, hd), k_pages.dtype),
+                pltpu.VMEM((2, bs, hd), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((4,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, Q, nkv, g, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(block_table, kv_lens, q_starts, q_counts, *inputs)
+    return out
+
+
+def ragged_prefill_supported(q, k_pages, v_pages, block_table, kv_lens,
+                             q_starts, q_counts, *, scale=None,
+                             alibi_slopes=None, window=None, interpret=None,
+                             mesh=None):
+    if q.ndim != 5 or k_pages.ndim != 4:
+        return False
+    S, Q, nkv, g, hd = q.shape
+    NB, nkv2, bs, hd2 = k_pages.shape
+    if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
+        return False
+    if window is not None and int(window) <= 0:
+        return False
+    return (nkv == nkv2 and hd == hd2 and hd % 8 == 0 and bs % 8 == 0
+            and _prefill_chunk(Q) is not None
+            and block_table.ndim == 2 and block_table.shape[0] == S)
+
+
+def ragged_prefill_attention(q, k_pages, v_pages, block_table, kv_lens,
+                             q_starts, q_counts, *,
+                             scale: Optional[float] = None,
+                             alibi_slopes=None, window=None,
+                             impl: Optional[str] = None,
+                             interpret: Optional[bool] = None, mesh=None):
+    """Registry entry for the ragged prefill kernel."""
+    from deepspeed_tpu.ops.registry import dispatch
+    return dispatch("ragged_prefill_attention", q, k_pages, v_pages,
+                    block_table, kv_lens, q_starts, q_counts, scale=scale,
+                    alibi_slopes=alibi_slopes, window=window, impl=impl,
+                    interpret=interpret, mesh=mesh)
