@@ -55,7 +55,8 @@ class GPTConfig:
     moe_every: int = 2                  # MoE replaces MLP every Nth block
     moe_aux_coef: float = 0.01
     moe_capacity_factor: float = 1.25
-    moe_dropless: bool = False          # ragged grouped-GEMM routing (ep=1)
+    moe_dropless: bool = False          # ragged grouped-GEMM routing
+    #                                     (ep>1: padded-bucket a2a, no drops)
     # parallelism (mesh passed separately to the GPT module attribute)
     sequence_parallel: bool = False     # attention over the sp axis
     sp_impl: str = "ulysses"            # "ulysses" (a2a head swap) | "ring"
@@ -102,6 +103,9 @@ class GPTConfig:
     # random-LTD (data_pipeline/random_ltd.py): layers that run on a kept
     # token subset when the batch carries "random_ltd_idx"
     random_ltd_layer_ids: tuple = ()
+    # activation fake-quant bits (compression/pruning.py quant_act —
+    # reference basic_layer.py QuantAct); None/0 = off
+    act_quant_bits: Optional[int] = None
 
     @property
     def kv_heads(self) -> int:
@@ -309,6 +313,9 @@ class Attention(nn.Module):
         c = self.cfg
         B, T, H = x.shape
         nh, nkv, hd = c.num_heads, c.kv_heads, c.head_dim
+        if c.act_quant_bits:
+            from deepspeed_tpu.compression.pruning import quant_act
+            x = quant_act(x, c.act_quant_bits)
 
         wq = self.param("wq", _part(_kernel_init(), ("embed", "heads", "kv")),
                         (H, nh, hd), c.param_dtype)
@@ -462,6 +469,9 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool):
         c = self.cfg
+        if c.act_quant_bits:
+            from deepspeed_tpu.compression.pruning import quant_act
+            x = quant_act(x, c.act_quant_bits)
         H, M = c.hidden_size, c.mlp_dim
         wi = self.param("wi", _part(_kernel_init(), ("embed", "mlp")),
                         (H, M), c.param_dtype)
