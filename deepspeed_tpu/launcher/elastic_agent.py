@@ -1,0 +1,203 @@
+"""Elastic agent — liveness monitoring, membership change, relaunch.
+
+Reference parity: ``deepspeed/elasticity/elastic_agent.py:32 DSElasticAgent``
+(the torch-elastic agent that restarts the worker group at a new world size)
++ ``launcher/runner.py:391 --elastic_training``.  The batch-geometry solver it
+consults is ``deepspeed_tpu.elasticity.compute_elastic_config`` (v0.1/v0.2).
+
+TPU-native shape: one worker process per host (SPMD owns the devices), so the
+agent is a HOST-level supervisor:
+
+1. solve the batch geometry for the current host count,
+2. launch one worker per host with the JAX rendezvous env + the solved
+   ``DSTPU_ELASTIC_*`` batch overrides,
+3. poll liveness; on a worker death (or a generation timeout) SIGKILL the
+   survivors (they are blocked in collectives — reference: the agent tears
+   the whole group down the same way),
+4. drop the lost host, re-solve, bump the rendezvous port, and relaunch;
+   workers resume from the latest *universal checkpoint* (the cross-topology
+   format — checkpoint/universal.py) so training continues at the new world
+   size with loss continuity.
+
+Worker contract (what the training script must do to be elastic):
+- read ``DSTPU_ELASTIC_BATCH`` / ``DSTPU_ELASTIC_MICRO`` for the batch triad,
+- on start, load the latest universal checkpoint from the run dir if present,
+- export a universal checkpoint periodically (rank 0),
+- exit 0 when done.
+
+``--sim_hosts`` mode launches local CPU-mesh processes (the test path); a
+real DCN fleet swaps the Popen for the launcher's ssh commands.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.elasticity import ElasticityConfig, compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticAgent:
+    def __init__(self, script: str, script_args: Optional[List[str]] = None,
+                 *, n_hosts: int, elastic_config: ElasticityConfig,
+                 run_dir: str, devices_per_host: int = 2,
+                 base_port: int = 29821, min_hosts: int = 1,
+                 max_restarts: int = 3, poll_interval: float = 0.25,
+                 gen_timeout: Optional[float] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.script = script
+        self.script_args = list(script_args or [])
+        self.n_hosts = n_hosts
+        self.cfg = elastic_config
+        self.run_dir = run_dir
+        self.devices_per_host = devices_per_host
+        self.base_port = base_port
+        self.min_hosts = min_hosts
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.gen_timeout = gen_timeout
+        self.extra_env = dict(extra_env or {})
+        os.makedirs(run_dir, exist_ok=True)
+        self.history: List[dict] = []
+
+    # ---------------------------------------------------------------- spawn
+    def _spawn(self, world: int, port: int, restarts: int,
+               batch: int, micro: Optional[int]) -> List[subprocess.Popen]:
+        procs = []
+        for rank in range(world):
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(rank),
+                "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                              + f" --xla_force_host_platform_device_count="
+                              f"{self.devices_per_host}").strip(),
+                "DSTPU_ELASTIC_BATCH": str(batch),
+                "DSTPU_ELASTIC_MICRO": str(micro or 1),
+                "DSTPU_RESTART_COUNT": str(restarts),
+                "DSTPU_RUN_DIR": self.run_dir,
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, self.script] + self.script_args, env=env))
+        return procs
+
+    def _write_status(self, **kw) -> None:
+        state = dict(kw)
+        state["history"] = self.history
+        tmp = os.path.join(self.run_dir, "agent_status.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(self.run_dir, "agent_status.json"))
+
+    @staticmethod
+    def _kill_all(procs: List[subprocess.Popen]) -> None:
+        # survivors sit in collectives waiting for the dead peer — SIGKILL,
+        # not SIGTERM (reference: the agent tears the worker group down)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> int:
+        world = self.n_hosts
+        restarts = 0
+        while True:
+            chips = world * self.devices_per_host
+            batch, valid_dp, micro = compute_elastic_config(self.cfg, chips)
+            port = self.base_port + restarts
+            gen = {"world": world, "batch": batch, "micro": micro,
+                   "restarts": restarts, "port": port}
+            logger.info(f"elastic agent: generation {restarts}: "
+                        f"world={world} batch={batch} micro={micro}")
+            procs = self._spawn(world, port, restarts, batch, micro)
+            gen["pids"] = [p.pid for p in procs]
+            self.history.append(gen)
+            self._write_status(phase="running", **gen)
+
+            t0 = time.time()
+            failed = None
+            while True:
+                codes = [p.poll() for p in procs]
+                if any(c is not None and c != 0 for c in codes):
+                    failed = [i for i, c in enumerate(codes)
+                              if c is not None and c != 0]
+                    break
+                if all(c == 0 for c in codes):
+                    self._write_status(phase="done", **gen)
+                    return 0
+                if (self.gen_timeout is not None
+                        and time.time() - t0 > self.gen_timeout):
+                    logger.warning("elastic agent: generation timed out — "
+                                   "restarting at the same world size")
+                    failed = []
+                    break
+                time.sleep(self.poll_interval)
+
+            self._kill_all(procs)
+            lost = max(1, len(failed)) if failed is not None and failed else 0
+            if failed:  # real deaths: those hosts leave the membership
+                world -= lost
+                logger.warning(
+                    f"elastic agent: worker(s) {failed} died — membership "
+                    f"change to world={world}")
+            restarts += 1
+            if world < self.min_hosts:
+                self._write_status(phase="failed", reason="below min_hosts",
+                                   **gen)
+                return 1
+            if restarts > self.max_restarts:
+                self._write_status(phase="failed", reason="max_restarts",
+                                   **gen)
+                return 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="dstpu-elastic",
+        description="elastic training agent (reference DSElasticAgent)")
+    ap.add_argument("--sim_hosts", type=int, required=True)
+    ap.add_argument("--devices_per_host", type=int, default=2)
+    ap.add_argument("--run_dir", required=True)
+    ap.add_argument("--min_hosts", type=int, default=1)
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--micro_batch_sizes", type=int, nargs="+",
+                    default=[1, 2, 4])
+    ap.add_argument("--max_train_batch_size", type=int, default=64)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs="*")
+    args = ap.parse_args(argv)
+    cfg = ElasticityConfig(
+        micro_batch_sizes=tuple(args.micro_batch_sizes),
+        max_train_batch_size=args.max_train_batch_size,
+        min_chips=args.min_hosts * args.devices_per_host,
+        max_chips=args.sim_hosts * args.devices_per_host,
+        chips_per_host=args.devices_per_host)
+    agent = ElasticAgent(args.script, args.script_args,
+                         n_hosts=args.sim_hosts, elastic_config=cfg,
+                         run_dir=args.run_dir,
+                         devices_per_host=args.devices_per_host,
+                         min_hosts=args.min_hosts,
+                         max_restarts=args.max_restarts)
+    return agent.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

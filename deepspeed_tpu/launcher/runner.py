@@ -122,12 +122,45 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--devices_per_host", type=int, default=4,
                     help="virtual devices per sim host")
     ap.add_argument("--sim_port", type=int, default=29731)
+    # elastic training (reference launcher/runner.py:391 --elastic_training →
+    # elasticity/elastic_agent.py DSElasticAgent)
+    ap.add_argument("--elastic_training", action="store_true",
+                    help="supervise workers with the elastic agent: on a "
+                    "host loss, re-solve the batch geometry and relaunch "
+                    "from the latest universal checkpoint")
+    ap.add_argument("--elastic_run_dir", default="./elastic_run")
+    ap.add_argument("--min_hosts", type=int, default=1)
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--elastic_micro_batches", type=int, nargs="+",
+                    default=[1, 2, 4])
+    ap.add_argument("--max_train_batch_size", type=int, default=64)
     ap.add_argument("--ssh", action="store_true",
                     help="with --hostfile: actually execute the ssh commands "
                     "(default: print them)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+
+    if args.elastic_training:
+        if not args.sim_hosts:
+            ap.error("--elastic_training currently supervises --sim_hosts "
+                     "fleets (a DCN fleet swaps Popen for ssh)")
+        from deepspeed_tpu.elasticity import ElasticityConfig
+        from deepspeed_tpu.launcher.elastic_agent import ElasticAgent
+        cfg = ElasticityConfig(
+            micro_batch_sizes=list(args.elastic_micro_batches),
+            max_train_batch_size=args.max_train_batch_size,
+            min_chips=args.min_hosts * args.devices_per_host,
+            max_chips=args.sim_hosts * args.devices_per_host,
+            chips_per_host=args.devices_per_host)
+        agent = ElasticAgent(args.script, args.script_args,
+                             n_hosts=args.sim_hosts, elastic_config=cfg,
+                             run_dir=args.elastic_run_dir,
+                             devices_per_host=args.devices_per_host,
+                             min_hosts=args.min_hosts,
+                             max_restarts=args.max_restarts,
+                             base_port=args.sim_port)
+        return agent.run()
 
     if args.sim_hosts:
         return _run_sim(args, args.script_args)

@@ -1,0 +1,85 @@
+"""Elastic worker for the agent test (the reference pattern: an
+--elastic_training run whose worker group survives a membership change).
+
+Contract with the agent (launcher/elastic_agent.py):
+- batch geometry from DSTPU_ELASTIC_BATCH / DSTPU_ELASTIC_MICRO,
+- resume from the latest universal checkpoint in DSTPU_RUN_DIR,
+- rank 0 exports a universal checkpoint every step + appends losses,
+- generation 0: the LAST rank kills itself mid-train (the simulated host
+  failure the test asserts recovery from).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models import GPT, GPTConfig  # noqa: E402
+
+TOTAL_STEPS = 24
+KILL_AT = 8
+
+
+def main():
+    run_dir = os.environ["DSTPU_RUN_DIR"]
+    batch = int(os.environ["DSTPU_ELASTIC_BATCH"])
+    micro = int(os.environ["DSTPU_ELASTIC_MICRO"])
+    restart = int(os.environ["DSTPU_RESTART_COUNT"])
+    deepspeed_tpu.comm.init_distributed()
+    rank = jax.process_index()
+    world = jax.process_count()
+
+    cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=16)
+    config = {
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        "seed": 7,                      # same init on every incarnation
+    }
+    rng = np.random.default_rng(0)
+    pool = rng.integers(0, 64, size=(64, 16)).astype(np.int32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(cfg), config=config,
+        example_batch={"input_ids": pool[:1]})
+
+    # resume from the newest COMPLETE universal export (step-tagged dirs +
+    # a pointer file written only after the export finished — a death
+    # mid-export can never corrupt the resume source)
+    latest_ptr = os.path.join(run_dir, "ulatest")
+    if os.path.exists(latest_ptr):
+        with open(latest_ptr) as f:
+            engine.load_universal_checkpoint(f.read().strip())
+
+    local_rows = batch // world
+    loss_log = os.path.join(run_dir, "losses.txt")
+    while engine.global_steps < TOTAL_STEPS:
+        step = engine.global_steps
+        rows = pool[(np.arange(local_rows) + step * local_rows
+                     + rank * local_rows * 31) % 64]
+        m = engine.train_batch({"input_ids": rows})
+        if rank == 0:
+            with open(loss_log, "a") as f:
+                f.write(f"{engine.global_steps} {world} "
+                        f"{float(m.loss):.6f}\n")
+            d = os.path.join(run_dir, f"universal_{engine.global_steps}")
+            engine.export_universal_checkpoint(d)
+            with open(latest_ptr + ".tmp", "w") as f:
+                f.write(d)
+            os.replace(latest_ptr + ".tmp", latest_ptr)
+        if (restart == 0 and rank == world - 1
+                and engine.global_steps >= KILL_AT):
+            os._exit(17)                # the simulated host failure
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main() or 0)
