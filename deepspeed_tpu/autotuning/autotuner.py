@@ -1,23 +1,29 @@
-"""Autotuner — find the fastest micro-batch size with real compile+step probes.
+"""Autotuner — stage/mesh/micro-batch search with real compile+step probes.
 
 Reference parity: ``autotuning/autotuner.py`` — the micro-batch tuner
-(``get_min_max_micro_batch_size`` :741, ``run_tuning_micro_batch_size`` :960)
-and its fast/model-based tuners (tuner/*.py).  The reference launches whole
-training jobs per experiment through the launcher and scrapes metrics files;
-here a probe is in-process — build the engine, compile the train step, time a
-few real steps — because one JAX process drives every local chip, so no
-process orchestration is needed.
+(``get_min_max_micro_batch_size`` :741, ``run_tuning_micro_batch_size`` :960),
+the ZeRO-stage memory model that prunes candidates before any experiment runs
+(``autotuner.py:278`` ``_get_instantiation_memory_required_per_gpu``), the
+experiment generator (:304 over stages × configs), and the model-based tuner
+(tuner/model_based.py).  The reference launches whole training jobs per
+experiment through the launcher and scrapes metrics files; here a probe is
+in-process — build the engine, compile the train step, time a few real steps
+— because one JAX process drives every local chip.
 
-Search shape mirrors the reference: geometric doubling from ``start`` until a
-probe fails (OOM) or ``max_mbs`` is hit, then the failure boundary is refined
-by bisection, and the fastest measured micro-batch (tokens/s) wins.
+Round-3 search (``tune()``): candidates = {ZeRO stage} × {fsdp·tp mesh
+split}; the MEMORY MODEL estimates each candidate's fixed per-chip bytes
+(params + grads + optimizer state under that stage's sharding) and prunes
+those over the HBM budget WITHOUT probing (the reference's "fast" path);
+survivors get the doubling+bisect micro-batch search; everything lands in a
+ranked experiment report (the reference's experiment-summary role).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -35,6 +41,29 @@ def _is_oom(err: Exception) -> bool:
     s = str(err)
     return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
             or "out of memory" in s.lower())
+
+
+def estimate_fixed_bytes(n_params: int, *, stage: int, fsdp: int, tp: int = 1,
+                         compute_bytes: int = 2, master_weights: bool = True,
+                         optimizer_moments: int = 2) -> Dict[str, float]:
+    """Per-chip FIXED memory (params + grads + optimizer state) under a ZeRO
+    stage and mesh split — the reference's
+    ``_get_instantiation_memory_required_per_gpu`` (autotuner.py:278).
+
+    Sharding rules mirror parallel/partition.py: tp divides every tensor-
+    parallel weight (≈ all of them for transformers); fsdp divides params at
+    stage 3 and grads/optimizer state at stages ≥2/≥1.  Activations are NOT
+    modeled — they scale with micro-batch, which the probe search explores.
+    """
+    p_local = n_params / tp
+    params = p_local * compute_bytes / (fsdp if stage >= 3 else 1)
+    grads = p_local * 4 / (fsdp if stage >= 2 else 1)
+    opt_shard = fsdp if stage >= 1 else 1
+    opt = p_local * 4 * optimizer_moments / opt_shard
+    masters = (p_local * 4 / opt_shard) if master_weights else 0.0
+    return {"params": params, "grads": grads, "optimizer": opt,
+            "masters": masters,
+            "total": params + grads + opt + masters}
 
 
 class Autotuner:
@@ -138,3 +167,105 @@ class Autotuner:
                  f"({best.tokens_per_s:,.0f} tok/s over "
                  f"{len(self.results)} probes)", ranks=[0])
         return best.micro_batch
+
+    # ------------------------------------------------- stage/mesh search
+    def tune(self, *, n_params: Optional[int] = None,
+             stages: Sequence[int] = (0, 2, 3),
+             mesh_splits: Optional[Sequence[Tuple[int, int]]] = None,
+             hbm_budget_bytes: Optional[float] = None,
+             start: int = 1, max_mbs: Optional[int] = None,
+             report_path: Optional[str] = None) -> Dict[str, Any]:
+        """Full search: {ZeRO stage} × {(fsdp, tp) split} × micro-batch.
+
+        The memory model prunes candidates whose fixed state cannot fit
+        ``hbm_budget_bytes`` per chip BEFORE any probe runs (reference
+        model-based tuner); survivors are probed for real and ranked by
+        tokens/s.  Returns the best config dict; the full experiment record
+        goes to ``report_path`` (JSON) and ``self.experiments``.
+        """
+        import jax
+        n_dev = len(jax.devices())
+        if mesh_splits is None:
+            # the advertised fsdp×tp product space (tp capped at 2 by
+            # default — wider tp belongs to explicit mesh_splits)
+            mesh_splits = [(f, t) for t in (1, 2)
+                           for f in (1, 2, 4, 8, 16, 32)
+                           if f * t <= n_dev and n_dev % (f * t) == 0]
+        if n_params is None:
+            n_params = self._count_params()
+        compute_bytes = 2 if (self.base_config.get("bf16", {}).get("enabled")
+                              or self.base_config.get("fp16", {}).get(
+                                  "enabled")) else 4
+        master = compute_bytes == 2
+        self.experiments: List[Dict[str, Any]] = []
+        for stage in stages:
+            for fsdp, tp in mesh_splits:
+                exp: Dict[str, Any] = {"stage": stage, "fsdp": fsdp,
+                                       "tp": tp}
+                est = estimate_fixed_bytes(
+                    n_params, stage=stage, fsdp=fsdp, tp=tp,
+                    compute_bytes=compute_bytes, master_weights=master)
+                exp["est_fixed_bytes"] = est["total"]
+                if (hbm_budget_bytes is not None
+                        and est["total"] > hbm_budget_bytes):
+                    exp["status"] = "pruned"
+                    exp["reason"] = (f"fixed state {est['total']/2**30:.2f}"
+                                     f"GiB > budget "
+                                     f"{hbm_budget_bytes/2**30:.2f}GiB")
+                    self.experiments.append(exp)
+                    log_dist(f"autotune: PRUNE stage={stage} fsdp={fsdp} "
+                             f"tp={tp}: {exp['reason']}", ranks=[0])
+                    continue
+                saved = dict(self.base_config)
+                self.base_config["zero_optimization"] = dict(
+                    self.base_config.get("zero_optimization", {}),
+                    stage=stage)
+                self.base_config["mesh"] = {"dp": -1, "fsdp": fsdp, "tp": tp}
+                self.results = []
+                try:
+                    best_mbs = self.tune_micro_batch_size(start=start,
+                                                          max_mbs=max_mbs)
+                    best_r = max((r for r in self.results if r.ok),
+                                 key=lambda r: r.tokens_per_s)
+                    exp.update(status="ok", micro_batch=best_mbs,
+                               tokens_per_s=best_r.tokens_per_s,
+                               step_time_s=best_r.step_time_s,
+                               probes=len(self.results))
+                except Exception as e:  # noqa: BLE001 — a candidate failing
+                    exp.update(status="failed", reason=str(e)[:200])
+                finally:
+                    self.base_config = saved
+                self.experiments.append(exp)
+        ranked = sorted(
+            (e for e in self.experiments if e.get("status") == "ok"),
+            key=lambda e: -e["tokens_per_s"])
+        report = {"model_params": n_params, "n_devices": n_dev,
+                  "hbm_budget_bytes": hbm_budget_bytes,
+                  "experiments": self.experiments,
+                  "ranking": ranked}
+        if report_path:
+            with open(report_path, "w") as f:
+                json.dump(report, f, indent=1)
+        if not ranked:
+            raise RuntimeError(
+                "autotune: every stage/mesh candidate was pruned or failed; "
+                f"see the experiment record ({len(self.experiments)} entries)")
+        best = ranked[0]
+        log_dist(f"autotune: BEST stage={best['stage']} fsdp={best['fsdp']} "
+                 f"tp={best['tp']} micro_batch={best['micro_batch']} "
+                 f"({best['tokens_per_s']:,.0f} tok/s; "
+                 f"{len(self.experiments)} experiments)", ranks=[0])
+        return best
+
+    def _count_params(self) -> int:
+        import jax
+        import numpy as np
+        batch = self.batch_factory(1)
+        model = self.model
+        if hasattr(model, "init"):
+            boxed = jax.eval_shape(
+                lambda r: model.init(r, batch), jax.random.PRNGKey(0))
+            from deepspeed_tpu.parallel.metadata import unbox
+            return sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(unbox(boxed)))
+        raise ValueError("pass n_params= for non-flax models")

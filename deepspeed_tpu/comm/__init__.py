@@ -29,8 +29,10 @@ from deepspeed_tpu.comm.collectives import (
 from deepspeed_tpu.comm.comm import (
     comms_logger,
     get_comms_logger,
+    hlo_collective_bytes,
     init_distributed,
     is_initialized,
+    profile_jitted,
 )
 
 __all__ = [
@@ -46,5 +48,7 @@ __all__ = [
     "init_distributed",
     "is_initialized",
     "comms_logger",
+    "profile_jitted",
+    "hlo_collective_bytes",
     "get_comms_logger",
 ]

@@ -1,6 +1,9 @@
-"""Compression library — staged quantization-aware training + layer reduction
-(reference deepspeed/compression/)."""
+"""Compression library — staged quantization-aware training, layer reduction,
+pruning family, activation quantization (reference deepspeed/compression/)."""
 
 from deepspeed_tpu.compression.basic import (  # noqa: F401
     CompressionSpec, layer_reduction_init, parse_compression_config,
     scheduled_weight_qdq)
+from deepspeed_tpu.compression.pruning import (  # noqa: F401
+    PruningSpec, parse_activation_quant_config, parse_pruning_config,
+    quant_act, scheduled_pruning)

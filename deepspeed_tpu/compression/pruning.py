@@ -1,0 +1,182 @@
+"""Pruning family + activation quantization (reference basic_layer.py
+LinearLayer_Compress sparse/row/head pruning + QuantAct, config.py
+get_sparse_pruning/get_row_pruning/get_head_pruning/
+get_activation_quantization).
+
+TPU-native: like the weight-QAT ladder (compression/basic.py), pruning is a
+PURE FUNCTION over the param tree applied inside the jitted loss once the
+step clock passes the group's ``schedule_offset`` — no module surgery.  The
+mask is recomputed from the live weights each step (the reference's l1
+method recomputes per forward too), so "pruned" weights stop contributing
+and receive zero gradient, letting the survivors recover accuracy.
+
+- sparse (unstructured l1): keep the top ``dense_ratio`` fraction of each
+  matching weight by |w|;
+- row: keep the top fraction of OUTPUT rows by row L2 norm (structured);
+- head: keep the top fraction of attention heads — a head's slice is found
+  by the axis whose length equals ``num_heads`` ([H, nh, hd] projections and
+  [nh, hd, H] output layouts both work), scored by its L2 norm;
+- activation quantization (QuantAct): symmetric dynamic fake-quant on
+  activations, exposed as ``quant_act`` for model layers (GPT/BERT wire it
+  through their config's ``act_quant_bits``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PruningSpec:
+    """One pruning group (reference different_groups entry)."""
+
+    kind: str                   # "sparse" | "row" | "head"
+    pattern: str                # regex over the "/"-joined param path
+    dense_ratio: float = 0.5    # fraction KEPT
+    schedule_offset: int = 0    # step the mask activates
+    num_heads: int = 0          # head pruning only
+
+
+def parse_pruning_config(cfg: Dict[str, Any],
+                         num_heads: int = 0) -> List[PruningSpec]:
+    """compression_training.{sparse,row,head}_pruning → specs (reference
+    config.py get_*_pruning)."""
+    specs: List[PruningSpec] = []
+    for kind, key in (("sparse", "sparse_pruning"), ("row", "row_pruning"),
+                      ("head", "head_pruning")):
+        block = (cfg or {}).get(key) or {}
+        shared = block.get("shared_parameters", {})
+        if not shared.get("enabled", False):
+            continue
+        offset = int(shared.get("schedule_offset", 0))
+        default_ratio = float(shared.get("dense_ratio", 0.5))
+        groups = block.get("different_groups") or {}
+        if not groups:
+            groups = {"all": {"params": {"dense_ratio": default_ratio},
+                              "modules": [".*"]}}
+        for g in groups.values():
+            ratio = float(g.get("params", {}).get("dense_ratio",
+                                                  default_ratio))
+            for m in g.get("modules", [".*"]):
+                specs.append(PruningSpec(
+                    kind=kind, pattern=m, dense_ratio=ratio,
+                    schedule_offset=offset,
+                    num_heads=int(shared.get("num_heads", num_heads))))
+    return specs
+
+
+def parse_activation_quant_config(cfg: Dict[str, Any]) -> int:
+    """→ activation fake-quant bits, or 0 (reference
+    get_activation_quantization; 'dynamic' range method is what the
+    symmetric per-tensor QDQ here implements).
+
+    One GLOBAL bit-width is supported (the model config carries it into
+    every layer); a config asking for per-module activation groups with
+    differing bits must FAIL rather than silently apply the first group
+    everywhere."""
+    block = (cfg or {}).get("activation_quantization") or {}
+    shared = block.get("shared_parameters", {})
+    if not shared.get("enabled", False):
+        return 0
+    groups = block.get("different_groups") or {}
+    bits_seen = {int(g.get("params", {}).get("bits", 8))
+                 for g in groups.values()}
+    scoped = [m for g in groups.values()
+              for m in g.get("modules", [".*"]) if m != ".*"]
+    if len(bits_seen) > 1 or scoped:
+        raise NotImplementedError(
+            "activation_quantization supports ONE global bit-width (the "
+            "model applies it in every attention/MLP input); per-module "
+            f"groups are not wired — got bits={sorted(bits_seen)}, "
+            f"modules={scoped}")
+    if bits_seen:
+        return bits_seen.pop()
+    return int(shared.get("bits", 8))
+
+
+def _keep_threshold(scores, dense_ratio):
+    """Value s.t. ``dense_ratio`` of scores are >= it (jnp.quantile)."""
+    return jnp.quantile(scores.reshape(-1).astype(jnp.float32),
+                        1.0 - dense_ratio)
+
+
+def _sparse_mask(w, ratio):
+    a = jnp.abs(w).astype(jnp.float32)
+    return (a >= _keep_threshold(a, ratio)).astype(w.dtype)
+
+
+def _row_mask(w, ratio):
+    # output rows: the LAST axis is the output features in the [in, out]
+    # convention used across the models' kernel layouts — prune rows of the
+    # transposed view, i.e. output channels
+    flat = w.reshape(-1, w.shape[-1]).astype(jnp.float32)
+    norms = jnp.linalg.norm(flat, axis=0)                  # [out]
+    keep = (norms >= _keep_threshold(norms, ratio))
+    shape = (1,) * (w.ndim - 1) + (w.shape[-1],)
+    return keep.reshape(shape).astype(w.dtype)
+
+
+def _head_mask(w, ratio, num_heads):
+    axis = next((i for i, d in enumerate(w.shape) if d == num_heads), None)
+    if axis is None:
+        return None
+    others = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sqrt(jnp.sum(jnp.square(w.astype(jnp.float32)),
+                             axis=others))                 # [nh]
+    keep = (norms >= _keep_threshold(norms, ratio))
+    shape = tuple(num_heads if i == axis else 1 for i in range(w.ndim))
+    return keep.reshape(shape).astype(w.dtype)
+
+
+def scheduled_pruning(params, specs: Sequence[PruningSpec], step):
+    """Apply each group's mask to matching leaves once ``step`` passes its
+    offset (step may be traced — jnp.where keeps one compiled program)."""
+    if not specs:
+        return params
+    compiled = [(re.compile(s.pattern), s) for s in specs]
+
+    def visit(path, leaf):
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            return leaf
+        name = "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                        for p in path)
+        out = leaf
+        for rx, s in compiled:
+            if not rx.search(name):
+                continue
+            if s.kind == "sparse":
+                mask = _sparse_mask(out, s.dense_ratio)
+            elif s.kind == "row":
+                mask = _row_mask(out, s.dense_ratio)
+            elif s.kind == "head":
+                if not s.num_heads:
+                    raise ValueError("head pruning needs num_heads (set "
+                                     "shared_parameters.num_heads or pass "
+                                     "num_heads to parse_pruning_config)")
+                mask = _head_mask(out, s.dense_ratio, s.num_heads)
+                if mask is None:
+                    continue           # leaf has no head axis
+            else:
+                raise ValueError(f"unknown pruning kind {s.kind!r}")
+            out = jnp.where(step >= s.schedule_offset, out * mask, out)
+        return out
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def quant_act(x, bits: int):
+    """QuantAct (reference basic_layer.py QuantAct, dynamic range): symmetric
+    per-tensor fake-quant with a straight-through estimator."""
+    if not bits or bits >= 16:
+        return x
+    levels = 2.0 ** (bits - 1) - 1
+    amax = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32))), 1e-6)
+    scale = amax / levels
+    q = jnp.round(x.astype(jnp.float32) / scale) * scale
+    q = q.astype(x.dtype)
+    return x + jax.lax.stop_gradient(q - x)

@@ -397,12 +397,15 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
         inert.append("prescale_gradients (losses are globally averaged on the "
                      "global-batch jax.Array view; pre-scaling is a no-op)")
     if cfg.compression_training:
-        # only weight_quantization.different_groups is consumed
-        # (compression/basic.py); every other reference sub-block must scream
+        # weight_quantization (compression/basic.py), the pruning family and
+        # activation_quantization (compression/pruning.py) are LIVE; every
+        # other reference sub-block must scream
+        live = {"weight_quantization", "sparse_pruning", "row_pruning",
+                "head_pruning", "activation_quantization"}
         for key in cfg.compression_training:
-            if key != "weight_quantization":
-                inert.append(f"compression_training.{key} (only "
-                             f"weight_quantization is implemented)")
+            if key not in live:
+                inert.append(f"compression_training.{key} (implemented "
+                             f"blocks: {sorted(live)})")
     for item in inert:
         logger.warning(f"config key accepted but NOT implemented on TPU yet: "
                        f"{item} — this run will NOT honor it")

@@ -161,6 +161,22 @@ class DeepSpeedTPUEngine:
                     f"random_ltd_layer_ids mismatch: model cfg has "
                     f"{model_ids}, ds_config says {cfg_ids} — set them in "
                     f"ONE place")
+        # activation quantization (reference compression QuantAct): the model
+        # config carries the bits so the fake-quant happens inside the layers
+        from deepspeed_tpu.compression.pruning import \
+            parse_activation_quant_config
+        act_bits = parse_activation_quant_config(
+            config.compression_training or {})
+        if act_bits:
+            if not (hasattr(model, "clone") and hasattr(model, "cfg")
+                    and hasattr(model.cfg, "act_quant_bits")):
+                raise ValueError(
+                    "compression_training.activation_quantization needs a "
+                    "model whose config takes act_quant_bits (models/gpt.py "
+                    "GPT); this model would silently ignore it")
+            import dataclasses as _dc
+            model = model.clone(cfg=_dc.replace(model.cfg,
+                                                act_quant_bits=act_bits))
         # progressive layer drop (reference engine.progressive_layer_drop
         # built at initialize() when the config block is enabled)
         pld_cfg = config.progressive_layer_drop
@@ -315,11 +331,24 @@ class DeepSpeedTPUEngine:
 
         # staged QAT groups (compression/basic.py); empty = off
         from deepspeed_tpu.compression import parse_compression_config
+        from deepspeed_tpu.compression.pruning import parse_pruning_config
         self._compression_specs = parse_compression_config(
             config.compression_training)
         if self._compression_specs:
             log_dist(f"compression: {len(self._compression_specs)} weight-"
                      f"quantization group(s) active", ranks=[0])
+        # pruning family (compression/pruning.py; reference basic_layer.py
+        # sparse/row/head pruning) — masks applied in-loss past each group's
+        # schedule_offset
+        nh = int(getattr(getattr(self.model, "cfg", None), "num_heads", 0)
+                 or 0)
+        self._pruning_specs = parse_pruning_config(
+            config.compression_training or {}, num_heads=nh)
+        if self._pruning_specs:
+            log_dist(f"compression: {len(self._pruning_specs)} pruning "
+                     f"group(s) active "
+                     f"({sorted(set(s.kind for s in self._pruning_specs))})",
+                     ranks=[0])
 
         # ZeRO++ qwZ: per-leaf fsdp-sharded dim for the quantized weight
         # all-gather (None = leaf not fsdp-sharded) — built once from the
@@ -556,6 +585,9 @@ class DeepSpeedTPUEngine:
             from deepspeed_tpu.compression import scheduled_weight_qdq
             params = scheduled_weight_qdq(params, self._compression_specs,
                                           step)
+        if self._pruning_specs and step is not None:
+            from deepspeed_tpu.compression.pruning import scheduled_pruning
+            params = scheduled_pruning(params, self._pruning_specs, step)
         if self._qwz_dims is not None:
             # ZeRO++ qwZ: explicit int8 weight all-gather (s8 on the wire)
             # instead of the partitioner's implicit bf16 gather
@@ -1083,6 +1115,36 @@ class DeepSpeedTPUEngine:
                                  top_modules=fp.top_modules,
                                  detailed=fp.detailed,
                                  output_file=fp.output_file)
+
+    def profile_comms(self, batch, iters: int = 2):
+        """Measure the jitted train step's per-collective bytes + latency
+        (comm.profile_jitted) and record them into the comms logger —
+        ``comm.comms_logger.log_summary()`` then shows algo-BW for the
+        jitted collectives (reference calc_bw_log role under XLA).
+
+        Functional state is NOT mutated (the step runs on a copy of the
+        inputs through an undonated jit)."""
+        from deepspeed_tpu.comm.comm import profile_jitted
+        batch = self._apply_data_efficiency(batch)
+        first = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
+        local_bs = self.config.train_batch_size // jax.process_count()
+        micro_local = local_bs // self.gas
+        # same batch-form disambiguation as train_batch (incl. the
+        # gas == local_bs ambiguity resolved by the SECOND dim)
+        if (first[0] == self.gas and len(first) > 1
+                and first[1] == micro_local):
+            pass                            # already [gas, micro_local, ...]
+        elif first[0] == local_bs:
+            batch = self._reshape_gas(batch)
+        else:
+            raise ValueError(
+                f"profile_comms batch leading dims {first[:2]} match "
+                f"neither [gas={self.gas}, micro_local={micro_local}, ...] "
+                f"nor the flat [{local_bs}, ...] form")
+        batch = self._shard_batch(batch, leading_gas=True)
+        with self.mesh:
+            return profile_jitted(jax.jit(self._train_batch_fn),
+                                  self.state, batch, iters=iters)
 
     def _print_memory_breakdown(self):
         """reference: see_memory_usage / memory_breakdown config."""

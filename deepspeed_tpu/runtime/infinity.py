@@ -867,3 +867,19 @@ class InfinityEngine:
         return _u.export_universal_offload(
             self._assemble_host_tree(), self.offload_opt, out_dir,
             step=self.global_steps)
+
+    def save_16bit_model(self, save_dir: str,
+                         filename: str = "model_states.safetensors") -> str:
+        """Consolidated low-precision export in the flax GPT layout
+        (engine.save_16bit_model parity) — the bridge from an Infinity run
+        to the serving engines, assembled host-side (nothing touches HBM)."""
+        from deepspeed_tpu.checkpoint.universal import _flatten_params
+        os.makedirs(save_dir, exist_ok=True)
+        flat = {k: np.ascontiguousarray(v)
+                for k, v in _flatten_params(
+                    self.current_params_gpt()).items()}
+        path = os.path.join(save_dir, filename)
+        if jax.process_index() == 0:
+            import safetensors.numpy
+            safetensors.numpy.save_file(flat, path)
+        return path

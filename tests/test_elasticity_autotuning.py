@@ -90,3 +90,68 @@ class TestAutotuner:
         # fastest measured throughput wins
         fastest = max(tuner.results, key=lambda r: r.tokens_per_s)
         assert best == fastest.micro_batch
+
+    def test_memory_model(self):
+        """Hand-checked fixed-state bytes per stage/mesh (reference
+        autotuner.py:278 memory model)."""
+        from deepspeed_tpu.autotuning.autotuner import estimate_fixed_bytes
+        P = 1_000_000
+        # stage 0, bf16 + masters, no sharding: 2P + 4P + 8P + 4P = 18P
+        e0 = estimate_fixed_bytes(P, stage=0, fsdp=8, compute_bytes=2)
+        assert e0["total"] == 18 * P
+        # stage 1: optimizer state + masters shard over fsdp
+        e1 = estimate_fixed_bytes(P, stage=1, fsdp=8, compute_bytes=2)
+        assert e1["total"] == 2 * P + 4 * P + 12 * P / 8
+        # stage 2: + grads shard
+        e2 = estimate_fixed_bytes(P, stage=2, fsdp=8, compute_bytes=2)
+        assert e2["total"] == 2 * P + 4 * P / 8 + 12 * P / 8
+        # stage 3: everything shards
+        e3 = estimate_fixed_bytes(P, stage=3, fsdp=8, compute_bytes=2)
+        assert e3["total"] == 18 * P / 8
+        # tp divides everything again
+        e3t = estimate_fixed_bytes(P, stage=3, fsdp=4, tp=2,
+                                   compute_bytes=2)
+        assert e3t["total"] == pytest.approx(18 * P / 8)
+        # fp32, no masters: 4P + 4P + 8P
+        ef = estimate_fixed_bytes(P, stage=0, fsdp=1, compute_bytes=4,
+                                  master_weights=False)
+        assert ef["total"] == 16 * P
+
+    def test_stage_mesh_search_prunes_and_recovers_best(self, tmp_path,
+                                                        devices):
+        """With an HBM budget only stage 3 × fsdp=8 satisfies, the tuner
+        must prune everything else WITHOUT probing and recover the known-
+        best config (reference model-based tuner behavior)."""
+        cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
+        rng = np.random.default_rng(0)
+
+        def factory(mbs):
+            return {"input_ids": rng.integers(0, 128, (mbs, 32))
+                    .astype(np.int32)}
+
+        tuner = Autotuner(GPT(cfg), {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+        }, factory, probe_steps=1)
+        n_params = tuner._count_params()
+        from deepspeed_tpu.autotuning.autotuner import estimate_fixed_bytes
+        # budget between the best candidate (stage3 fsdp8) and the runner-up
+        best_bytes = estimate_fixed_bytes(n_params, stage=3, fsdp=8,
+                                          compute_bytes=2)["total"]
+        runner_up = estimate_fixed_bytes(n_params, stage=2, fsdp=8,
+                                         compute_bytes=2)["total"]
+        budget = (best_bytes + runner_up) / 2
+        report = str(tmp_path / "autotune_report.json")
+        best = tuner.tune(stages=(0, 2, 3), mesh_splits=[(1, 1), (8, 1)],
+                          hbm_budget_bytes=budget, start=1, max_mbs=2,
+                          report_path=report)
+        assert (best["stage"], best["fsdp"]) == (3, 8)
+        import json
+        with open(report) as f:
+            rep = json.load(f)
+        statuses = {(e["stage"], e["fsdp"]): e["status"]
+                    for e in rep["experiments"]}
+        assert statuses[(3, 8)] == "ok"
+        # every other candidate pruned by the memory model, not probed
+        assert all(v == "pruned" for k, v in statuses.items() if k != (3, 8))
+        assert rep["ranking"][0]["stage"] == 3

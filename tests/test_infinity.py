@@ -261,6 +261,24 @@ class TestInfinityEngineSurface:
                     _ds_config()),
                 {"input_ids": np.zeros((1, SEQ), np.int32)})
 
+    def test_save_16bit_model_serves(self, tmp_path):
+        """Infinity-trained params assemble into the flax GPT layout and
+        round-trip through the consolidated export."""
+        import safetensors.numpy
+        mc = _cfg(n_layers=2)
+        inf = _build_infinity(mc)
+        inf.train_batch(_data(1, inf.train_batch_size)[0])
+        path = inf.save_16bit_model(str(tmp_path))
+        flat = safetensors.numpy.load_file(path)
+        assert any("backbone" in k and "block_1" in k for k in flat)
+        # forward through the plain GPT with the exported weights
+        import jax.numpy as jnp
+        gpt_vars = inf.current_params_gpt()
+        model = GPT(mc)
+        loss = model.apply(jax.tree_util.tree_map(jnp.asarray, gpt_vars),
+                           _data(1, 2)[0], deterministic=True)
+        assert np.isfinite(float(loss))
+
     def test_cpu_checkpointing_activations(self):
         """activation_checkpointing.cpu_checkpointing: saved layer inputs
         round-trip through host RAM (Infinity activation offload)."""

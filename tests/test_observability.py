@@ -218,3 +218,74 @@ class TestInertConfigWarnings:
         cfg = parse_config({"zero_optimization": {"stage": 2},
                             "bf16": {"enabled": True}})
         assert warn_inert_config(cfg) == []
+
+
+class TestCommsTelemetry:
+    """Jitted-collective bytes + measured latency (VERDICT r3 item 10;
+    reference utils/comms_logging.py calc_bw_log)."""
+
+    def test_hlo_collective_bytes(self):
+        from deepspeed_tpu.comm import hlo_collective_bytes
+        hlo = """
+  %ar = f32[8,128]{1,0} all-reduce(f32[8,128]{1,0} %x), replica_groups={}
+  %ag.1 = bf16[16,64]{1,0} all-gather(bf16[2,64]{1,0} %y), dimensions={0}
+  %ar2.s = f32[4]{0} all-reduce-start(f32[4]{0} %z)
+  %ar2.d = f32[4]{0} all-reduce-done(f32[4]{0} %ar2.s)
+"""
+        out = hlo_collective_bytes(hlo)
+        assert out["all-reduce"]["bytes"] == 8 * 128 * 4 + 4 * 4
+        assert out["all-reduce"]["count"] == 2      # start/done pair once
+        assert out["all-gather"]["bytes"] == 16 * 64 * 2
+
+    def test_profile_jitted_measures_allreduce(self, devices):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.comm import comms_logger, profile_jitted
+        from deepspeed_tpu.parallel.mesh import MeshSpec, build_mesh
+        mesh = build_mesh(MeshSpec(dp=8))
+        x = jax.device_put(jnp.ones((8, 256, 128)),
+                           NamedSharding(mesh, P("dp")))
+
+        def f(x):
+            return x - jnp.mean(x)          # forces a cross-dp all-reduce
+
+        comms_logger.reset()
+        res = profile_jitted(f, x, iters=2)
+        assert "all-reduce" in res
+        assert res["all-reduce"]["bytes"] > 0
+        assert res["all-reduce"]["time_s"] > 0     # MEASURED, not estimated
+        lines = comms_logger.log_summary()
+        jit_lines = [ln for ln in lines if ln.startswith("jit:all-reduce")]
+        assert jit_lines and "algo_bw=" in jit_lines[0]
+        bw = float(jit_lines[0].split("algo_bw=")[1].split("GB/s")[0])
+        assert bw > 0
+        comms_logger.reset()
+
+    def test_engine_profile_comms(self, devices):
+        import numpy as np
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import comms_logger
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=16)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg), config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3},
+                "mesh": {"dp": 1, "fsdp": -1},
+                "steps_per_print": 0,
+            }, example_batch={"input_ids": np.zeros((1, 16), np.int32)})
+        comms_logger.reset()
+        batch = {"input_ids": np.zeros((engine.train_batch_size, 16),
+                                       np.int32)}
+        res = engine.profile_comms(batch, iters=1)
+        # ZeRO-3 train step must show all-gathers (param gathers) and a
+        # grad reduction collective, with measured nonzero latency
+        assert any(k in res for k in ("all-gather", "all-reduce",
+                                      "reduce-scatter"))
+        assert any(v["time_s"] > 0 for v in res.values())
+        # state untouched by the profiling run
+        assert engine.global_steps == 0
+        comms_logger.reset()
