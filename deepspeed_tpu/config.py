@@ -77,6 +77,9 @@ class OffloadConfig(DeepSpeedConfigModel):
     nvme_path: Optional[str] = None
     buffer_count: int = 5
     pin_memory: bool = False
+    # reference offload_config.py:96 (ZeRO-Offload++ partial offload): the
+    # host tier here is all-or-nothing — any ratio < 1 warns inert
+    ratio: float = 1.0
 
 
 class ZeroConfig(DeepSpeedConfigModel):
@@ -250,6 +253,39 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class AIOConfig(DeepSpeedConfigModel):
+    """reference: "aio" block (runtime/swap_tensor/aio_config.py).
+    thread_count feeds the native pread/pwrite pool (csrc/aio.cpp); the
+    libaio-specific knobs are accepted for schema parity and warned inert."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    # reference default is 1; the threaded pread/pwrite pool here measured
+    # best at 4 on the local SSDs, so that stays the default.  The libaio-
+    # specific knobs (block_size/queue_depth/single_submit/overlap_events)
+    # warn inert when changed (warn_inert_config).
+    thread_count: int = 4
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class ElasticityJSONConfig(DeepSpeedConfigModel):
+    """reference: "elasticity" ds_config block (elasticity/config.py
+    ElasticityConfig) — when enabled, the SOLVER controls the batch triad
+    (runtime/config.py:733)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10_000
+    num_gpus_per_node: int = 1
+    model_parallel_size: int = 1
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+
 class GradientCompressionConfig(DeepSpeedConfigModel):
     """DCN-tier gradient compression (replaces reference 1-bit optimizers'
     error-feedback compression, runtime/fp16/onebit/ — see SURVEY.md: pointless over
@@ -291,6 +327,9 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     compression_training: Optional[dict] = None
     gradient_compression: GradientCompressionConfig = Field(
         default_factory=GradientCompressionConfig)
+    elasticity: ElasticityJSONConfig = Field(
+        default_factory=ElasticityJSONConfig)
+    aio: AIOConfig = Field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0
     prescale_gradients: bool = False
@@ -378,6 +417,13 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
     from deepspeed_tpu.utils.logging import logger
     inert = []
     z = cfg.zero_optimization
+    for blk, name in ((z.offload_optimizer, "offload_optimizer"),
+                      (z.offload_param, "offload_param")):
+        if blk.device != "none" and blk.ratio != 1.0:
+            inert.append(f"zero_optimization.{name}.ratio "
+                         f"(partial offload — the host tier here is "
+                         f"all-or-nothing; ratio={blk.ratio} will offload "
+                         f"everything)")
     if z.zero_quantized_weights and z.stage < 3:
         inert.append("zero_optimization.zero_quantized_weights (qwZ is the "
                      "stage-3 weight all-gather; inert at stage "
@@ -387,6 +433,26 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
                      "quantized grad reduce-scatter; the collective exists — "
                      "ops/quantization.quantized_psum_scatter — but the "
                      "engine grad path does not route through it yet)")
+    # reference top-level blocks that are accepted for schema parity but have
+    # no TPU behavior (extra="allow" would otherwise swallow them silently)
+    aio_defaults = AIOConfig()
+    for knob in ("block_size", "queue_depth", "single_submit",
+                 "overlap_events"):
+        if getattr(cfg.aio, knob) != getattr(aio_defaults, knob):
+            inert.append(f"aio.{knob} (libaio-specific; the native "
+                         f"pread/pwrite pool honors thread_count only)")
+    extras = getattr(cfg, "__pydantic_extra__", None) or {}
+    for key, hint in (
+            ("amp", "apex AMP is CUDA-specific; use bf16/fp16 blocks"),
+            ("sparse_attention", "use ops.sparse_attention "
+             "(SparsityConfig API) — the module-injection config block has "
+             "no analog"),
+            ("checkpoint", "orbax handles parallel/sharded writes natively"),
+            ("communication_data_type", "see gradient_compression / "
+             "data_types"),
+            ("sparse_gradients", "no torch sparse-embedding analog")):
+        if key in extras:
+            inert.append(f"{key} ({hint})")
     # zero_hpz_partition_size at stage<3 is a hard engine error (not inert)
     ac = cfg.activation_checkpointing
     if ac.partition_activations or ac.cpu_checkpointing or ac.number_checkpoints:

@@ -116,6 +116,11 @@ class DeepSpeedTPUEngine:
             mesh = mesh_lib.build_mesh(spec)
         self.mesh = mesh
         self.dp_world_size = mesh.shape["dp"] * mesh.shape["fsdp"]
+        if config.elasticity.enabled:
+            # the SOLVER controls the batch triad (reference
+            # runtime/config.py:733: elastic config overrides / rejects
+            # user-set batch params)
+            self._apply_elasticity_config(config)
         config.resolve_batch_size(self.dp_world_size)
 
         self.zero_stage = config.zero_optimization.stage
@@ -249,7 +254,8 @@ class DeepSpeedTPUEngine:
                     "drop the client optimizer or offload")
             self.offload_opt = OffloadAdam(
                 config.optimizer.type, config.optimizer.params,
-                device=off.device, nvme_path=off.nvme_path)
+                device=off.device, nvme_path=off.nvme_path,
+                aio_threads=max(1, int(config.aio.thread_count)))
             # API contract: initialize() returns the swapped-in host optimizer
             # (reference returns DeepSpeedCPUAdam on the offload path)
             self.optimizer = self.offload_opt
@@ -443,6 +449,58 @@ class DeepSpeedTPUEngine:
             f"global_bs={config.train_batch_size}", ranks=[0])
 
     # ------------------------------------------------------------------ builders
+
+    def _apply_elasticity_config(self, config):
+        """ds_config "elasticity" block (reference runtime/config.py:733):
+        solve the batch geometry for the CURRENT world size and take control
+        of the batch triad; explicitly-set batch params are an error unless
+        ignore_non_elastic_batch_info."""
+        from deepspeed_tpu.constants import AUTO
+        from deepspeed_tpu.elasticity import (ElasticityConfig,
+                                              compute_elastic_config)
+        e = config.elasticity
+        triad_set = any(v != AUTO for v in (
+            config.train_batch_size, config.train_micro_batch_size_per_gpu,
+            config.gradient_accumulation_steps))
+        if triad_set and not e.ignore_non_elastic_batch_info:
+            raise ValueError(
+                "batch-related parameters found in the ds_config while "
+                "elasticity is enabled — elastic training controls "
+                "train_batch_size/train_micro_batch_size_per_gpu/"
+                "gradient_accumulation_steps; remove them or set "
+                "elasticity.ignore_non_elastic_batch_info (reference "
+                "ElasticityConfigError semantics)")
+        if float(e.version) not in (0.1, 0.2):
+            raise ValueError(
+                f"elasticity.version {e.version} is not supported "
+                f"(reference semantics: 0.1 chip-granular, 0.2 "
+                f"host-granular)")
+        chips = self.dp_world_size * e.model_parallel_size
+        ec = ElasticityConfig(
+            enabled=True,
+            max_train_batch_size=e.max_train_batch_size,
+            micro_batch_sizes=list(e.micro_batch_sizes),
+            min_chips=e.min_gpus, max_chips=e.max_gpus,
+            # v0.1 solves at CHIP granularity (reference elasticity.py
+            # version gate); v0.2 adds the host-granular constraint.  The
+            # chip-granular unit is one model replica (mp chips).
+            chips_per_host=(e.num_gpus_per_node
+                            if float(e.version) >= 0.2
+                            else e.model_parallel_size),
+            model_parallel_size=e.model_parallel_size,
+            prefer_larger_batch=e.prefer_larger_batch,
+            version=e.version)
+        batch, valid_dp, micro = compute_elastic_config(ec, chips)
+        if micro is None:
+            raise ValueError(
+                f"elasticity: no micro batch in {e.micro_batch_sizes} "
+                f"divides batch {batch} at dp world {self.dp_world_size}")
+        gas = batch // (micro * self.dp_world_size)
+        config.train_batch_size = batch
+        config.train_micro_batch_size_per_gpu = micro
+        config.gradient_accumulation_steps = gas
+        log_dist(f"[Elasticity] batch={batch} micro={micro} gas={gas} "
+                 f"valid dp counts={valid_dp}", ranks=[0])
 
     def _build_tx(self, client_optimizer):
         cfg = self.config

@@ -379,9 +379,11 @@ class InfinityEngine:
             config.activation_checkpointing.cpu_checkpointing)
 
         from deepspeed_tpu.runtime.offload import OffloadAdam
+        aio_threads = max(1, int(config.aio.thread_count))
         self.offload_opt = OffloadAdam(
             config.optimizer.type, config.optimizer.params,
-            device=moments_device, nvme_path=off_o.nvme_path)
+            device=moments_device, nvme_path=off_o.nvme_path,
+            aio_threads=aio_threads)
         self.optimizer = self.offload_opt
         self._opt_params = dict(config.optimizer.params)
         self.lr_schedule = lr_scheduler
@@ -462,7 +464,8 @@ class InfinityEngine:
         rng = jax.random.PRNGKey(config.seed)
         k_embed, k_layers, k_head, self._rng = jax.random.split(rng, 4)
         store_kw = dict(device=off_p.device, nvme_path=off_p.nvme_path,
-                        buffer_count=off_p.buffer_count)
+                        buffer_count=off_p.buffer_count,
+                        aio_threads=aio_threads)
 
         def to_host_compute(tree):
             return jax.tree_util.tree_map(

@@ -25,13 +25,12 @@ import pytest  # noqa: E402
 
 
 # ---- quick tier (VERDICT r2 weak #10): `pytest -m quick` runs the core-
-# correctness slice in a few minutes, for the fast inner loop; the full
-# suite stays the merge gate.
+# correctness slice (~7 min measured single-core: engine 273s + ops 123s +
+# config/mesh 9s) for the fast inner loop; the full suite stays the merge
+# gate.
 QUICK_MODULES = {
     "test_config.py", "test_mesh_partition.py", "test_engine.py",
-    "test_ops.py", "test_offload.py", "test_observability.py",
-    "test_pipeline.py", "test_moe.py", "test_ulysses.py",
-    "test_infinity.py",
+    "test_ops.py",
 }
 
 

@@ -69,6 +69,65 @@ class TestSolver:
                 micro_batch_sizes=[64], max_train_batch_size=32))
 
 
+class TestElasticityConfigBlock:
+    """The ds_config "elasticity" block takes control of the batch triad at
+    initialize (reference runtime/config.py:733)."""
+
+    def _model(self):
+        return GPT(GPTConfig.tiny(vocab_size=64, max_seq_len=16))
+
+    def test_solver_controls_batch_triad(self, devices):
+        import deepspeed_tpu
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=self._model(), config={
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "mesh": {"dp": -1, "fsdp": 1},
+                "elasticity": {"enabled": True,
+                               "max_train_batch_size": 64,
+                               "micro_batch_sizes": [1, 2, 4],
+                               "min_gpus": 1, "max_gpus": 8},
+                "steps_per_print": 0,
+            }, example_batch={"input_ids": np.zeros((1, 16), np.int32)})
+        cfg = engine.config
+        assert cfg.train_batch_size == (
+            cfg.train_micro_batch_size_per_gpu
+            * cfg.gradient_accumulation_steps * engine.dp_world_size)
+        assert cfg.train_micro_batch_size_per_gpu in (1, 2, 4)
+        # and the engine actually trains at the solved geometry
+        rng = np.random.default_rng(0)
+        m = engine.train_batch({"input_ids": rng.integers(
+            0, 64, (engine.train_batch_size, 16)).astype(np.int32)})
+        assert np.isfinite(float(m.loss))
+
+    def test_user_batch_params_rejected(self):
+        import deepspeed_tpu
+        with pytest.raises(ValueError, match="elastic"):
+            deepspeed_tpu.initialize(
+                model=self._model(), config={
+                    "train_batch_size": 16,
+                    "elasticity": {"enabled": True,
+                                   "micro_batch_sizes": [1, 2],
+                                   "max_train_batch_size": 32},
+                }, example_batch={"input_ids": np.zeros((1, 16), np.int32)})
+
+    def test_ignore_non_elastic_batch_info(self, devices):
+        import deepspeed_tpu
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=self._model(), config={
+                "train_batch_size": 16,       # ignored, solver wins
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "mesh": {"dp": -1, "fsdp": 1},
+                "elasticity": {"enabled": True,
+                               "max_train_batch_size": 64,
+                               "micro_batch_sizes": [1, 2, 4],
+                               "ignore_non_elastic_batch_info": True},
+                "steps_per_print": 0,
+            }, example_batch={"input_ids": np.zeros((1, 16), np.int32)})
+        # the SOLVER's geometry wins (candidates at dp=8 are 48/60/64,
+        # never the user's 16)
+        assert engine.train_batch_size != 16
+
+
 class TestAutotuner:
     def test_micro_batch_search(self):
         cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)

@@ -93,6 +93,34 @@ class TestInfinityNumerics:
         l_inf = [float(inf.train_batch(b).loss) for b in data]
         np.testing.assert_allclose(l_inf, l_base, rtol=2e-4, atol=2e-5)
 
+    def test_bf16_matches_in_hbm(self):
+        """bf16 Infinity (bf16 streamed params, fused host-Adam bf16 write)
+        must track the in-HBM bf16 ZeRO-3 run within bf16 noise."""
+        mc = _cfg(n_layers=2)
+        model = GPT(mc)
+        example = {"input_ids": np.zeros((1, SEQ), np.int32)}
+        base_cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-2, "weight_decay": 0.01}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"dp": 1, "fsdp": -1},
+            "steps_per_print": 0,
+        }
+        base, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=base_cfg, example_batch=example)
+        ds = _ds_config()
+        ds["bf16"] = {"enabled": True}
+        inf = _build_infinity(mc, ds)
+        inf.load_params(gpt_params_to_infinity(
+            jax.device_get(base.state.params), mc))
+        data = _data(5, base.train_batch_size)
+        l_base = [float(base.train_batch(b).loss) for b in data]
+        l_inf = [float(inf.train_batch(b).loss) for b in data]
+        np.testing.assert_allclose(l_inf, l_base, rtol=0.05, atol=0.02)
+        assert inf.compute_dtype.__name__ == "bfloat16"
+
     def test_tied_embedding_grads(self):
         """Tied wte gets BOTH the embedding-gather and the unembed cotangent
         (the reference's tied-layer grad reduction)."""

@@ -202,6 +202,23 @@ class TestInertConfigWarnings:
             "stage": 3, "offload_param": {"device": "cpu"}}})
         assert "offload_param" not in " ".join(warn_inert_config(cfg2))
 
+    def test_reference_extra_blocks_warn(self):
+        """Top-level reference blocks with no TPU analog must scream instead
+        of vanishing into pydantic extra='allow'."""
+        cfg = parse_config({
+            "amp": {"enabled": True},
+            "sparse_attention": {"mode": "fixed"},
+            "checkpoint": {"use_node_local_storage": True},
+            "communication_data_type": "fp16",
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu", "ratio": 0.3}},
+        })
+        joined = " ".join(warn_inert_config(cfg))
+        for key in ("amp", "sparse_attention", "checkpoint",
+                    "communication_data_type", "ratio"):
+            assert key in joined, key
+
     def test_implemented_keys_do_not_warn(self):
         """gradient_compression + stage-3 qwZ are live now (round 2) — the
         inert list must NOT name them."""
