@@ -141,6 +141,33 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
         raise TypeError(f"config must be dict/path/config model, got "
                         f"{type(cfg)!r}")
 
+    from deepspeed_tpu.checkpoint.diffusion import is_diffusers_model_dir
+    if is_diffusers_model_dir(model):
+        # SD containers (reference module_inject/containers/{unet,vae}.py)
+        from deepspeed_tpu.checkpoint.diffusion import _read_json
+        from deepspeed_tpu.inference.config import _DTYPE_ALIASES
+        from deepspeed_tpu.inference.diffusion import UNetEngine, VAEEngine
+        import os as _os
+        if params is not None:
+            raise ValueError("pass either a diffusers model dir or params, "
+                             "not both")
+        if mesh is not None:
+            raise ValueError("the SD containers are single-mesh jitted "
+                             "forwards; mesh selection is not consumed — "
+                             "drop the mesh argument")
+        merged = dict(as_dict(config), **kwargs)
+        raw_dt = str(merged.get("dtype", "fp32")).lower().replace(
+            "torch.", "")
+        if raw_dt not in _DTYPE_ALIASES:
+            raise ValueError(f"unknown dtype {merged.get('dtype')!r}; one "
+                             f"of {sorted(_DTYPE_ALIASES)}")
+        dt = _DTYPE_ALIASES[raw_dt]
+        cls = _read_json(_os.path.join(str(model),
+                                       "config.json"))["_class_name"]
+        eng_cls = UNetEngine if cls == "UNet2DConditionModel" else VAEEngine
+        return eng_cls(str(model), dtype=dt,
+                       channels_last=bool(merged.get("channels_last",
+                                                     False)))
     if is_hf_model_dir(model):
         if params is not None:
             raise ValueError("pass either an HF model dir or params, not both")

@@ -1,0 +1,238 @@
+"""Diffusers checkpoint import — UNet2DConditionModel / AutoencoderKL.
+
+Reference parity: ``module_inject/containers/unet.py`` + ``vae.py`` consume
+diffusers modules in-place; here the diffusers ``diffusion_pytorch_model.
+safetensors`` + ``config.json`` pair loads directly into the pure-function
+models in ``models/diffusion.py``.
+
+Import policy matches ``checkpoint/hf.py``: STRICT — every tensor in the
+checkpoint must be consumed and every leaf the model needs must be filled;
+anything else raises instead of silently serving wrong images.
+
+Layout transforms (torch → TPU-native):
+- conv  [O, I, kh, kw] → HWIO [kh, kw, I, O]
+- linear [O, I]        → [I, O]
+- norm weight/bias     → scale/bias
+Old-style VAE attention names (query/key/value/proj_attn) are accepted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.diffusion import UNetConfig, VAEConfig
+
+
+def _conv(w):
+    return np.ascontiguousarray(np.transpose(np.asarray(w), (2, 3, 1, 0)))
+
+
+def _lin(w):
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _read_json(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _load_safetensors(d: str) -> Dict[str, np.ndarray]:
+    import safetensors.numpy
+    for name in ("diffusion_pytorch_model.safetensors",
+                 "model.safetensors"):
+        p = os.path.join(d, name)
+        if os.path.exists(p):
+            return dict(safetensors.numpy.load_file(p))
+    raise FileNotFoundError(f"no safetensors weights under {d}")
+
+
+def _place(tree: Dict[str, Any], dotted: str, value) -> None:
+    """'down_blocks.0.resnets.1.conv1.kernel' → nested dict/list write."""
+    parts = dotted.split(".")
+    node: Any = tree
+    for i, part in enumerate(parts[:-1]):
+        idx = int(part) if part.isdigit() else part
+        nxt_is_index = parts[i + 1].isdigit() if i + 1 < len(parts) else False
+        if isinstance(idx, int):
+            while len(node) <= idx:
+                node.append([] if nxt_is_index else {})
+            if node[idx] == {} and nxt_is_index:
+                node[idx] = []
+            node = node[idx]
+        else:
+            if idx not in node:
+                node[idx] = [] if nxt_is_index else {}
+            node = node[idx]
+    node[parts[-1]] = value
+
+
+_OLD_VAE_ATTN = {"query": "to_q", "key": "to_k", "value": "to_v",
+                 "proj_attn": "to_out"}
+
+
+def _translate(name: str) -> Tuple[str, Any]:
+    """diffusers tensor name → (tree path, transform fn)."""
+    is_weight = name.endswith(".weight")
+    base = name.rsplit(".", 1)[0]
+    leaf = name.rsplit(".", 1)[1]
+
+    # norm layers: weight/bias → scale/bias
+    norm_like = re.search(
+        r"(?:^|\.)(norm\d?|group_norm|conv_norm_out|norm_out)$", base)
+    if norm_like:
+        if base.endswith("norm_out") and not base.endswith("conv_norm_out"):
+            base = base[: -len("norm_out")] + "conv_norm_out"
+        return (base + (".scale" if is_weight else ".bias"), np.asarray)
+
+    # structural renames
+    base = re.sub(r"downsamplers\.0\.conv$", "downsampler", base)
+    base = re.sub(r"upsamplers\.0\.conv$", "upsampler", base)
+    base = re.sub(r"\.to_out\.0$", ".to_out", base)
+    base = re.sub(r"\.ff\.net\.0\.proj$", ".ff_proj", base)
+    base = re.sub(r"\.ff\.net\.2$", ".ff_out", base)
+    for old, new in _OLD_VAE_ATTN.items():
+        base = re.sub(rf"\.{old}$", f".{new}", base)
+
+    conv_like = re.search(
+        r"(conv_in|conv_out|conv1|conv2|conv_shortcut|downsampler|upsampler|"
+        r"quant_conv|post_quant_conv)$", base)
+    if leaf == "bias":
+        return base + ".bias", np.asarray
+    if conv_like:
+        return base + ".kernel", _conv
+    # everything else with a .weight is a linear (attention projections,
+    # time_emb_proj, ff, proj_in/proj_out under use_linear_projection)
+    return base + ".kernel", _lin
+
+
+def _import_tree(weights: Dict[str, np.ndarray],
+                 proj_is_conv: bool) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for name, w in sorted(weights.items()):
+        path, fn = _translate(name)
+        if proj_is_conv and re.search(r"proj_(in|out)\.kernel$", path):
+            fn = _conv if np.asarray(w).ndim == 4 else _lin
+        # old VAE attention stored projections as 1x1 convs [O, I, 1, 1]
+        if (np.asarray(w).ndim == 4 and fn is _lin):
+            w = np.asarray(w)[:, :, 0, 0]
+        _place(tree, path, fn(w))
+    return tree
+
+
+def _leaf_paths(node, prefix="") -> Dict[str, Tuple[int, ...]]:
+    out: Dict[str, Tuple[int, ...]] = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            out.update(_leaf_paths(v, f"{prefix}.{k}" if prefix else k))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            out.update(_leaf_paths(v, f"{prefix}.{i}"))
+    else:
+        out[prefix] = tuple(np.asarray(node).shape) \
+            if not hasattr(node, "shape") else tuple(node.shape)
+    return out
+
+
+def _check_structure(tree, expected_tree, what: str) -> None:
+    """The REAL strict check: the imported tree must have exactly the leaf
+    paths and shapes the config-derived abstract structure promises — a
+    truncated, padded, or misrouted checkpoint fails HERE, not as an opaque
+    KeyError inside the jitted forward."""
+    got = _leaf_paths(tree)
+    want = _leaf_paths(expected_tree)
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    if missing or extra:
+        raise ValueError(
+            f"{what} checkpoint does not match the config structure: "
+            f"missing={missing[:8]}{'...' if len(missing) > 8 else ''} "
+            f"unexpected={extra[:8]}{'...' if len(extra) > 8 else ''}")
+    bad = [(p, got[p], want[p]) for p in want if got[p] != want[p]]
+    if bad:
+        p, g, w = bad[0]
+        raise ValueError(f"{what} checkpoint shape mismatch at {p}: "
+                         f"{g} != expected {w} ({len(bad)} total)")
+
+
+def load_hf_unet(model_dir: str, dtype=None):
+    """diffusers UNet2DConditionModel dir (config.json + safetensors) →
+    (UNetConfig, params tree for models.diffusion.unet_forward)."""
+    import jax.numpy as jnp
+    hf = _read_json(os.path.join(model_dir, "config.json"))
+    cls = hf.get("_class_name", "UNet2DConditionModel")
+    if cls != "UNet2DConditionModel":
+        raise ValueError(f"{model_dir}: expected UNet2DConditionModel, "
+                         f"got {cls}")
+    cfg = UNetConfig.from_hf(hf, dtype=dtype or jnp.float32)
+    weights = _load_safetensors(model_dir)
+    tree = _import_tree(weights, proj_is_conv=not cfg.use_linear_projection)
+    import jax
+    from deepspeed_tpu.models.diffusion import init_unet_params
+    expected = jax.eval_shape(
+        lambda k: init_unet_params(k, cfg), jax.random.PRNGKey(0))
+    _check_structure(tree, expected, "UNet")
+    _validate_against_config(tree, cfg)
+    return cfg, tree
+
+
+def load_hf_vae(model_dir: str, dtype=None):
+    """diffusers AutoencoderKL dir → (VAEConfig, params tree)."""
+    import jax.numpy as jnp
+    hf = _read_json(os.path.join(model_dir, "config.json"))
+    cls = hf.get("_class_name", "AutoencoderKL")
+    if cls != "AutoencoderKL":
+        raise ValueError(f"{model_dir}: expected AutoencoderKL, got {cls}")
+    cfg = VAEConfig.from_hf(hf, dtype=dtype or jnp.float32)
+    weights = _load_safetensors(model_dir)
+    tree = _import_tree(weights, proj_is_conv=False)
+    import jax
+    from deepspeed_tpu.models.diffusion import init_vae_params
+    expected = jax.eval_shape(
+        lambda k: init_vae_params(k, cfg), jax.random.PRNGKey(0))
+    _check_structure(tree, expected, "VAE")
+    return cfg, tree
+
+
+def _validate_against_config(tree: Dict[str, Any], cfg: UNetConfig) -> None:
+    """Structural completeness: the tree must contain exactly the blocks the
+    config promises (a truncated checkpoint must not serve)."""
+    need = ("conv_in", "time_embedding", "down_blocks", "mid_block",
+            "up_blocks", "conv_norm_out", "conv_out")
+    for key in need:
+        if key not in tree:
+            raise ValueError(f"UNet checkpoint missing {key}")
+    if len(tree["down_blocks"]) != len(cfg.down_block_types):
+        raise ValueError(
+            f"UNet checkpoint has {len(tree['down_blocks'])} down blocks; "
+            f"config promises {len(cfg.down_block_types)}")
+    if len(tree["up_blocks"]) != len(cfg.up_block_types):
+        raise ValueError(
+            f"UNet checkpoint has {len(tree['up_blocks'])} up blocks; "
+            f"config promises {len(cfg.up_block_types)}")
+    for i, btype in enumerate(cfg.down_block_types):
+        bp = tree["down_blocks"][i]
+        if len(bp["resnets"]) != cfg.layers_per_block:
+            raise ValueError(f"down block {i}: {len(bp['resnets'])} resnets "
+                             f"!= layers_per_block {cfg.layers_per_block}")
+        has_attn = "attentions" in bp and bp["attentions"]
+        if (btype == "CrossAttnDownBlock2D") != bool(has_attn):
+            raise ValueError(f"down block {i}: attention presence does not "
+                             f"match type {btype}")
+
+
+def is_diffusers_model_dir(path) -> bool:
+    if not isinstance(path, (str, os.PathLike)):
+        return False
+    cfg = os.path.join(str(path), "config.json")
+    if not os.path.exists(cfg):
+        return False
+    try:
+        cls = _read_json(cfg).get("_class_name", "")
+    except (OSError, json.JSONDecodeError):
+        return False
+    return cls in ("UNet2DConditionModel", "AutoencoderKL")

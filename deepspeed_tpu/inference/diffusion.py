@@ -1,0 +1,192 @@
+"""Stable-diffusion serving — UNet/VAE engines + a txt2img pipeline.
+
+Reference parity: ``module_inject/containers/unet.py`` and ``vae.py`` are
+serving CONTAINERS — they wrap the diffusers modules with the optimized
+attention kernels and dtype policy.  The analog here: jitted NHWC forwards
+over the pure-function models (attention already rides the ops registry),
+with the NCHW↔NHWC transposes at the boundary so diffusers-convention
+callers drop in.
+
+``StableDiffusionPipeline`` composes the three towers this framework serves
+(CLIP text — ``inference/encoder.ClipTextEngine`` — UNet, VAE) into a
+classifier-free-guidance txt2img loop with a DDIM sampler, which is what the
+reference's SD inference tutorial assembles out of its containers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.diffusion import (UNetConfig, VAEConfig,
+                                            unet_forward, vae_decode,
+                                            vae_encode)
+from deepspeed_tpu.utils.logging import log_dist
+
+_DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
+           "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+           "fp16": jnp.float16, "float16": jnp.float16}
+
+
+def _nchw_to_nhwc(x):
+    return jnp.transpose(jnp.asarray(x), (0, 2, 3, 1))
+
+
+def _nhwc_to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+class UNetEngine:
+    """Jitted UNet2DCondition forward (reference unet container role).
+
+    ``__call__(sample, timesteps, encoder_hidden_states)`` accepts NCHW
+    (diffusers convention) or NHWC (``channels_last=True``) latents."""
+
+    def __init__(self, model_dir_or_cfg, params=None, *,
+                 dtype: str = "fp32", channels_last: bool = False):
+        if isinstance(model_dir_or_cfg, UNetConfig):
+            assert params is not None, "pass params with an explicit config"
+            self.cfg = model_dir_or_cfg
+        else:
+            from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
+            self.cfg, params = load_hf_unet(model_dir_or_cfg,
+                                            dtype=_DTYPES[dtype])
+        import dataclasses
+        self.cfg = dataclasses.replace(self.cfg, dtype=_DTYPES[dtype])
+        self.channels_last = channels_last
+        conv = (lambda l: jnp.asarray(l, _DTYPES[dtype])
+                if np.asarray(l).dtype.kind == "f" else jnp.asarray(l))
+        self.params = jax.tree_util.tree_map(conv, params)
+        cfg = self.cfg
+
+        def fwd(p, sample, t, ctx):
+            return unet_forward(p, sample, t, ctx, cfg)
+        self._fwd = jax.jit(fwd)
+        n = sum(int(np.prod(np.asarray(l).shape))
+                for l in jax.tree_util.tree_leaves(self.params))
+        log_dist(f"unet engine ready: params={n/1e6:.1f}M "
+                 f"blocks={cfg.block_out_channels} dtype={dtype}", ranks=[0])
+
+    def __call__(self, sample, timesteps, encoder_hidden_states):
+        if not self.channels_last:
+            sample = _nchw_to_nhwc(sample)
+        out = self._fwd(self.params, sample, jnp.asarray(timesteps),
+                        jnp.asarray(encoder_hidden_states))
+        return out if self.channels_last else _nhwc_to_nchw(out)
+
+
+class VAEEngine:
+    """Jitted AutoencoderKL encode/decode (reference vae container role)."""
+
+    def __init__(self, model_dir_or_cfg, params=None, *,
+                 dtype: str = "fp32", channels_last: bool = False):
+        if isinstance(model_dir_or_cfg, VAEConfig):
+            assert params is not None
+            self.cfg = model_dir_or_cfg
+        else:
+            from deepspeed_tpu.checkpoint.diffusion import load_hf_vae
+            self.cfg, params = load_hf_vae(model_dir_or_cfg,
+                                           dtype=_DTYPES[dtype])
+        import dataclasses
+        self.cfg = dataclasses.replace(self.cfg, dtype=_DTYPES[dtype])
+        self.channels_last = channels_last
+        conv = (lambda l: jnp.asarray(l, _DTYPES[dtype])
+                if np.asarray(l).dtype.kind == "f" else jnp.asarray(l))
+        self.params = jax.tree_util.tree_map(conv, params)
+        cfg = self.cfg
+        self._enc = jax.jit(lambda p, x: vae_encode(p, x, cfg))
+        self._dec = jax.jit(lambda p, z: vae_decode(p, z, cfg))
+
+    def encode(self, image):
+        if not self.channels_last:
+            image = _nchw_to_nhwc(image)
+        z = self._enc(self.params, image)
+        return z if self.channels_last else _nhwc_to_nchw(z)
+
+    def decode(self, latent):
+        if not self.channels_last:
+            latent = _nchw_to_nhwc(latent)
+        img = self._dec(self.params, latent)
+        return img if self.channels_last else _nhwc_to_nchw(img)
+
+
+class DDIMScheduler:
+    """Deterministic DDIM (eta=0) over the SD beta schedule — the minimal
+    sampler the pipeline needs (scaled_linear betas, the SD default)."""
+
+    def __init__(self, num_train_timesteps: int = 1000,
+                 beta_start: float = 0.00085, beta_end: float = 0.012):
+        betas = np.linspace(beta_start ** 0.5, beta_end ** 0.5,
+                            num_train_timesteps, dtype=np.float64) ** 2
+        self.alphas_cumprod = np.cumprod(1.0 - betas)
+        self.num_train_timesteps = num_train_timesteps
+
+    def timesteps(self, steps: int) -> np.ndarray:
+        stride = self.num_train_timesteps // steps
+        return (np.arange(steps) * stride + 1)[::-1].copy()
+
+    def step(self, noise_pred, t: int, t_prev: int, sample):
+        a_t = float(self.alphas_cumprod[t])
+        a_prev = (float(self.alphas_cumprod[t_prev]) if t_prev >= 0 else 1.0)
+        x0 = (sample - (1 - a_t) ** 0.5 * noise_pred) / a_t ** 0.5
+        return a_prev ** 0.5 * x0 + (1 - a_prev) ** 0.5 * noise_pred
+
+
+class StableDiffusionPipeline:
+    """txt2img: CLIP text encode → CFG denoising loop → VAE decode.
+
+    ``text``: ClipTextEngine (inference/encoder.py).  ``unet``/``vae``: the
+    engines above (channels_last or not — handled)."""
+
+    def __init__(self, text, unet: UNetEngine, vae: VAEEngine,
+                 scheduler: Optional[DDIMScheduler] = None):
+        self.text = text
+        self.unet = unet
+        self.vae = vae
+        self.scheduler = scheduler or DDIMScheduler()
+
+    def __call__(self, prompt_ids, uncond_ids, *, steps: int = 20,
+                 guidance_scale: float = 7.5, height: int = 512,
+                 width: int = 512, seed: int = 0):
+        """prompt_ids/uncond_ids: tokenized [B, T] int32 (the tokenizer stays
+        with the caller, as in the reference tutorial).  Returns NCHW images
+        in [-1, 1]."""
+        B = np.asarray(prompt_ids).shape[0]
+        hidden_c, _ = self.text(prompt_ids)      # [B, T, H] last hidden
+        hidden_u, _ = self.text(uncond_ids)
+        ctx = jnp.concatenate([jnp.asarray(hidden_u), jnp.asarray(hidden_c)])
+
+        lat_c = self.unet.cfg.in_channels
+        # spatial ratio = one downsample per VAE level after the first
+        # (8x for the SD AutoencoderKL)
+        ratio = 2 ** (len(self.vae.cfg.block_out_channels) - 1)
+        h, w = height // ratio, width // ratio
+        rng = jax.random.PRNGKey(seed)
+        latents = jax.random.normal(rng, (B, lat_c, h, w), jnp.float32)
+
+        # the pipeline's internal layout is NCHW; engines built with
+        # channels_last=True expect NHWC, so convert at their boundary
+        def to_engine(x, eng):
+            return _nchw_to_nhwc(x) if eng.channels_last else x
+
+        def from_engine(x, eng):
+            return _nhwc_to_nchw(x) if eng.channels_last else jnp.asarray(x)
+
+        ts = self.scheduler.timesteps(steps)
+        for i, t in enumerate(ts):
+            t_prev = int(ts[i + 1]) if i + 1 < len(ts) else -1
+            inp = jnp.concatenate([latents, latents])
+            noise = self.unet(to_engine(inp, self.unet),
+                              np.full((2 * B,), t, np.int32), ctx)
+            noise = from_engine(noise, self.unet)
+            n_u, n_c = jnp.split(noise, 2)
+            guided = n_u + guidance_scale * (n_c - n_u)
+            latents = self.scheduler.step(np.asarray(guided, np.float64),
+                                          int(t), t_prev,
+                                          np.asarray(latents, np.float64))
+            latents = jnp.asarray(latents, jnp.float32)
+        return from_engine(self.vae.decode(to_engine(latents, self.vae)),
+                           self.vae)

@@ -1,0 +1,362 @@
+"""SD UNet/VAE tests (reference analog: the unet/vae container injection
+tests).  diffusers is not in the image, so parity rests on: (a) primitive
+blocks checked against independent numpy reimplementations written in THIS
+file, (b) a strict import test against a synthetic checkpoint whose tensor
+names are spelled out by hand from the diffusers naming rules (independently
+of the importer's translate logic), and (c) structural/determinism
+invariants of the full towers."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.diffusion import (UNetConfig, VAEConfig,
+                                            group_norm, init_unet_params,
+                                            init_vae_params,
+                                            timestep_embedding,
+                                            cross_attention, resnet_block,
+                                            unet_forward, vae_decode,
+                                            vae_encode)
+
+
+@pytest.fixture()
+def tiny_unet():
+    cfg = UNetConfig.tiny()
+    params = init_unet_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestPrimitives:
+    def test_group_norm_matches_numpy(self, rng):
+        x = jnp.asarray(rng.standard_normal((2, 4, 4, 8)), jnp.float32)
+        p = {"scale": jnp.asarray(rng.standard_normal(8), jnp.float32),
+             "bias": jnp.asarray(rng.standard_normal(8), jnp.float32)}
+        got = np.asarray(group_norm(p, x, groups=2, eps=1e-5))
+        # independent numpy reference
+        xn = np.asarray(x).reshape(2, 4, 4, 2, 4)
+        m = xn.mean(axis=(1, 2, 4), keepdims=True)
+        v = xn.var(axis=(1, 2, 4), keepdims=True)
+        ref = ((xn - m) / np.sqrt(v + 1e-5)).reshape(2, 4, 4, 8)
+        ref = ref * np.asarray(p["scale"]) + np.asarray(p["bias"])
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_timestep_embedding_matches_numpy(self):
+        t = jnp.asarray([0, 10, 999])
+        dim = 16
+        got = np.asarray(timestep_embedding(t, dim, flip_sin_to_cos=True,
+                                            freq_shift=0))
+        half = dim // 2
+        freqs = np.exp(-np.log(10000.0) * np.arange(half) / half)
+        ang = np.asarray(t)[:, None] * freqs[None, :]
+        ref = np.concatenate([np.cos(ang), np.sin(ang)], -1)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_cross_attention_matches_naive_softmax(self, rng):
+        C, ctx_dim, heads = 16, 12, 4
+        p = {"to_q": {"kernel": jnp.asarray(
+                rng.standard_normal((C, C)), jnp.float32)},
+             "to_k": {"kernel": jnp.asarray(
+                rng.standard_normal((ctx_dim, C)), jnp.float32)},
+             "to_v": {"kernel": jnp.asarray(
+                rng.standard_normal((ctx_dim, C)), jnp.float32)},
+             "to_out": {"kernel": jnp.asarray(
+                rng.standard_normal((C, C)), jnp.float32),
+                "bias": jnp.zeros((C,), jnp.float32)}}
+        x = jnp.asarray(rng.standard_normal((2, 5, C)), jnp.float32)
+        ctx = jnp.asarray(rng.standard_normal((2, 7, ctx_dim)), jnp.float32)
+        got = np.asarray(cross_attention(p, x, ctx, heads))
+        # independent numpy attention
+        q = np.asarray(x) @ np.asarray(p["to_q"]["kernel"])
+        k = np.asarray(ctx) @ np.asarray(p["to_k"]["kernel"])
+        v = np.asarray(ctx) @ np.asarray(p["to_v"]["kernel"])
+        hd = C // heads
+        out = np.zeros_like(q)
+        for b in range(2):
+            for h in range(heads):
+                qs = q[b, :, h * hd:(h + 1) * hd]
+                ks = k[b, :, h * hd:(h + 1) * hd]
+                vs = v[b, :, h * hd:(h + 1) * hd]
+                s = qs @ ks.T / np.sqrt(hd)
+                pr = np.exp(s - s.max(-1, keepdims=True))
+                pr /= pr.sum(-1, keepdims=True)
+                out[b, :, h * hd:(h + 1) * hd] = pr @ vs
+        ref = out @ np.asarray(p["to_out"]["kernel"])
+        np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+
+    def test_resnet_block_residual_identity_at_zero_weights(self, rng):
+        """Zero convs ⇒ the block is the identity (residual path only)."""
+        C = 8
+        p = {"norm1": {"scale": jnp.ones(C), "bias": jnp.zeros(C)},
+             "conv1": {"kernel": jnp.zeros((3, 3, C, C)),
+                       "bias": jnp.zeros(C)},
+             "norm2": {"scale": jnp.ones(C), "bias": jnp.zeros(C)},
+             "conv2": {"kernel": jnp.zeros((3, 3, C, C)),
+                       "bias": jnp.zeros(C)}}
+        x = jnp.asarray(rng.standard_normal((1, 4, 4, C)), jnp.float32)
+        out = resnet_block(p, x, None, 4, 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestUNet:
+    def test_forward_shape_finite_deterministic(self, tiny_unet):
+        cfg, params = tiny_unet
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 4))
+        ctx = jax.random.normal(jax.random.PRNGKey(2), (2, 7, 32))
+        t = jnp.asarray([10, 500])
+        out1 = unet_forward(params, x, t, ctx, cfg)
+        out2 = jax.jit(lambda p, a, b, c: unet_forward(p, a, b, c, cfg))(
+            params, x, t, ctx)
+        assert out1.shape == (2, 16, 16, cfg.out_channels)
+        assert np.isfinite(np.asarray(out1)).all()
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   atol=1e-5)
+
+    def test_context_actually_conditions(self, tiny_unet):
+        cfg, params = tiny_unet
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16, 4))
+        t = jnp.asarray([100])
+        c1 = jax.random.normal(jax.random.PRNGKey(2), (1, 7, 32))
+        c2 = jax.random.normal(jax.random.PRNGKey(3), (1, 7, 32))
+        o1 = unet_forward(params, x, t, c1, cfg)
+        o2 = unet_forward(params, x, t, c2, cfg)
+        assert np.abs(np.asarray(o1) - np.asarray(o2)).max() > 1e-6
+
+    def test_timestep_actually_conditions(self, tiny_unet):
+        cfg, params = tiny_unet
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16, 4))
+        ctx = jax.random.normal(jax.random.PRNGKey(2), (1, 7, 32))
+        o1 = unet_forward(params, x, jnp.asarray([1]), ctx, cfg)
+        o2 = unet_forward(params, x, jnp.asarray([900]), ctx, cfg)
+        assert np.abs(np.asarray(o1) - np.asarray(o2)).max() > 1e-6
+
+
+def _synthetic_unet_checkpoint(tmp_path):
+    """Write a diffusers-layout UNet checkpoint for the tiny config.  The
+    tensor NAMES below are spelled out by hand from the diffusers naming
+    rules — independent of checkpoint/diffusion.py's translate logic."""
+    r = np.random.default_rng(0)
+
+    def t(*shape):
+        return r.standard_normal(shape).astype(np.float32) * 0.05
+
+    w = {}
+
+    def norm(base, c):
+        w[f"{base}.weight"] = np.ones(c, np.float32)
+        w[f"{base}.bias"] = np.zeros(c, np.float32)
+
+    def conv(base, cin, cout, k=3):
+        w[f"{base}.weight"] = t(cout, cin, k, k)
+        w[f"{base}.bias"] = t(cout)
+
+    def lin(base, cin, cout, bias=True):
+        w[f"{base}.weight"] = t(cout, cin)
+        if bias:
+            w[f"{base}.bias"] = t(cout)
+
+    def resnet(base, cin, cout, temb=128):
+        norm(f"{base}.norm1", cin)
+        conv(f"{base}.conv1", cin, cout)
+        if temb:
+            lin(f"{base}.time_emb_proj", temb, cout)
+        norm(f"{base}.norm2", cout)
+        conv(f"{base}.conv2", cout, cout)
+        if cin != cout:
+            conv(f"{base}.conv_shortcut", cin, cout, k=1)
+
+    def attn_block(base, c, ctx=32):
+        norm(f"{base}.norm", c)
+        conv(f"{base}.proj_in", c, c, k=1)
+        tb = f"{base}.transformer_blocks.0"
+        norm(f"{tb}.norm1", c)
+        lin(f"{tb}.attn1.to_q", c, c, bias=False)
+        lin(f"{tb}.attn1.to_k", c, c, bias=False)
+        lin(f"{tb}.attn1.to_v", c, c, bias=False)
+        lin(f"{tb}.attn1.to_out.0", c, c)
+        norm(f"{tb}.norm2", c)
+        lin(f"{tb}.attn2.to_q", c, c, bias=False)
+        lin(f"{tb}.attn2.to_k", ctx, c, bias=False)
+        lin(f"{tb}.attn2.to_v", ctx, c, bias=False)
+        lin(f"{tb}.attn2.to_out.0", c, c)
+        norm(f"{tb}.norm3", c)
+        lin(f"{tb}.ff.net.0.proj", c, 8 * c)
+        lin(f"{tb}.ff.net.2", 4 * c, c)
+        conv(f"{base}.proj_out", c, c, k=1)
+
+    conv("conv_in", 4, 32)
+    lin("time_embedding.linear_1", 32, 128)
+    lin("time_embedding.linear_2", 128, 128)
+    # down block 0: CrossAttn (32), with downsampler
+    resnet("down_blocks.0.resnets.0", 32, 32)
+    attn_block("down_blocks.0.attentions.0", 32)
+    conv("down_blocks.0.downsamplers.0.conv", 32, 32)
+    # down block 1: plain (64), final → no downsampler
+    resnet("down_blocks.1.resnets.0", 32, 64)
+    # mid
+    resnet("mid_block.resnets.0", 64, 64)
+    attn_block("mid_block.attentions.0", 64)
+    resnet("mid_block.resnets.1", 64, 64)
+    # up block 0: UpBlock2D (64) with upsampler; skips: 64, 32
+    resnet("up_blocks.0.resnets.0", 64 + 64, 64)
+    resnet("up_blocks.0.resnets.1", 64 + 32, 64)
+    conv("up_blocks.0.upsamplers.0.conv", 64, 64)
+    # up block 1: CrossAttn (32), final; skips: 32, 32
+    resnet("up_blocks.1.resnets.0", 64 + 32, 32)
+    attn_block("up_blocks.1.attentions.0", 32)
+    resnet("up_blocks.1.resnets.1", 32 + 32, 32)
+    attn_block("up_blocks.1.attentions.1", 32)
+    norm("conv_norm_out", 32)
+    conv("conv_out", 32, 4)
+
+    d = str(tmp_path / "unet")
+    os.makedirs(d, exist_ok=True)
+    import safetensors.numpy
+    safetensors.numpy.save_file(
+        w, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "UNet2DConditionModel",
+            "in_channels": 4, "out_channels": 4,
+            "block_out_channels": [32, 64], "layers_per_block": 1,
+            "cross_attention_dim": 32, "attention_head_dim": 4,
+            "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+            "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D"],
+            "norm_num_groups": 8, "norm_eps": 1e-5,
+            "use_linear_projection": False,
+        }, f)
+    return d, w
+
+
+class TestImport:
+    def test_strict_unet_import_and_forward(self, tmp_path):
+        from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
+        d, w = _synthetic_unet_checkpoint(tmp_path)
+        cfg, tree = load_hf_unet(d)
+        # a conv actually transposed into HWIO
+        k = np.asarray(tree["conv_in"]["kernel"])
+        assert k.shape == (3, 3, 4, 32)
+        np.testing.assert_array_equal(
+            k, np.transpose(w["conv_in.weight"], (2, 3, 1, 0)))
+        # a linear transposed
+        q = np.asarray(tree["down_blocks"][0]["attentions"][0]
+                       ["transformer_blocks"][0]["attn2"]["to_k"]["kernel"])
+        assert q.shape == (32, 32)
+        out = unet_forward(tree, jnp.zeros((1, 16, 16, 4)),
+                           jnp.asarray([3]), jnp.zeros((1, 5, 32)), cfg)
+        assert out.shape == (1, 16, 16, 4)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_truncated_checkpoint_rejected(self, tmp_path):
+        from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
+        import safetensors.numpy
+        d, w = _synthetic_unet_checkpoint(tmp_path)
+        w.pop("mid_block.resnets.0.conv1.weight")
+        safetensors.numpy.save_file(
+            w, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+        # rejected AT IMPORT (structural check), not as an opaque KeyError
+        # inside the jitted forward
+        with pytest.raises(ValueError, match="missing"):
+            load_hf_unet(d)
+
+    def test_extra_tensor_rejected(self, tmp_path):
+        from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
+        import safetensors.numpy
+        d, w = _synthetic_unet_checkpoint(tmp_path)
+        w["add_embedding.linear_1.weight"] = np.zeros((8, 4), np.float32)
+        safetensors.numpy.save_file(
+            w, os.path.join(d, "diffusion_pytorch_model.safetensors"))
+        with pytest.raises(ValueError, match="unexpected"):
+            load_hf_unet(d)
+
+    def test_sdxl_era_config_rejected(self, tmp_path):
+        from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
+        d, _ = _synthetic_unet_checkpoint(tmp_path)
+        cfg = json.load(open(os.path.join(d, "config.json")))
+        cfg["addition_embed_type"] = "text_time"
+        json.dump(cfg, open(os.path.join(d, "config.json"), "w"))
+        with pytest.raises(NotImplementedError, match="addition_embed_type"):
+            load_hf_unet(d)
+
+    def test_unsupported_block_type_rejected(self, tmp_path):
+        from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
+        d, _ = _synthetic_unet_checkpoint(tmp_path)
+        cfg = json.load(open(os.path.join(d, "config.json")))
+        cfg["down_block_types"][0] = "AttnDownBlock2D"
+        json.dump(cfg, open(os.path.join(d, "config.json"), "w"))
+        with pytest.raises(NotImplementedError, match="AttnDownBlock2D"):
+            load_hf_unet(d)
+
+    def test_init_inference_routes_diffusers_dir(self, tmp_path):
+        import deepspeed_tpu
+        d, _ = _synthetic_unet_checkpoint(tmp_path)
+        eng = deepspeed_tpu.init_inference(d, dtype="fp32")
+        out = eng(np.zeros((1, 4, 16, 16), np.float32), np.asarray([3]),
+                  np.zeros((1, 5, 32), np.float32))
+        assert np.asarray(out).shape == (1, 4, 16, 16)   # NCHW boundary
+
+
+class TestVAE:
+    def test_roundtrip_shapes_and_determinism(self):
+        cfg = VAEConfig.tiny()
+        params = init_vae_params(jax.random.PRNGKey(0), cfg)
+        img = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+        z = vae_encode(params, img, cfg)
+        assert z.shape == (2, 8, 8, cfg.latent_channels)   # one downsample
+        out = vae_decode(params, z, cfg)
+        assert out.shape == (2, 16, 16, 3)
+        assert np.isfinite(np.asarray(out)).all()
+        z2 = vae_encode(params, img, cfg)
+        np.testing.assert_allclose(np.asarray(z), np.asarray(z2))
+
+    def test_posterior_sampling_differs_from_mode(self):
+        cfg = VAEConfig.tiny()
+        params = init_vae_params(jax.random.PRNGKey(0), cfg)
+        img = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 16, 3))
+        z_mode = vae_encode(params, img, cfg)
+        z_samp = vae_encode(params, img, cfg,
+                            sample_rng=jax.random.PRNGKey(7))
+        assert np.abs(np.asarray(z_mode) - np.asarray(z_samp)).max() > 0
+
+
+class TestPipeline:
+    def test_txt2img_loop_runs(self, tiny_unet):
+        from deepspeed_tpu.inference.diffusion import (DDIMScheduler,
+                                                       StableDiffusionPipeline,
+                                                       UNetEngine, VAEEngine)
+        ucfg, uparams = tiny_unet
+        vcfg = VAEConfig.tiny()
+        vparams = init_vae_params(jax.random.PRNGKey(3), vcfg)
+        unet = UNetEngine(ucfg, uparams)
+        vae = VAEEngine(vcfg, vparams)
+
+        class StubText:
+            def __call__(self, ids):
+                r = jax.random.normal(
+                    jax.random.PRNGKey(int(np.asarray(ids).sum()) % 997),
+                    (np.asarray(ids).shape[0], 5, 32))
+                return r, r[:, 0]
+
+        pipe = StableDiffusionPipeline(StubText(), unet, vae,
+                                       DDIMScheduler())
+        imgs = pipe(np.ones((1, 5), np.int32), np.zeros((1, 5), np.int32),
+                    steps=2, height=16, width=16, seed=0)
+        # 16/8=2 latent → VAE tiny has ONE upsample (2 levels): 2→4... the
+        # tiny VAE upsamples once, so the image side is latent*2
+        assert np.asarray(imgs).shape[0] == 1
+        assert np.isfinite(np.asarray(imgs)).all()
+
+    def test_ddim_scheduler_reconstructs_x0_at_last_step(self):
+        from deepspeed_tpu.inference.diffusion import DDIMScheduler
+        s = DDIMScheduler()
+        x0 = np.ones((1, 2, 2, 1))
+        t = 100
+        a = s.alphas_cumprod[t]
+        noise = np.random.default_rng(0).standard_normal(x0.shape)
+        xt = np.sqrt(a) * x0 + np.sqrt(1 - a) * noise
+        # one DDIM step to t_prev=-1 with the TRUE noise recovers x0
+        rec = s.step(noise, t, -1, xt)
+        np.testing.assert_allclose(rec, x0, atol=1e-6)
