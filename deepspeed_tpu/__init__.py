@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
-from deepspeed_tpu import comm
+from deepspeed_tpu import checkpointing, comm, zero
+from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
 from deepspeed_tpu.engine import DeepSpeedTPUEngine, StepMetrics, TrainState
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -27,8 +28,19 @@ __all__ = [
     "TrainState",
     "StepMetrics",
     "comm",
+    "zero",
+    "checkpointing",
+    "get_accelerator",
+    "default_inference_config",
     "__version__",
 ]
+
+
+def default_inference_config() -> dict:
+    """reference deepspeed.default_inference_config (:266): the default
+    inference config as an editable dict."""
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().model_dump()
 
 
 def initialize(model=None,
@@ -158,10 +170,20 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
         merged = dict(as_dict(config), **kwargs)
         raw_dt = str(merged.get("dtype", "fp32")).lower().replace(
             "torch.", "")
-        if raw_dt not in _DTYPE_ALIASES:
-            raise ValueError(f"unknown dtype {merged.get('dtype')!r}; one "
-                             f"of {sorted(_DTYPE_ALIASES)}")
-        dt = _DTYPE_ALIASES[raw_dt]
+        float_aliases = {k: v for k, v in _DTYPE_ALIASES.items()
+                         if v.startswith(("float", "bfloat"))}
+        if raw_dt not in float_aliases:
+            raise ValueError(f"SD containers serve float dtypes; got "
+                             f"{merged.get('dtype')!r}, expected one of "
+                             f"{sorted(float_aliases)}")
+        dt = float_aliases[raw_dt]
+        # inert-config-must-scream (config.warn_inert_config policy): the SD
+        # engines consume only dtype/channels_last
+        from deepspeed_tpu.utils.logging import logger as _logger
+        for k in sorted(set(merged) - {"dtype", "channels_last"}):
+            _logger.warning(f"inference config key {k!r} is not consumed by "
+                            f"the SD containers (only dtype/channels_last "
+                            f"are) — this run will NOT honor it")
         cls = _read_json(_os.path.join(str(model),
                                        "config.json"))["_class_name"]
         eng_cls = UNetEngine if cls == "UNet2DConditionModel" else VAEEngine

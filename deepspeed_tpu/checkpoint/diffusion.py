@@ -176,7 +176,6 @@ def load_hf_unet(model_dir: str, dtype=None):
     expected = jax.eval_shape(
         lambda k: init_unet_params(k, cfg), jax.random.PRNGKey(0))
     _check_structure(tree, expected, "UNet")
-    _validate_against_config(tree, cfg)
     return cfg, tree
 
 
@@ -196,33 +195,6 @@ def load_hf_vae(model_dir: str, dtype=None):
         lambda k: init_vae_params(k, cfg), jax.random.PRNGKey(0))
     _check_structure(tree, expected, "VAE")
     return cfg, tree
-
-
-def _validate_against_config(tree: Dict[str, Any], cfg: UNetConfig) -> None:
-    """Structural completeness: the tree must contain exactly the blocks the
-    config promises (a truncated checkpoint must not serve)."""
-    need = ("conv_in", "time_embedding", "down_blocks", "mid_block",
-            "up_blocks", "conv_norm_out", "conv_out")
-    for key in need:
-        if key not in tree:
-            raise ValueError(f"UNet checkpoint missing {key}")
-    if len(tree["down_blocks"]) != len(cfg.down_block_types):
-        raise ValueError(
-            f"UNet checkpoint has {len(tree['down_blocks'])} down blocks; "
-            f"config promises {len(cfg.down_block_types)}")
-    if len(tree["up_blocks"]) != len(cfg.up_block_types):
-        raise ValueError(
-            f"UNet checkpoint has {len(tree['up_blocks'])} up blocks; "
-            f"config promises {len(cfg.up_block_types)}")
-    for i, btype in enumerate(cfg.down_block_types):
-        bp = tree["down_blocks"][i]
-        if len(bp["resnets"]) != cfg.layers_per_block:
-            raise ValueError(f"down block {i}: {len(bp['resnets'])} resnets "
-                             f"!= layers_per_block {cfg.layers_per_block}")
-        has_attn = "attentions" in bp and bp["attentions"]
-        if (btype == "CrossAttnDownBlock2D") != bool(has_attn):
-            raise ValueError(f"down block {i}: attention presence does not "
-                             f"match type {btype}")
 
 
 def is_diffusers_model_dir(path) -> bool:

@@ -1,0 +1,85 @@
+"""``deepspeed.checkpointing`` API-compat surface (activation checkpointing).
+
+Reference: ``runtime/activation_checkpointing/checkpointing.py`` —
+``configure`` (:1073), ``checkpoint`` (:748 the re-entrant rematerializing
+autograd Function), ``is_configured``, plus the RNG-tracker machinery CUDA
+needs to replay dropout patterns inside recomputation.
+
+TPU: rematerialization is ``jax.checkpoint`` — a function transform, not a
+runtime hook — and JAX's functional PRNG makes the CUDA RNG tracker
+unnecessary (the same rng key produces the same dropout in the recompute by
+construction).  ``checkpoint(fn, *args)`` therefore simply applies
+``jax.checkpoint`` with the configured policy; model-level remat stays where
+it belongs (``GPTConfig.remat`` / the ``activation_checkpointing`` config
+block's ``policy`` knob).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+_config: dict = {"policy": "nothing_saveable", "configured": False}
+
+_POLICIES = {
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              policy: Optional[str] = None) -> None:
+    """reference checkpointing.configure (:1073).
+
+    Only ``policy`` changes behavior on TPU (the jax.checkpoint policy used
+    by subsequent ``checkpoint()`` calls); the CUDA-specific knobs warn when
+    set — partition/cpu placement of saved activations is XLA's scheduling
+    domain (and the Infinity engine owns activation offload)."""
+    for name, val in (("partition_activations", partition_activations),
+                      ("contiguous_checkpointing", contiguous_checkpointing),
+                      ("num_checkpoints", num_checkpoints),
+                      ("checkpoint_in_cpu", checkpoint_in_cpu),
+                      ("synchronize", synchronize), ("profile", profile)):
+        if val:
+            logger.warning(f"checkpointing.configure: {name} is CUDA-"
+                           f"specific and has no TPU behavior (jax.checkpoint"
+                           f" + XLA scheduling own activation residency)")
+    if deepspeed_config is not None:
+        from deepspeed_tpu.config import parse_config
+        policy = policy or parse_config(
+            deepspeed_config).activation_checkpointing.policy
+    if policy is not None:
+        if policy not in _POLICIES:
+            raise ValueError(f"unknown remat policy {policy!r}; one of "
+                             f"{sorted(_POLICIES)}")
+        _config["policy"] = policy
+    _config["configured"] = True
+
+
+def is_configured() -> bool:
+    """reference checkpointing.is_configured."""
+    return bool(_config["configured"])
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """reference checkpointing.checkpoint (:748): run ``function(*args)``
+    discarding internal activations; they rematerialize in the backward.
+
+    TPU: ``jax.checkpoint`` under the configured policy.  Unlike the CUDA
+    path there is no RNG state to stash — dropout inside ``function`` replays
+    exactly because JAX PRNG keys are explicit inputs."""
+    fn = jax.checkpoint(function, policy=_POLICIES[_config["policy"]])
+    return fn(*args)
+
+
+def reset() -> None:
+    """Test hook: restore defaults."""
+    _config.update(policy="nothing_saveable", configured=False)

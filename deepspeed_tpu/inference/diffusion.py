@@ -26,9 +26,16 @@ from deepspeed_tpu.models.diffusion import (UNetConfig, VAEConfig,
                                             vae_encode)
 from deepspeed_tpu.utils.logging import log_dist
 
-_DTYPES = {"fp32": jnp.float32, "float32": jnp.float32,
-           "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-           "fp16": jnp.float16, "float16": jnp.float16}
+# alias names resolve through the ONE inference dtype table
+# (inference/config.py _DTYPE_ALIASES); this maps canonical names → jnp
+def _resolve_dtype(name: str):
+    from deepspeed_tpu.inference.config import _DTYPE_ALIASES
+    canon = _DTYPE_ALIASES.get(str(name).lower().replace("torch.", ""))
+    table = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+             "float16": jnp.float16}
+    if canon not in table:
+        raise ValueError(f"SD containers serve float dtypes; got {name!r}")
+    return table[canon]
 
 
 def _nchw_to_nhwc(x):
@@ -46,18 +53,20 @@ class UNetEngine:
     (diffusers convention) or NHWC (``channels_last=True``) latents."""
 
     def __init__(self, model_dir_or_cfg, params=None, *,
-                 dtype: str = "fp32", channels_last: bool = False):
+                 dtype: Optional[str] = None, channels_last: bool = False):
+        import dataclasses
         if isinstance(model_dir_or_cfg, UNetConfig):
             assert params is not None, "pass params with an explicit config"
             self.cfg = model_dir_or_cfg
+            # explicit config: its dtype WINS unless the caller overrides
+            dt = _resolve_dtype(dtype) if dtype is not None else self.cfg.dtype
         else:
             from deepspeed_tpu.checkpoint.diffusion import load_hf_unet
-            self.cfg, params = load_hf_unet(model_dir_or_cfg,
-                                            dtype=_DTYPES[dtype])
-        import dataclasses
-        self.cfg = dataclasses.replace(self.cfg, dtype=_DTYPES[dtype])
+            dt = _resolve_dtype(dtype or "fp32")
+            self.cfg, params = load_hf_unet(model_dir_or_cfg, dtype=dt)
+        self.cfg = dataclasses.replace(self.cfg, dtype=dt)
         self.channels_last = channels_last
-        conv = (lambda l: jnp.asarray(l, _DTYPES[dtype])
+        conv = (lambda l: jnp.asarray(l, dt)
                 if np.asarray(l).dtype.kind == "f" else jnp.asarray(l))
         self.params = jax.tree_util.tree_map(conv, params)
         cfg = self.cfg
@@ -68,7 +77,8 @@ class UNetEngine:
         n = sum(int(np.prod(np.asarray(l).shape))
                 for l in jax.tree_util.tree_leaves(self.params))
         log_dist(f"unet engine ready: params={n/1e6:.1f}M "
-                 f"blocks={cfg.block_out_channels} dtype={dtype}", ranks=[0])
+                 f"blocks={cfg.block_out_channels} "
+                 f"dtype={jnp.dtype(dt).name}", ranks=[0])
 
     def __call__(self, sample, timesteps, encoder_hidden_states):
         if not self.channels_last:
@@ -82,18 +92,19 @@ class VAEEngine:
     """Jitted AutoencoderKL encode/decode (reference vae container role)."""
 
     def __init__(self, model_dir_or_cfg, params=None, *,
-                 dtype: str = "fp32", channels_last: bool = False):
+                 dtype: Optional[str] = None, channels_last: bool = False):
+        import dataclasses
         if isinstance(model_dir_or_cfg, VAEConfig):
             assert params is not None
             self.cfg = model_dir_or_cfg
+            dt = _resolve_dtype(dtype) if dtype is not None else self.cfg.dtype
         else:
             from deepspeed_tpu.checkpoint.diffusion import load_hf_vae
-            self.cfg, params = load_hf_vae(model_dir_or_cfg,
-                                           dtype=_DTYPES[dtype])
-        import dataclasses
-        self.cfg = dataclasses.replace(self.cfg, dtype=_DTYPES[dtype])
+            dt = _resolve_dtype(dtype or "fp32")
+            self.cfg, params = load_hf_vae(model_dir_or_cfg, dtype=dt)
+        self.cfg = dataclasses.replace(self.cfg, dtype=dt)
         self.channels_last = channels_last
-        conv = (lambda l: jnp.asarray(l, _DTYPES[dtype])
+        conv = (lambda l: jnp.asarray(l, dt)
                 if np.asarray(l).dtype.kind == "f" else jnp.asarray(l))
         self.params = jax.tree_util.tree_map(conv, params)
         cfg = self.cfg

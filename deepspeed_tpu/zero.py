@@ -1,0 +1,82 @@
+"""``deepspeed.zero`` API-compat surface.
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py`` — users wrap
+model CONSTRUCTION in ``deepspeed.zero.Init()`` so parameters materialize
+pre-sharded, and wrap parameter ACCESS in ``zero.GatheredParameters`` to
+temporarily re-assemble them.
+
+On TPU both capabilities are intrinsic to the architecture, so these shims
+exist for porting ergonomics and documentation:
+
+- ``Init``: the engine's jitted ``model.init`` runs under output shardings
+  (engine.py ``_jit_init``), so parameters are BORN sharded on the mesh —
+  there is no torch-style materialize-then-partition step to intercept.
+  The context manager validates its arguments and otherwise does nothing.
+- ``GatheredParameters``: engine params are global-view ``jax.Array``s; any
+  host access (``jax.device_get``) or cross-shard read IS the gather, with
+  XLA scheduling the collectives.  The context yields the params unchanged.
+
+Both warn once at first use so a ported script's author learns the TPU
+semantics instead of wondering whether the calls did anything.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_warned = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key not in _warned:
+        _warned.add(key)
+        logger.info(msg)
+
+
+@contextlib.contextmanager
+def Init(data_parallel_group=None, remote_device: Optional[str] = None,
+         pin_memory: bool = False, config_dict_or_path=None, config=None,
+         enabled: bool = True, dtype=None, mpu=None, mesh=None):
+    """reference zero.Init (partition_parameters.py:808).
+
+    TPU: parameters are created ALREADY SHARDED by the engine's jitted init
+    (zero stage 3 shards over the fsdp mesh axis at initialize time), so
+    there is nothing to intercept at module construction.  Kept for porting
+    compatibility — a reference script's ``with deepspeed.zero.Init():``
+    block runs unchanged.
+    """
+    if remote_device not in (None, "none", "cpu", "nvme"):
+        raise ValueError(f"unknown remote_device {remote_device!r}")
+    if enabled:
+        extra = ""
+        if remote_device in ("cpu", "nvme"):
+            extra = (" For parameters larger than HBM use "
+                     "zero_optimization.offload_param (the Infinity engine "
+                     "streams layer params from the host just-in-time).")
+        _warn_once("init", "zero.Init: TPU parameters are born sharded by "
+                           "the engine's jitted init — this context is a "
+                           "compatibility no-op." + extra)
+    yield
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any = None, modifier_rank: Optional[int] = None,
+                       fwd_module=None, enabled: bool = True):
+    """reference zero.GatheredParameters (partition_parameters.py:2113).
+
+    TPU: engine params are global-view ``jax.Array``s — reading one on the
+    host (``jax.device_get``/``np.asarray``) performs the gather, and
+    functional updates replace the array wholesale, so there is no
+    partitioned state to re-assemble or write back.  Yields ``params``
+    unchanged.
+    """
+    if enabled:
+        _warn_once(
+            "gather", "zero.GatheredParameters: global-view jax.Arrays "
+                      "gather on host access — this context is a "
+                      "compatibility no-op (device_get the leaf, or assign "
+                      "a new params tree for updates)")
+    yield params

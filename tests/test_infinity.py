@@ -121,6 +121,22 @@ class TestInfinityNumerics:
         np.testing.assert_allclose(l_inf, l_base, rtol=0.05, atol=0.02)
         assert inf.compute_dtype.__name__ == "bfloat16"
 
+    def test_fp16_loss_scaling_engages(self):
+        """fp16 Infinity: the dynamic loss-scale state machine must drive the
+        host step (skip-on-overflow, scale halving) and training proceed."""
+        mc = _cfg(n_layers=2)
+        ds = _ds_config()
+        ds["fp16"] = {"enabled": True, "initial_scale_power": 4,
+                      "loss_scale_window": 2}
+        inf = _build_infinity(mc, ds)
+        assert float(inf.loss_scale_state.scale) == 2.0 ** 4
+        losses = [float(inf.train_batch(b).loss)
+                  for b in _data(6, inf.train_batch_size)]
+        assert all(np.isfinite(l) for l in losses)
+        # window=2 with finite steps → the scale GREW (state machine live)
+        assert float(inf.loss_scale_state.scale) > 2.0 ** 4
+        assert losses[-1] < losses[0]
+
     def test_tied_embedding_grads(self):
         """Tied wte gets BOTH the embedding-gather and the unembed cotangent
         (the reference's tied-layer grad reduction)."""
