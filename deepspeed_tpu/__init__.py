@@ -167,7 +167,13 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
             raise ValueError("the SD containers are single-mesh jitted "
                              "forwards; mesh selection is not consumed — "
                              "drop the mesh argument")
-        merged = dict(as_dict(config), **kwargs)
+        from deepspeed_tpu.inference import DeepSpeedInferenceConfig
+        if isinstance(config, DeepSpeedInferenceConfig):
+            # only fields the user actually SET count as intent — a full
+            # model_dump would make every defaulted field warn
+            merged = dict(config.model_dump(exclude_unset=True), **kwargs)
+        else:
+            merged = dict(as_dict(config), **kwargs)
         raw_dt = str(merged.get("dtype", "fp32")).lower().replace(
             "torch.", "")
         float_aliases = {k: v for k, v in _DTYPE_ALIASES.items()

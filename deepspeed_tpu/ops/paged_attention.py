@@ -6,17 +6,19 @@ slot owns a list of fixed-size KV pages; decode attends one query token per
 slot over exactly that slot's pages.
 
 Kernel design (vs the XLA fallback, which masks over gathered pages):
-- grid = (slots, kv_heads, max_blocks); the innermost block axis runs an
-  online-softmax accumulation (m/l/acc scratch), like flash attention.
-- the block table rides scalar prefetch (PrefetchScalarGridSpec), so the
-  K/V BlockSpec index maps can look up each slot's b-th physical page.
-- past a slot's last used page the index map CLAMPS to the last used page:
-  Pallas skips the DMA when consecutive grid steps map the same block, so a
-  slot with 3 live pages moves exactly 3 pages of KV through VMEM no matter
-  how large max_blocks is — bandwidth scales with tokens actually attended,
-  the property the reference kernel gets from its atom decomposition.
+- grid = (slots, kv_heads, kv_splits) — flash-decoding style.  Each step
+  runs an in-kernel double-buffered HBM→VMEM DMA loop over ITS SHARE of the
+  slot's live pages (block table via scalar prefetch), with online-softmax
+  m/l/acc scratch, and emits unnormalized partials that a tiny XLA epilogue
+  merges (logsumexp-weighted).  One split degenerates to the single-pass
+  kernel; many splits cut long-KV decode latency by ~splits (the serial
+  page loop was the critical path).  Bandwidth always scales with tokens
+  actually attended (only live pages are ever read — the property the
+  reference kernel gets from its atom decomposition), and a sliding window
+  additionally starts the loop past wholly-out-of-window pages.
 - GQA native: q arrives [S, nkv, group, hd]; one grid step attends the whole
   group for one kv head (scores [group, bs] on the MXU).
+- alibi: per-head slope × key-position bias folded into the online softmax.
 
 Layouts: q [S, nkv, g, hd]; k_pages/v_pages [NB, nkv, bs, hd] (bs = tokens
 per page); block_table [S, MB] int32; kv_lens [S] int32 (0 ⇒ inactive slot →
@@ -73,48 +75,42 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     return jnp.einsum("sngk,sknd->sngd", probs.astype(q.dtype), v_seq)
 
 
-def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
-            q_ref, *rest, bs, scale, window, has_alibi):
-    """One (slot, kv-head) per grid step; in-kernel double-buffered DMA loop
-    over exactly the slot's USED pages.
-
-    The earlier design put the page index on the grid (S, nkv, MB) and clamped
-    past-the-end index maps; with 1-token decode that is thousands of grid
-    steps of [g, bs] work — pure dispatch latency.  Here the grid is (S, nkv)
-    (~slots×heads steps) and the page loop is a `fori_loop` whose trip count is
-    the slot's actual page count, with page b+1's DMA in flight while page b
-    computes (pallas_guide.md double-buffering pattern) — bandwidth scales
-    with tokens attended, grid overhead scales with slots.
-
-    ``window``: the loop STARTS at the first page intersecting the window
-    (pages wholly before it are never DMA'd — a bandwidth win the XLA
-    fallback can't get), and in-window masking handles the partial first
-    page.  ``has_alibi``: per-head slope × key-position bias folded into the
-    online softmax (reference v1 kernels includes/alibi.h)."""
+def _split_kernel(bt_ref, len_ref,                 # scalar prefetch (SMEM)
+                  q_ref, *rest, bs, scale, window, has_alibi, n_splits):
+    """Flash-decoding variant (one grid step = one KV SPLIT of one
+    (slot, kv-head)): the page loop covers only this split's share of the
+    slot's live pages, and the kernel emits UNNORMALIZED partials
+    (acc, m, l) that a tiny XLA epilogue merges with the standard
+    logsumexp-weighted combine.  Long-KV decode latency then scales with
+    pages/n_splits instead of pages (the serial DMA loop was the critical
+    path)."""
     if has_alibi:
-        slopes_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+        slopes_ref, k_hbm, v_hbm, o_ref, m_ref, l_ref, k_buf, v_buf, sem = \
+            rest
     else:
-        k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+        k_hbm, v_hbm, o_ref, m_ref, l_ref, k_buf, v_buf, sem = rest
         slopes_ref = None
-    s, h = pl.program_id(0), pl.program_id(1)
+    s, h, sp = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     length = len_ref[s]
     n_pages = (length + bs - 1) // bs
     g, hd = q_ref.shape[2], q_ref.shape[3]
     q = q_ref[0, 0]                                # [g, hd]
     if window is None:
-        p_start = 0
+        lo_page = jnp.int32(0)
         lo = jnp.int32(0)
     else:
-        # decode query sits at position length-1; valid keys have
-        # kvpos >= length - window
         lo = jnp.maximum(length - window, 0)
-        p_start = lo // bs
+        lo_page = lo // bs
+    live_pages = jnp.maximum(n_pages - lo_page, 0)
+    per = (live_pages + n_splits - 1) // n_splits
+    p_start = lo_page + sp * per
+    p_end = jnp.minimum(p_start + per, n_pages)
 
     def dma(hbm, buf, slot, p, way):
         return pltpu.make_async_copy(
             hbm.at[bt_ref[s, p], h], buf.at[slot], sem.at[way * 2 + slot])
 
-    @pl.when(n_pages > p_start)
+    @pl.when(p_end > p_start)
     def _warmup():
         slot0 = jax.lax.rem(p_start, 2)
         dma(k_hbm, k_buf, slot0, p_start, 0).start()
@@ -125,18 +121,18 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
         slot = jax.lax.rem(p, 2)
         nxt = jax.lax.rem(p + 1, 2)
 
-        @pl.when(p + 1 < n_pages)
+        @pl.when(p + 1 < p_end)
         def _prefetch():
             dma(k_hbm, k_buf, nxt, p + 1, 0).start()
             dma(v_hbm, v_buf, nxt, p + 1, 1).start()
 
         dma(k_hbm, k_buf, slot, p, 0).wait()
         dma(v_hbm, v_buf, slot, p, 1).wait()
-        k = k_buf[slot]                            # [bs, hd]
+        k = k_buf[slot]
         v = v_buf[slot]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # [g, bs]
+            preferred_element_type=jnp.float32) * scale
         kvpos = p * bs + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         if has_alibi:
             scores = scores + (slopes_ref[0, :][:, None]
@@ -146,7 +142,8 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
             valid = valid & (kvpos >= lo)
         scores = jnp.where(valid, scores, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
-        pr = jnp.exp(scores - m_new)               # [g, bs]
+        pr = jnp.exp(scores - m_new)
+        pr = jnp.where(m_new > _NEG_INF / 2, pr, 0.0)
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(pr, axis=1, keepdims=True)
         pv = jax.lax.dot_general(pr.astype(v.dtype), v,
@@ -157,15 +154,17 @@ def _kernel(bt_ref, len_ref,                       # scalar prefetch (SMEM)
     m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((g, 1), jnp.float32)
     acc0 = jnp.zeros((g, hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(p_start, n_pages, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)                # inactive slot -> zeros
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(p_start, p_end, body, (m0, l0, acc0))
+    o_ref[0, 0, 0] = acc                           # fp32 partial
+    m_ref[0, 0, 0] = m[:, 0]
+    l_ref[0, 0, 0] = l[:, 0]
 
 
 def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                            alibi_slopes=None, window=None,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
+                           num_kv_splits: Optional[int] = None,
                            mesh=None):
     """Mesh-aware entry: with a ``tp`` axis the kv-head dim is sharded, and the
     kernel runs per-shard under shard_map (attention is independent per kv
@@ -177,7 +176,8 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
         from jax.sharding import PartitionSpec as P
         inner = functools.partial(_pallas_paged_attention_local,
                                   scale=scale, window=window,
-                                  interpret=interpret)
+                                  interpret=interpret,
+                                  num_kv_splits=num_kv_splits)
         kv_spec = P(None, "tp", None, None)
         in_specs = [kv_spec, kv_spec, kv_spec, P(None, None), P(None)]
         args = [q, k_pages, v_pages, block_table, kv_lens]
@@ -198,13 +198,15 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     return _pallas_paged_attention_local(q, k_pages, v_pages, block_table,
                                          kv_lens, alibi_slopes=alibi_slopes,
                                          window=window, scale=scale,
-                                         interpret=interpret)
+                                         interpret=interpret,
+                                         num_kv_splits=num_kv_splits)
 
 
 def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
                                   alibi_slopes=None, window=None,
                                   scale: Optional[float] = None,
-                                  interpret: Optional[bool] = None):
+                                  interpret: Optional[bool] = None,
+                                  num_kv_splits: Optional[int] = None):
     S, nkv, g, hd = q.shape
     NB, _, bs, _ = k_pages.shape
     MB = block_table.shape[1]
@@ -214,49 +216,78 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
         interpret = jax.default_backend() != "tpu"
     block_table = block_table.astype(jnp.int32)
     kv_lens = kv_lens.astype(jnp.int32)
-    has_alibi = alibi_slopes is not None
+    if num_kv_splits is None:
+        # flash-decoding heuristic: split long block tables so the serial
+        # per-(slot, head) DMA loop stops being the latency floor; short
+        # tables run a single split (the combine epilogue degenerates to a
+        # normalize)
+        num_kv_splits = max(1, min(8, MB // 16))
+    return _pallas_paged_attention_split(
+        q, k_pages, v_pages, block_table, kv_lens,
+        alibi_slopes=alibi_slopes, window=window, scale=float(scale),
+        interpret=interpret, num_kv_splits=int(num_kv_splits))
 
-    grid = (S, nkv)
+
+def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
+                                  *, alibi_slopes, window, scale, interpret,
+                                  num_kv_splits: int):
+    """Flash-decoding dispatch: grid (S, nkv, splits) of unnormalized
+    partials + logsumexp-weighted XLA combine."""
+    S, nkv, g, hd = q.shape
+    NB, _, bs, _ = k_pages.shape
+    NS = num_kv_splits
     kernel = functools.partial(
-        _kernel, bs=bs, scale=float(scale),
+        _split_kernel, bs=bs, scale=float(scale),
         window=int(window) if window is not None else None,
-        has_alibi=has_alibi)
+        has_alibi=alibi_slopes is not None, n_splits=NS)
     in_specs = [
-        pl.BlockSpec((1, 1, g, hd), lambda s, h, bt, lens: (s, h, 0, 0)),
+        pl.BlockSpec((1, 1, g, hd), lambda s, h, sp, bt, lens: (s, h, 0, 0)),
     ]
     inputs = [q]
-    if has_alibi:
+    if alibi_slopes is not None:
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
-        in_specs.append(pl.BlockSpec((1, g), lambda s, h, bt, lens: (h, 0)))
+        in_specs.append(pl.BlockSpec((1, g),
+                                     lambda s, h, sp, bt, lens: (h, 0)))
         inputs.append(slopes)
-    in_specs += [
-        pl.BlockSpec(memory_space=pl.ANY),     # k pages stay in HBM
-        pl.BlockSpec(memory_space=pl.ANY),     # v pages stay in HBM
-    ]
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
     inputs += [k_pages, v_pages]
-    out = pl.pallas_call(
+    acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=grid,
+            grid=(S, nkv, NS),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, g, hd),
-                                   lambda s, h, bt, lens: (s, h, 0, 0)),
+            out_specs=[
+                pl.BlockSpec((1, 1, 1, g, hd),
+                             lambda s, h, sp, bt, lens: (s, h, sp, 0, 0)),
+                pl.BlockSpec((1, 1, 1, g),
+                             lambda s, h, sp, bt, lens: (s, h, sp, 0)),
+                pl.BlockSpec((1, 1, 1, g),
+                             lambda s, h, sp, bt, lens: (s, h, sp, 0)),
+            ],
             scratch_shapes=[
-                pltpu.VMEM((2, bs, hd), k_pages.dtype),   # k double buffer
-                pltpu.VMEM((2, bs, hd), v_pages.dtype),   # v double buffer
-                pltpu.SemaphoreType.DMA((4,)),            # [way*2 + slot]
+                pltpu.VMEM((2, bs, hd), k_pages.dtype),
+                pltpu.VMEM((2, bs, hd), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((4,)),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((S, nkv, g, hd), q.dtype),
-        # "arbitrary" both: kernels with internal DMA loops must not be
-        # core-parallelized (jax's own paged_attention kernel hangs under
-        # wrong megacore parallelism — see its docstring caveat)
+        out_shape=[
+            jax.ShapeDtypeStruct((S, nkv, NS, g, hd), jnp.float32),
+            jax.ShapeDtypeStruct((S, nkv, NS, g), jnp.float32),
+            jax.ShapeDtypeStruct((S, nkv, NS, g), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_table, kv_lens, *inputs)
-    return out
+    )(block_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *inputs)
+    # combine: o = Σ exp(m_s − m*) acc_s / Σ exp(m_s − m*) l_s
+    m_star = jnp.max(m, axis=2, keepdims=True)              # [S, nkv, 1, g]
+    w = jnp.exp(m - m_star)                                 # [S, nkv, NS, g]
+    num = jnp.sum(acc * w[..., None], axis=2)               # [S, nkv, g, hd]
+    den = jnp.sum(l * w, axis=2)                            # [S, nkv, g]
+    den = jnp.where(den == 0.0, 1.0, den)                   # inactive slots
+    return (num / den[..., None]).astype(q.dtype)
 
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,

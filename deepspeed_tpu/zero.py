@@ -37,9 +37,12 @@ def _warn_once(key: str, msg: str) -> None:
 
 
 @contextlib.contextmanager
-def Init(data_parallel_group=None, remote_device: Optional[str] = None,
-         pin_memory: bool = False, config_dict_or_path=None, config=None,
-         enabled: bool = True, dtype=None, mpu=None, mesh=None):
+def Init(module=None, data_parallel_group=None,
+         remote_device: Optional[str] = None, pin_memory: bool = False,
+         config_dict_or_path=None, config=None, enabled: bool = True,
+         dtype=None, mpu=None, mesh=None, param_swapper=None,
+         mem_efficient_linear: bool = True,
+         sequence_data_parallel_group=None, **kwargs):
     """reference zero.Init (partition_parameters.py:808).
 
     TPU: parameters are created ALREADY SHARDED by the engine's jitted init

@@ -338,6 +338,38 @@ class TestPagedAttention:
             jnp.asarray(lens), window=window, interpret=True)
         assert np.isfinite(np.asarray(got)).all()
 
+    def test_split_kv_matches_single_pass(self, rng):
+        """Flash-decoding split-KV (grid over KV splits + logsumexp combine)
+        must be token-exact vs the single-pass kernel AND the XLA path, for
+        every split count including splits > live pages."""
+        from deepspeed_tpu.ops.paged_attention import (pallas_paged_attention,
+                                                       xla_paged_attention)
+        q, k, v, bt, lens = (jnp.asarray(a) for a in self._rand_case(
+            rng, S=4, MB=8, NB=40))
+        lens = jnp.asarray([0, 5, 17, 64], jnp.int32)
+        want = xla_paged_attention(q, k, v, bt, lens)
+        for ns in (1, 2, 3, 8, 16):
+            got = pallas_paged_attention(q, k, v, bt, lens,
+                                         num_kv_splits=ns, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, err_msg=f"splits={ns}")
+
+    def test_split_kv_with_alibi_and_window(self, rng):
+        from deepspeed_tpu.ops.paged_attention import (pallas_paged_attention,
+                                                       xla_paged_attention)
+        q, k, v, bt, lens = (jnp.asarray(a) for a in self._rand_case(
+            rng, S=2, MB=8, NB=24))
+        lens = jnp.asarray([40, 64], jnp.int32)
+        nkv, g = q.shape[1], q.shape[2]
+        slopes = jnp.asarray(np.geomspace(0.5, 1 / 64, nkv * g), jnp.float32)
+        for kw in ({"window": 20}, {"alibi_slopes": slopes},
+                   {"alibi_slopes": slopes, "window": 11}):
+            want = xla_paged_attention(q, k, v, bt, lens, **kw)
+            got = pallas_paged_attention(q, k, v, bt, lens, num_kv_splits=4,
+                                         interpret=True, **kw)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, err_msg=str(kw))
+
     def test_kernel_alibi_window_combined(self, rng):
         from deepspeed_tpu.ops.paged_attention import (pallas_paged_attention,
                                                        xla_paged_attention)
