@@ -13,6 +13,8 @@ from typing import Any, Callable, Optional, Tuple
 
 from deepspeed_tpu import checkpointing, comm, zero
 from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.zero import OnDevice
 from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
 from deepspeed_tpu.engine import DeepSpeedTPUEngine, StepMetrics, TrainState
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -32,6 +34,8 @@ __all__ = [
     "checkpointing",
     "get_accelerator",
     "default_inference_config",
+    "add_tuning_arguments",
+    "OnDevice",
     "__version__",
 ]
 
@@ -167,14 +171,16 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
             raise ValueError("the SD containers are single-mesh jitted "
                              "forwards; mesh selection is not consumed — "
                              "drop the mesh argument")
-        from deepspeed_tpu.inference import DeepSpeedInferenceConfig
         if isinstance(config, DeepSpeedInferenceConfig):
             # only fields the user actually SET count as intent — a full
             # model_dump would make every defaulted field warn
             merged = dict(config.model_dump(exclude_unset=True), **kwargs)
         else:
             merged = dict(as_dict(config), **kwargs)
-        raw_dt = str(merged.get("dtype", "fp32")).lower().replace(
+        # fallback = the inference config class default, NOT a hardcoded
+        # fp32 (they must never disagree)
+        default_dt = DeepSpeedInferenceConfig().dtype
+        raw_dt = str(merged.get("dtype", default_dt)).lower().replace(
             "torch.", "")
         float_aliases = {k: v for k, v in _DTYPE_ALIASES.items()
                          if v.startswith(("float", "bfloat"))}

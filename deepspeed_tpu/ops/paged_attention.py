@@ -10,9 +10,11 @@ Kernel design (vs the XLA fallback, which masks over gathered pages):
   runs an in-kernel double-buffered HBM→VMEM DMA loop over ITS SHARE of the
   slot's live pages (block table via scalar prefetch), with online-softmax
   m/l/acc scratch, and emits unnormalized partials that a tiny XLA epilogue
-  merges (logsumexp-weighted).  One split degenerates to the single-pass
-  kernel; many splits cut long-KV decode latency by ~splits (the serial
-  page loop was the critical path).  Bandwidth always scales with tokens
+  merges (logsumexp-weighted).  One split (the default — Pallas TPU grids
+  run sequentially per core, so splits don't parallelize under current
+  dispatch) degenerates to the single-pass kernel; the split knob exists
+  for explicit experimentation on dispatch modes where the axis can run
+  concurrently.  Bandwidth always scales with tokens
   actually attended (only live pages are ever read — the property the
   reference kernel gets from its atom decomposition), and a sliding window
   additionally starts the loop past wholly-out-of-window pages.
@@ -77,13 +79,13 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
 
 def _split_kernel(bt_ref, len_ref,                 # scalar prefetch (SMEM)
                   q_ref, *rest, bs, scale, window, has_alibi, n_splits):
-    """Flash-decoding variant (one grid step = one KV SPLIT of one
+    """Flash-decoding-SHAPED kernel (one grid step = one KV split of one
     (slot, kv-head)): the page loop covers only this split's share of the
-    slot's live pages, and the kernel emits UNNORMALIZED partials
-    (acc, m, l) that a tiny XLA epilogue merges with the standard
-    logsumexp-weighted combine.  Long-KV decode latency then scales with
-    pages/n_splits instead of pages (the serial DMA loop was the critical
-    path)."""
+    slot's live pages and emits UNNORMALIZED partials (acc, m, l) that a
+    tiny XLA epilogue merges with the standard logsumexp-weighted combine.
+    n_splits=1 (the default) IS the single-pass decode kernel; more splits
+    only help where the grid axis can actually run concurrently — see the
+    module docstring."""
     if has_alibi:
         slopes_ref, k_hbm, v_hbm, o_ref, m_ref, l_ref, k_buf, v_buf, sem = \
             rest
@@ -217,11 +219,13 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
     block_table = block_table.astype(jnp.int32)
     kv_lens = kv_lens.astype(jnp.int32)
     if num_kv_splits is None:
-        # flash-decoding heuristic: split long block tables so the serial
-        # per-(slot, head) DMA loop stops being the latency floor; short
-        # tables run a single split (the combine epilogue degenerates to a
-        # normalize)
-        num_kv_splits = max(1, min(8, MB // 16))
+        # DEFAULT 1: Pallas TPU executes grid dimensions sequentially on a
+        # core (and this DMA-loop kernel must not be megacore-partitioned),
+        # so extra splits do not parallelize on current single-core
+        # dispatch — they only pay partial-writeback + combine.  The knob
+        # exists for explicit experimentation (e.g. future megacore-safe
+        # variants or very small slot×head grids); measure before enabling.
+        num_kv_splits = 1
     return _pallas_paged_attention_split(
         q, k_pages, v_pages, block_table, kv_lens,
         alibi_slopes=alibi_slopes, window=window, scale=float(scale),
@@ -231,8 +235,10 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
 def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
                                   *, alibi_slopes, window, scale, interpret,
                                   num_kv_splits: int):
-    """Flash-decoding dispatch: grid (S, nkv, splits) of unnormalized
-    partials + logsumexp-weighted XLA combine."""
+    """Grid (S, nkv, splits) of unnormalized partials + logsumexp-weighted
+    XLA combine (flash-decoding shape).  Inputs arrive NORMALIZED (int32
+    tables, float scale) from _pallas_paged_attention_local — the only
+    caller."""
     S, nkv, g, hd = q.shape
     NB, _, bs, _ = k_pages.shape
     NS = num_kv_splits
@@ -280,7 +286,7 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *inputs)
+    )(block_table, kv_lens, *inputs)
     # combine: o = Σ exp(m_s − m*) acc_s / Σ exp(m_s − m*) l_s
     m_star = jnp.max(m, axis=2, keepdims=True)              # [S, nkv, 1, g]
     w = jnp.exp(m - m_star)                                 # [S, nkv, NS, g]

@@ -133,3 +133,38 @@ def build_schedule(name: str, params: Dict[str, Any]) -> Schedule:
 
 def constant(lr: float) -> Schedule:
     return optax.constant_schedule(lr)
+
+
+def add_tuning_arguments(parser):
+    """reference lr_schedules.add_tuning_arguments (:60): the convergence-
+    tuning CLI group (LR schedule + range-test + 1Cycle knobs).  The parsed
+    values map onto the scheduler config blocks this module builds."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training")
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    def _str2bool(v):
+        if isinstance(v, bool):
+            return v
+        return str(v).lower() in ("1", "true", "yes", "y")
+    group.add_argument("--lr_range_test_staircase", type=_str2bool,
+                       default=False)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log")
+    return parser

@@ -83,3 +83,20 @@ def GatheredParameters(params: Any = None, modifier_rank: Optional[int] = None,
                       "compatibility no-op (device_get the leaf, or assign "
                       "a new params tree for updates)")
     yield params
+
+
+@contextlib.contextmanager
+def OnDevice(dtype=None, device: str = "meta", enabled: bool = True,
+             **kwargs):
+    """reference deepspeed.OnDevice (utils/init_on_device.py:12): construct
+    modules without materializing weights (torch meta device).
+
+    TPU: flax modules are DESCRIPTIONS — no parameters exist until the
+    engine's jitted ``init`` runs (and then they are born sharded), so every
+    model here is effectively built "on meta".  Compatibility no-op."""
+    if enabled:
+        _warn_once("ondevice",
+                   "OnDevice: flax modules carry no parameters until the "
+                   "engine's jitted init — construction is always "
+                   "deferred/meta on TPU; this context is a no-op")
+    yield
