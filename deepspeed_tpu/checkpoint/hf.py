@@ -82,14 +82,39 @@ def _reject_unsupported_semantics(hf: Dict[str, Any], arch: str,
     """Raise rather than silently serve a DIFFERENT model: config fields that
     change the math must be implemented or rejected (round-2 review)."""
     scaling = hf.get("rope_scaling")
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+    if scaling and scaling.get("rope_type", scaling.get("type")) not in (
+            "default", "llama3", "linear"):
         raise ValueError(
             f"{arch}: rope_scaling={scaling!r} is not implemented "
-            f"(llama3/yarn-scaled RoPE); logits would be silently wrong")
+            f"(yarn/dynamic/longrope); logits would be silently wrong")
     if hf.get("mlp_bias"):
         raise ValueError(
             f"{arch}: mlp_bias=true (gate/up/down biases) is not implemented "
             f"in the SwiGLU body; logits would be silently wrong")
+
+
+def _rope_scaling_of(hf: Dict[str, Any]):
+    """HF rope_scaling dict → GPTConfig.rope_scaling tuple (llama-3.1
+    piecewise scheme and linear position interpolation; anything else was
+    rejected by _reject_unsupported_semantics)."""
+    scaling = hf.get("rope_scaling")
+    if not scaling:
+        return None
+    kind = scaling.get("rope_type", scaling.get("type"))
+    try:
+        if kind == "llama3":
+            return ("llama3", float(scaling["factor"]),
+                    float(scaling["low_freq_factor"]),
+                    float(scaling["high_freq_factor"]),
+                    float(scaling["original_max_position_embeddings"]))
+        if kind == "linear":
+            return ("linear", float(scaling["factor"]))
+    except KeyError as e:
+        raise ValueError(
+            f"rope_scaling type {kind!r} is missing required key {e} "
+            f"(got keys {sorted(scaling)}) — corrupt or hand-edited "
+            f"config.json") from None
+    return None
 def _sliding_window_of(hf: Dict[str, Any],
                        max_seq_len: Optional[int]) -> Optional[int]:
     """Effective sliding window (mistral/qwen2): None when disabled or when
@@ -159,6 +184,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             num_kv_heads=hf.get("num_key_value_heads", heads),
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
             qkv_bias=(arch == "Qwen2ForCausalLM") or attn_bias,
             attn_out_bias=attn_bias,
@@ -243,6 +269,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             num_kv_heads=hf.get("num_key_value_heads") or heads,
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=True, attn_out_bias=True, mlp_bias=True,
             unembed_bias=True,
@@ -292,6 +319,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             num_kv_heads=nkv,
             tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
             qkv_bias=has_bias, attn_out_bias=has_bias, mlp_bias=has_bias,
             dtype=dtype or jnp.bfloat16,
@@ -347,6 +375,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             rope_pct=float(hf.get("rotary_pct", 0.25)),
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=True, attn_out_bias=True, mlp_bias=True,
             dtype=dtype or jnp.bfloat16,
@@ -416,6 +445,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             num_kv_heads=hf.get("num_key_value_heads", heads),
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
             qkv_bias=bool(hf.get("use_qkv_bias", False)),
             dtype=dtype or jnp.bfloat16,
@@ -441,6 +471,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             num_kv_heads=hf.get("num_key_value_heads", heads),
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
             sliding_window=_sliding_window_of(hf, max_seq_len),
             dtype=dtype or jnp.bfloat16,
@@ -470,6 +501,7 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
             num_kv_heads=hf.get("num_key_value_heads", heads),
             tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling=_rope_scaling_of(hf),
             norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
             qkv_bias=gemma_bias, attn_out_bias=gemma_bias,
             dtype=dtype or jnp.bfloat16,

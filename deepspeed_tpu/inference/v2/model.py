@@ -179,7 +179,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         if cfg.use_rope:
             # rope() takes [B, T, n, d] + positions [B, T]
             q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim,
-                        base=cfg.rope_theta, rope_pct=cfg.rope_pct)
+                        base=cfg.rope_theta, rope_pct=cfg.rope_pct,
+                        scaling=cfg.rope_scaling)
             q, k = q[0], k[0]
 
         # ---- paged KV append (reference linear_blocked_kv_rotary) ----
@@ -273,7 +274,8 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         q, k, v = _qkv(ap, h, cfg, "sh,hkd->skd")
         if cfg.use_rope:
             q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd,
-                        base=cfg.rope_theta, rope_pct=cfg.rope_pct)
+                        base=cfg.rope_theta, rope_pct=cfg.rope_pct,
+                        scaling=cfg.rope_scaling)
             q, k = q[:, 0], k[:, 0]
 
         page_li = jnp.where(active, li * NB + page, big)

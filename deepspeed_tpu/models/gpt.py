@@ -71,6 +71,10 @@ class GPTConfig:
     # (qwen2: qkv only; gpt2: everywhere)
     mlp_dim_override: Optional[int] = None
     rope_theta: float = 10000.0
+    # rope scaling (llama-3.1+ long-context checkpoints; HF rope_scaling):
+    # ("llama3", factor, low_freq_factor, high_freq_factor, original_max) or
+    # ("linear", factor); None = unscaled
+    rope_scaling: Optional[tuple] = None
     norm_eps: Optional[float] = None    # None = ops/norms.py defaults
     qkv_bias: bool = False
     attn_out_bias: bool = False
@@ -228,15 +232,45 @@ def rotary_dim(head_dim: int, rope_pct: float) -> int:
     return rot - (rot % 2)
 
 
-def rope(q, k, positions, head_dim, base=10000.0, rope_pct=1.0):
+def _scale_rope_freq(freq, scaling):
+    """Frequency transform for long-context rope scaling (HF
+    modeling_rope_utils):
+    - ("linear", factor): inv_freq / factor (position interpolation)
+    - ("llama3", factor, low_freq_factor, high_freq_factor, original_max):
+      the llama-3.1 piecewise scheme — low frequencies divide by factor,
+      high frequencies pass through, the medium band interpolates smoothly
+      (matches _compute_llama3_parameters bit-for-bit in fp32)."""
+    import math as _math
+    kind = scaling[0]
+    if kind == "linear":
+        return freq / float(scaling[1])
+    if kind == "llama3":
+        _, factor, lo_f, hi_f, orig = scaling
+        factor, lo_f, hi_f, orig = (float(factor), float(lo_f),
+                                    float(hi_f), float(orig))
+        wavelen = 2.0 * _math.pi / freq
+        low_wl = orig / lo_f
+        high_wl = orig / hi_f
+        scaled = jnp.where(wavelen > low_wl, freq / factor, freq)
+        smooth = (orig / wavelen - lo_f) / (hi_f - lo_f)
+        smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+        is_medium = (wavelen >= high_wl) & (wavelen <= low_wl)
+        return jnp.where(is_medium, smoothed, scaled)
+    raise ValueError(f"unknown rope scaling kind {kind!r}")
+
+
+def rope(q, k, positions, head_dim, base=10000.0, rope_pct=1.0,
+         scaling=None):
     """Rotary position embedding (reference CUDA kernel:
     csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu — on TPU a few
     elementwise ops XLA fuses into the attention matmuls).  rope_pct < 1
     rotates only the first ``rotary_dim`` channels (phi-style partial rotary);
-    the remainder passes through."""
+    the remainder passes through.  ``scaling`` = GPTConfig.rope_scaling."""
     rot = rotary_dim(head_dim, rope_pct)
     half = rot // 2
     freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        freq = _scale_rope_freq(freq, tuple(scaling))
     angles = positions[..., None].astype(jnp.float32) * freq  # [B,T,half]
     sin, cos = jnp.sin(angles), jnp.cos(angles)
 
@@ -349,7 +383,7 @@ class Attention(nn.Module):
 
         if c.use_rope:
             q, k = rope(q, k, positions, hd, base=c.rope_theta,
-                        rope_pct=c.rope_pct)
+                        rope_pct=c.rope_pct, scaling=c.rope_scaling)
 
         def alibi_bias(key_pos):
             """[.., S] key positions → [.., nh, 1, S] logit bias.  Key-
