@@ -83,10 +83,10 @@ def _reject_unsupported_semantics(hf: Dict[str, Any], arch: str,
     change the math must be implemented or rejected (round-2 review)."""
     scaling = hf.get("rope_scaling")
     if scaling and scaling.get("rope_type", scaling.get("type")) not in (
-            "default", "llama3", "linear"):
+            "default", "llama3", "linear", "longrope"):
         raise ValueError(
             f"{arch}: rope_scaling={scaling!r} is not implemented "
-            f"(yarn/dynamic/longrope); logits would be silently wrong")
+            f"(yarn/dynamic); logits would be silently wrong")
     if hf.get("mlp_bias"):
         raise ValueError(
             f"{arch}: mlp_bias=true (gate/up/down biases) is not implemented "
@@ -109,6 +109,36 @@ def _rope_scaling_of(hf: Dict[str, Any]):
                     float(scaling["original_max_position_embeddings"]))
         if kind == "linear":
             return ("linear", float(scaling["factor"]))
+        if kind == "longrope":
+            # phi-3 long-context (HF _compute_longrope_parameters): per-
+            # channel short/long factors + the paper's attention factor.
+            # HF precedence: a (top-level or scaling-dict) original_max
+            # overrides rope_scaling["factor"] via msl/orig; with neither
+            # the extension ratio is underivable — reject, don't guess.
+            import math as _math
+            short = tuple(float(x) for x in scaling["short_factor"])
+            long_ = tuple(float(x) for x in scaling["long_factor"])
+            msl = float(hf.get("max_position_embeddings", 2048))
+            orig = (hf.get("original_max_position_embeddings")
+                    or scaling.get("original_max_position_embeddings"))
+            if orig is not None:
+                orig = float(orig)
+                factor = msl / orig
+            elif scaling.get("factor") is not None:
+                orig = msl            # HF fallback: orig = max_position
+                factor = float(scaling["factor"])
+            else:
+                raise ValueError(
+                    "rope_scaling longrope needs "
+                    "original_max_position_embeddings (top-level or in "
+                    "rope_scaling) or a 'factor' — neither present; the "
+                    "attention factor and regime boundary are underivable")
+            att = scaling.get("attention_factor")
+            if att is None:
+                att = (1.0 if factor <= 1.0 else
+                       _math.sqrt(1.0 + _math.log(factor)
+                                  / _math.log(orig)))
+            return ("longrope", float(att), short, long_, orig)
     except KeyError as e:
         raise ValueError(
             f"rope_scaling type {kind!r} is missing required key {e} "
@@ -453,7 +483,8 @@ def config_from_hf(model_path: str, *, max_seq_len: Optional[int] = None,
     if arch in _PHI3_LIKE:
         # phi-3 (reference inference/v2/model_implementations/phi3): llama
         # semantics with FUSED qkv_proj and gate_up_proj (split in the tree
-        # builder); longrope scaling rejected by the shared guard
+        # builder); longrope scaling is LIVE (short/long factor tables
+        # selected in-graph by sequence length, models/gpt.py rope)
         _reject_unsupported_semantics(hf, arch, max_seq_len)
         hidden = hf["hidden_size"]
         heads = hf["num_attention_heads"]

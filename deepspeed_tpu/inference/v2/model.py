@@ -180,7 +180,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
             # rope() takes [B, T, n, d] + positions [B, T]
             q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim,
                         base=cfg.rope_theta, rope_pct=cfg.rope_pct,
-                        scaling=cfg.rope_scaling)
+                        scaling=cfg.rope_scaling,
+                        seq_lens=kv_len[jnp.clip(token_slot, 0)][None])
             q, k = q[0], k[0]
 
         # ---- paged KV append (reference linear_blocked_kv_rotary) ----
@@ -275,7 +276,8 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         if cfg.use_rope:
             q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd,
                         base=cfg.rope_theta, rope_pct=cfg.rope_pct,
-                        scaling=cfg.rope_scaling)
+                        scaling=cfg.rope_scaling,
+                        seq_lens=kv_len[:, None])
             q, k = q[:, 0], k[:, 0]
 
         page_li = jnp.where(active, li * NB + page, big)

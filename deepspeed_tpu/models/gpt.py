@@ -260,19 +260,45 @@ def _scale_rope_freq(freq, scaling):
 
 
 def rope(q, k, positions, head_dim, base=10000.0, rope_pct=1.0,
-         scaling=None):
+         scaling=None, seq_lens=None):
     """Rotary position embedding (reference CUDA kernel:
     csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu — on TPU a few
     elementwise ops XLA fuses into the attention matmuls).  rope_pct < 1
     rotates only the first ``rotary_dim`` channels (phi-style partial rotary);
-    the remainder passes through.  ``scaling`` = GPTConfig.rope_scaling."""
+    the remainder passes through.  ``scaling`` = GPTConfig.rope_scaling.
+
+    longrope (phi-3 long-context; ("longrope", attention_factor,
+    short_factors, long_factors, original_max)): the short/long per-channel
+    factor table is selected IN-GRAPH from each SEQUENCE's current length vs
+    the pretrained context (HF selects per forward the same way), and
+    cos/sin scale by the attention factor.  ``seq_lens``: per-element
+    sequence lengths shaped like ``positions`` (ragged serving passes each
+    token's slot kv length so co-batched sequences select independently);
+    default = per-ROW max position + 1."""
+    att_factor = None
     rot = rotary_dim(head_dim, rope_pct)
     half = rot // 2
     freq = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    if scaling is not None:
-        freq = _scale_rope_freq(freq, tuple(scaling))
-    angles = positions[..., None].astype(jnp.float32) * freq  # [B,T,half]
+    if scaling is not None and scaling[0] == "longrope":
+        _, att_factor, short_f, long_f, orig = scaling
+        if seq_lens is None:
+            # per-row: a padded/multi-row batch must not let one long row
+            # flip the others' factor table
+            seq_lens = jnp.max(positions, axis=-1, keepdims=True) + 1
+        is_long = (seq_lens > orig)[..., None]           # [..., 1]
+        ext = jnp.where(is_long,
+                        jnp.asarray(long_f, jnp.float32),
+                        jnp.asarray(short_f, jnp.float32))
+        angles = (positions[..., None].astype(jnp.float32)
+                  * (freq / ext))                        # [B,T,half]
+    else:
+        if scaling is not None:
+            freq = _scale_rope_freq(freq, tuple(scaling))
+        angles = positions[..., None].astype(jnp.float32) * freq
     sin, cos = jnp.sin(angles), jnp.cos(angles)
+    if att_factor is not None:
+        sin = sin * jnp.float32(att_factor)
+        cos = cos * jnp.float32(att_factor)
 
     def rotfn(x):
         x1, x2 = x[..., :half], x[..., half:rot]

@@ -1030,6 +1030,63 @@ class TestPhi3:
                                       do_sample=False))[0]
         np.testing.assert_array_equal(got, want)
 
+    def test_phi3_longrope_short_and_long_regimes(self, tmp_models, rng):
+        """Phi-3 longrope (round 3: previously rejected): per-channel
+        short/long factor tables selected by sequence length + the paper's
+        attention factor — parity vs HF in BOTH regimes."""
+        hd_half = (64 // 4) // 2
+        r = np.random.default_rng(5)
+        cfg = transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=172,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=64,
+            original_max_position_embeddings=16,
+            pad_token_id=0, eos_token_id=1, bos_token_id=2,
+            tie_word_embeddings=False,
+            rope_scaling={
+                "type": "longrope",
+                "short_factor": (1.0 + r.random(hd_half) * 0.2).tolist(),
+                "long_factor": (2.0 + r.random(hd_half)).tolist()})
+        torch.manual_seed(40)
+        model = transformers.Phi3ForCausalLM(cfg).eval()
+        path = _save(tmp_models, model, "phi3_longrope")
+        from deepspeed_tpu.checkpoint.hf import config_from_hf
+        c = config_from_hf(path)
+        assert c.rope_scaling is not None and c.rope_scaling[0] == "longrope"
+        # short regime: seq 12 <= original 16
+        ids = rng.integers(3, 128, (2, 12)).astype(np.int32)
+        np.testing.assert_allclose(_our_logits(path, ids),
+                                   _torch_logits(model, ids),
+                                   atol=2e-3, rtol=1e-3)
+        # long regime: seq 24 > original 16 → the LONG factor table
+        ids = rng.integers(3, 128, (2, 24)).astype(np.int32)
+        np.testing.assert_allclose(_our_logits(path, ids),
+                                   _torch_logits(model, ids),
+                                   atol=2e-3, rtol=1e-3)
+
+    def test_phi3_longrope_cobatched_regimes_independent(self, tmp_models,
+                                                         rng):
+        """A LONG sequence co-scheduled with a SHORT one in the ragged engine
+        must not flip the short one onto the long factor table: each slot
+        selects by ITS OWN kv length (per-token seq_lens in rope)."""
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        d = os.path.join(str(tmp_models), "phi3_longrope")
+        assert os.path.exists(os.path.join(d, "config.json")), \
+            "run test_phi3_longrope_short_and_long_regimes first (fixture)"
+        sm = {"dtype": "fp32",
+              "state_manager": {"max_tracked_sequences": 3,
+                                "kv_block_size": 8},
+              "generation": {"do_sample": False}}
+        short_p = rng.integers(3, 128, (6,)).astype(np.int32)   # < orig 16
+        long_p = rng.integers(3, 128, (22,)).astype(np.int32)   # > orig 16
+        eng_solo = InferenceEngineV2(d, sm)
+        want_short = eng_solo.generate([short_p], max_new_tokens=4)[0]
+        del eng_solo
+        eng_both = InferenceEngineV2(d, sm)
+        got = eng_both.generate([short_p, long_p], max_new_tokens=4)
+        np.testing.assert_array_equal(got[0], want_short)
+
     def test_phi3_partial_rotary_variant(self, tmp_models, rng):
         """phi-4-mini-style partial_rotary_factor under the Phi3 arch."""
         cfg = transformers.Phi3Config(
