@@ -169,15 +169,32 @@ class InferenceEngineV2:
                                                   zero_stage=0)
             self.params = jax.device_put(self.params, shardings)
 
-        blocks_per_seq = -(-model_cfg.max_seq_len // sm.kv_block_size)
-        num_blocks = (sm.num_kv_blocks if sm.num_kv_blocks
-                      else sm.max_tracked_sequences * blocks_per_seq)
+        from deepspeed_tpu.inference.v2.model import kv_block_size_for
+        from deepspeed_tpu.ops.registry import would_use_pallas
+        # only the Pallas kernels need 128-aligned kv-major pages; off-TPU
+        # (XLA fallback / interpret tests) any size works, so don't disturb
+        # the configured granularity there
+        eff_bs = sm.kv_block_size
+        if would_use_pallas("paged_attention"):
+            eff_bs = kv_block_size_for(model_cfg, sm.kv_block_size)
+        if eff_bs != sm.kv_block_size:
+            log_dist(
+                f"kv_block_size {sm.kv_block_size} -> {eff_bs}: head_dim="
+                f"{model_cfg.head_dim} uses the kv-major page layout, whose "
+                f"Pallas DMA needs 128-aligned pages (ops/paged_attention.py)",
+                ranks=[0])
+        blocks_per_seq = -(-model_cfg.max_seq_len // eff_bs)
+        if sm.num_kv_blocks:
+            # the user sized the pool in THEIR block units — preserve the
+            # total-token budget (and HBM footprint) under a bump
+            num_blocks = max(1, sm.num_kv_blocks * sm.kv_block_size // eff_bs)
+        else:
+            num_blocks = sm.max_tracked_sequences * blocks_per_seq
         self.state = DSStateManager(
             max_tracked_sequences=sm.max_tracked_sequences,
-            num_blocks=num_blocks, block_size=sm.kv_block_size,
+            num_blocks=num_blocks, block_size=eff_bs,
             max_seq_len=model_cfg.max_seq_len)
-        self.cache = PagedKVCache.create(model_cfg, num_blocks,
-                                         sm.kv_block_size, dt)
+        self.cache = PagedKVCache.create(model_cfg, num_blocks, eff_bs, dt)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             kv_sh = NamedSharding(self.mesh, P(None, None, "tp", None, None))
@@ -189,13 +206,13 @@ class InferenceEngineV2:
         # buckets are powers of two so the compile cache stays small
         self._steps: Dict[Any, Any] = {}
         self._sampler_cache: Dict[Any, Any] = {}
-        self._block_size = sm.kv_block_size
+        self._block_size = eff_bs
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(self.params))
         log_dist(f"v2 ragged engine ready: params={n_params/1e6:.1f}M "
                  f"budget={sm.max_ragged_batch_size}tok "
                  f"slots={sm.max_tracked_sequences} "
-                 f"kv_blocks={num_blocks}x{sm.kv_block_size}", ranks=[0])
+                 f"kv_blocks={num_blocks}x{eff_bs}", ranks=[0])
 
     # ------------------------------------------------ reference put() :107
     def put(self, uids: Sequence[int], tokens_list: Sequence[np.ndarray],
@@ -365,7 +382,7 @@ class InferenceEngineV2:
             "free_sequence_slots": self.state.free_sequence_slots,
             "token_budget": sm.max_ragged_batch_size,
             "max_q_per_seq": sm.max_q_per_seq,
-            "kv_block_size": sm.kv_block_size,
+            "kv_block_size": self._block_size,
         }
 
     def can_schedule(self, uids: Sequence[int],

@@ -27,22 +27,46 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.gpt import GPTConfig, mlp_activation, rope
 
 
+def kv_major_layout(cfg: GPTConfig) -> bool:
+    """True ⇒ pages are stored token-on-lanes, [NB, nkv, hd, bs].
+
+    The Pallas DMA slab's lane dim must be 128-aligned (ops/
+    paged_attention.py module docstring); head dims that aren't already
+    128-multiples get the transposed layout so the TOKEN axis (a
+    framework-controlled knob — the engine sizes pages to 128) carries the
+    lanes instead.  Pure function of the model config, so every component
+    (cache alloc, scatter, kernels, fallbacks) derives the same answer."""
+    return cfg.head_dim % 128 != 0
+
+
+def kv_block_size_for(cfg: GPTConfig, requested: int) -> int:
+    """Effective page size: kv-major pages need block_size % 128 == 0."""
+    if kv_major_layout(cfg) and requested % 128 != 0:
+        return -(-requested // 128) * 128
+    return requested
+
+
 class PagedKVCache(NamedTuple):
-    """Per-layer paged KV arrays: [num_blocks, n_kv_heads, block_size, head_dim]
-    stacked on a leading layer axis (reference: KVCacheManager kv_cache.py).
+    """Per-layer paged KV arrays stacked on a leading layer axis (reference:
+    KVCacheManager kv_cache.py).
 
-    Layout note: (kv_head, token-in-page, head_dim) trailing order makes one
-    page × one kv head a clean [block_size, head_dim] TPU tile — exactly the
-    block the Pallas paged-attention decode kernel streams (ops/
-    paged_attention.py)."""
+    Layout: [L, num_blocks, nkv, block_size, head_dim], OR the kv-major
+    transpose [L, num_blocks, nkv, head_dim, block_size] when
+    ``kv_major_layout(cfg)`` — one page × one kv head is then a clean TPU
+    tile with a 128-aligned lane dim for EVERY hd % 8 == 0 model, which is
+    what the Pallas paged/prefill kernels DMA (ops/paged_attention.py)."""
 
-    k: jax.Array  # [L, num_blocks, nkv, bs, hd]
+    k: jax.Array
     v: jax.Array
 
     @classmethod
     def create(cls, cfg: GPTConfig, num_blocks: int, block_size: int, dtype):
-        shape = (cfg.num_layers, num_blocks, cfg.kv_heads, block_size,
-                 cfg.head_dim)
+        if kv_major_layout(cfg):
+            shape = (cfg.num_layers, num_blocks, cfg.kv_heads, cfg.head_dim,
+                     block_size)
+        else:
+            shape = (cfg.num_layers, num_blocks, cfg.kv_heads, block_size,
+                     cfg.head_dim)
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -163,13 +187,14 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     q_counts = jnp.zeros((S,), jnp.int32).at[scat_slot].add(1, mode="drop")
     q_starts = kv_len - q_counts
 
-    # [L * num_blocks, nkv, bs, hd] views updated IN PLACE through the
-    # donated cache buffer — never rebuild the whole pool (a jnp.stack of
-    # per-layer copies costs a full cache rewrite per step)
+    # [L * num_blocks, nkv, …] views updated IN PLACE through the donated
+    # cache buffer — never rebuild the whole pool (a jnp.stack of per-layer
+    # copies costs a full cache rewrite per step)
     L = cfg.num_layers
     NB = cache.k.shape[1]
-    flat_k_all = cache.k.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
-    flat_v_all = cache.v.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
+    km = kv_major_layout(cfg)
+    flat_k_all = cache.k.reshape((-1,) + cache.k.shape[2:])
+    flat_v_all = cache.v.reshape((-1,) + cache.v.shape[2:])
 
     for li in range(cfg.num_layers):
         blk = bb[f"block_{li}"]
@@ -186,10 +211,16 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
         # ---- paged KV append (reference linear_blocked_kv_rotary) ----
         page_li = jnp.where(valid, li * NB + page, big)
-        flat_k_all = flat_k_all.at[page_li, :, off].set(
-            k.astype(flat_k_all.dtype), mode="drop")
-        flat_v_all = flat_v_all.at[page_li, :, off].set(
-            v.astype(flat_v_all.dtype), mode="drop")
+        if km:   # pages [P, nkv, hd, bs]: token offset is the LANE index
+            flat_k_all = flat_k_all.at[page_li, :, :, off].set(
+                k.astype(flat_k_all.dtype), mode="drop")
+            flat_v_all = flat_v_all.at[page_li, :, :, off].set(
+                v.astype(flat_v_all.dtype), mode="drop")
+        else:
+            flat_k_all = flat_k_all.at[page_li, :, off].set(
+                k.astype(flat_k_all.dtype), mode="drop")
+            flat_v_all = flat_v_all.at[page_li, :, off].set(
+                v.astype(flat_v_all.dtype), mode="drop")
 
         # ---- ragged blocked attention (reference blocked_flash +
         # atom_builder): dense-per-slot q layout, per-slot contiguous
@@ -213,7 +244,8 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
             q_dense.reshape(S, Q, nkv, gq, hd).astype(dtype),
             k_pool.astype(dtype), v_pool.astype(dtype), block_table, kv_len,
             q_starts, q_counts, scale=cfg.attn_scale, alibi_slopes=slopes,
-            window=win, mesh=mesh).reshape(S, Q, cfg.num_heads, hd)
+            window=win, mesh=mesh, kv_major=km).reshape(
+                S, Q, cfg.num_heads, hd)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
         attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")
@@ -244,7 +276,8 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     (ops/paged_attention.py — Pallas kernel on TPU, masked-gather XLA
     fallback).  Shared by the single-step and burst programs.
 
-    flat_k_all/flat_v_all: [L*NB, nkv, bs, hd] views of the donated cache.
+    flat_k_all/flat_v_all: [L*NB, nkv, …] views of the donated cache
+    (standard or kv-major trailing order per kv_major_layout(cfg)).
     """
     from deepspeed_tpu import ops
     bb = params["backbone"]
@@ -254,6 +287,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     NB = flat_k_all.shape[0] // L
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     g = nh // nkv
+    km = kv_major_layout(cfg)
 
     x = bb["wte"].astype(dtype)[tokens]                       # [S, H]
     if cfg.embed_scale:
@@ -281,10 +315,16 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
             q, k = q[:, 0], k[:, 0]
 
         page_li = jnp.where(active, li * NB + page, big)
-        flat_k_all = flat_k_all.at[page_li, :, off].set(
-            k.astype(flat_k_all.dtype), mode="drop")
-        flat_v_all = flat_v_all.at[page_li, :, off].set(
-            v.astype(flat_v_all.dtype), mode="drop")
+        if km:   # pages [P, nkv, hd, bs]: token offset is the LANE index
+            flat_k_all = flat_k_all.at[page_li, :, :, off].set(
+                k.astype(flat_k_all.dtype), mode="drop")
+            flat_v_all = flat_v_all.at[page_li, :, :, off].set(
+                v.astype(flat_v_all.dtype), mode="drop")
+        else:
+            flat_k_all = flat_k_all.at[page_li, :, off].set(
+                k.astype(flat_k_all.dtype), mode="drop")
+            flat_v_all = flat_v_all.at[page_li, :, off].set(
+                v.astype(flat_v_all.dtype), mode="drop")
 
         k_pages = jax.lax.dynamic_slice_in_dim(flat_k_all, li * NB, NB)
         v_pages = jax.lax.dynamic_slice_in_dim(flat_v_all, li * NB, NB)
@@ -296,7 +336,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         win = cfg.window_for_layer(li)
         o = ops.paged_attention(qg, k_pages, v_pages, block_table, kv_len,
                                 alibi_slopes=slopes, window=win,
-                                scale=cfg.attn_scale, mesh=mesh)
+                                scale=cfg.attn_scale, mesh=mesh, kv_major=km)
         o = o.reshape(S, nh, hd)
         attn_delta = _attn_out(ap, o, cfg, "skd,kdh->sh")
         x = _block_residual(blk, x, h, attn_delta, cfg)
@@ -326,9 +366,8 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
     pre-allocated.
     Returns (tokens [T, S], cache).
     """
-    bs = block_size
-    flat_k = cache.k.reshape(-1, cfg.kv_heads, bs, cfg.head_dim)
-    flat_v = cache.v.reshape(-1, cfg.kv_heads, bs, cfg.head_dim)
+    flat_k = cache.k.reshape((-1,) + cache.k.shape[2:])
+    flat_v = cache.v.reshape((-1,) + cache.v.shape[2:])
     bt = batch["block_table"]
     active = batch["active"]
 
@@ -358,8 +397,8 @@ def ragged_decode_forward(params, cache: PagedKVCache, batch,
     batch: tokens [S], active [S] bool, token_pos [S] (position being written),
     block_table [S, MB] int32 (each slot's physical pages, in order).
     """
-    flat_k = cache.k.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
-    flat_v = cache.v.reshape(-1, cfg.kv_heads, block_size, cfg.head_dim)
+    flat_k = cache.k.reshape((-1,) + cache.k.shape[2:])
+    flat_v = cache.v.reshape((-1,) + cache.v.shape[2:])
     logits, flat_k, flat_v = _decode_core(
         params, flat_k, flat_v, batch["tokens"], batch["active"],
         batch["token_pos"], batch["block_table"], cfg, block_size, mesh=mesh)

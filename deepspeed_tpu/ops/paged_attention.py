@@ -25,6 +25,17 @@ Kernel design (vs the XLA fallback, which masks over gathered pages):
 Layouts: q [S, nkv, g, hd]; k_pages/v_pages [NB, nkv, bs, hd] (bs = tokens
 per page); block_table [S, MB] int32; kv_lens [S] int32 (0 ⇒ inactive slot →
 zero output).  Output [S, nkv, g, hd].
+
+kv-major layout (``kv_major=True``): pages are stored TRANSPOSED,
+[NB, nkv, hd, bs].  Mosaic requires a DMA slab's lane (last) dimension to be
+128-aligned; with the standard layout that means hd % 128 == 0, which
+excludes hd∈{64, 80, 96} — a large slice of the zoo (GPT-2, BLOOM-ish
+configs, small llamas).  Putting the TOKEN axis on lanes instead makes the
+constraint bs % 128 == 0 (a framework-controlled knob: the engine bumps
+kv_block_size to 128), and the two kernel matmuls become the natural MXU
+layouts: scores = q·K (contract hd = K's sublane axis) and out = P·Vᵀ
+(contract bs = V's lane axis) — no transposes at all.  The engine picks
+kv-major automatically whenever hd % 128 != 0 (model.py kv_major_layout).
 """
 
 from __future__ import annotations
@@ -41,23 +52,40 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _gather_pages(pages, block_table, kv_major):
+    """Gather each slot's pages THEN normalize the layout — transposing only
+    the [S, MB, …] gather result, never the whole pool.  Returns
+    [S, MB*bs, nkv, hd]."""
+    got = pages[block_table]               # [S, MB, nkv, bs|hd, hd|bs]
+    S, MB = got.shape[:2]
+    nkv = got.shape[2]
+    if kv_major:                           # [S, MB, nkv, hd, bs]
+        got = jnp.transpose(got, (0, 1, 4, 2, 3))
+        hd = got.shape[4]
+    else:                                  # [S, MB, nkv, bs, hd]
+        got = jnp.swapaxes(got, 2, 3)
+        hd = got.shape[4]
+    return got.reshape(S, -1, nkv, hd)
+
+
 def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                         scale: Optional[float] = None, alibi_slopes=None,
-                        window=None, interpret=None, mesh=None):
+                        window=None, interpret=None, mesh=None,
+                        kv_major=False):
     """Ground-truth XLA path: gather this slot's pages, masked softmax.
 
     ``mesh`` is accepted for signature parity with the Pallas path; the XLA
     body is einsum/gather code the SPMD partitioner shards on its own."""
     S, nkv, g, hd = q.shape
-    NB, _, bs, _ = k_pages.shape
+    if kv_major:
+        NB, _, _, bs = k_pages.shape
+    else:
+        NB, _, bs, _ = k_pages.shape
     MB = block_table.shape[1]
     if scale is None:
         scale = hd ** -0.5
-    # [S, MB, nkv, bs, hd] -> [S, nkv, MB*bs, hd]
-    k_seq = jnp.swapaxes(k_pages[block_table], 2, 3).reshape(
-        S, MB * bs, nkv, hd)
-    v_seq = jnp.swapaxes(v_pages[block_table], 2, 3).reshape(
-        S, MB * bs, nkv, hd)
+    k_seq = _gather_pages(k_pages, block_table, kv_major)   # [S, MB*bs, nkv, hd]
+    v_seq = _gather_pages(v_pages, block_table, kv_major)
     kvpos = jnp.arange(MB * bs)
     mask = kvpos[None, :] < kv_lens[:, None]                  # [S, K]
     if window is not None:
@@ -77,20 +105,25 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     return jnp.einsum("sngk,sknd->sngd", probs.astype(q.dtype), v_seq)
 
 
-def _split_kernel(bt_ref, len_ref,                 # scalar prefetch (SMEM)
-                  q_ref, *rest, bs, scale, window, has_alibi, n_splits):
+def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major):
     """Flash-decoding-SHAPED kernel (one grid step = one KV split of one
     (slot, kv-head)): the page loop covers only this split's share of the
     slot's live pages and emits UNNORMALIZED partials (acc, m, l) that a
     tiny XLA epilogue merges with the standard logsumexp-weighted combine.
     n_splits=1 (the default) IS the single-pass decode kernel; more splits
     only help where the grid axis can actually run concurrently — see the
-    module docstring."""
+    module docstring.
+
+    Alibi slopes ride in SMEM scalar prefetch ([nkv, g] f32): a (1, g)
+    VMEM BlockSpec is rejected by Mosaic when nkv > 1 (sublane block of 1
+    against an nkv-sized axis), and per-head scalars are SMEM-natured
+    anyway."""
     if has_alibi:
-        slopes_ref, k_hbm, v_hbm, o_ref, m_ref, l_ref, k_buf, v_buf, sem = \
-            rest
+        bt_ref, len_ref, slopes_ref, q_ref, k_hbm, v_hbm, \
+            o_ref, m_ref, l_ref, k_buf, v_buf, sem = refs
     else:
-        k_hbm, v_hbm, o_ref, m_ref, l_ref, k_buf, v_buf, sem = rest
+        bt_ref, len_ref, q_ref, k_hbm, v_hbm, \
+            o_ref, m_ref, l_ref, k_buf, v_buf, sem = refs
         slopes_ref = None
     s, h, sp = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     length = len_ref[s]
@@ -130,15 +163,16 @@ def _split_kernel(bt_ref, len_ref,                 # scalar prefetch (SMEM)
 
         dma(k_hbm, k_buf, slot, p, 0).wait()
         dma(v_hbm, v_buf, slot, p, 1).wait()
-        k = k_buf[slot]
+        k = k_buf[slot]                # [bs, hd] or [hd, bs] (kv-major)
         v = v_buf[slot]
+        k_dims = ((1,), (0,)) if kv_major else ((1,), (1,))
         scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (k_dims, ((), ())),
             preferred_element_type=jnp.float32) * scale
         kvpos = p * bs + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         if has_alibi:
-            scores = scores + (slopes_ref[0, :][:, None]
-                               * kvpos.astype(jnp.float32))
+            sl = jnp.stack([slopes_ref[h, i] for i in range(g)])
+            scores = scores + sl[:, None] * kvpos.astype(jnp.float32)
         valid = kvpos < length
         if window is not None:
             valid = valid & (kvpos >= lo)
@@ -148,8 +182,9 @@ def _split_kernel(bt_ref, len_ref,                 # scalar prefetch (SMEM)
         pr = jnp.where(m_new > _NEG_INF / 2, pr, 0.0)
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(pr, axis=1, keepdims=True)
+        v_dims = ((1,), (1,)) if kv_major else ((1,), (0,))
         pv = jax.lax.dot_general(pr.astype(v.dtype), v,
-                                 (((1,), (0,)), ((), ())),
+                                 (v_dims, ((), ())),
                                  preferred_element_type=jnp.float32)
         return m_new, l, acc * alpha + pv
 
@@ -167,7 +202,7 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
                            num_kv_splits: Optional[int] = None,
-                           mesh=None):
+                           mesh=None, kv_major=False):
     """Mesh-aware entry: with a ``tp`` axis the kv-head dim is sharded, and the
     kernel runs per-shard under shard_map (attention is independent per kv
     head, so TP needs no collective here — the reference shards its blocked
@@ -179,7 +214,8 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
         inner = functools.partial(_pallas_paged_attention_local,
                                   scale=scale, window=window,
                                   interpret=interpret,
-                                  num_kv_splits=num_kv_splits)
+                                  num_kv_splits=num_kv_splits,
+                                  kv_major=kv_major)
         kv_spec = P(None, "tp", None, None)
         in_specs = [kv_spec, kv_spec, kv_spec, P(None, None), P(None)]
         args = [q, k_pages, v_pages, block_table, kv_lens]
@@ -201,16 +237,21 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                                          kv_lens, alibi_slopes=alibi_slopes,
                                          window=window, scale=scale,
                                          interpret=interpret,
-                                         num_kv_splits=num_kv_splits)
+                                         num_kv_splits=num_kv_splits,
+                                         kv_major=kv_major)
 
 
 def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
                                   alibi_slopes=None, window=None,
                                   scale: Optional[float] = None,
                                   interpret: Optional[bool] = None,
-                                  num_kv_splits: Optional[int] = None):
+                                  num_kv_splits: Optional[int] = None,
+                                  kv_major=False):
     S, nkv, g, hd = q.shape
-    NB, _, bs, _ = k_pages.shape
+    if kv_major:
+        NB, _, _, bs = k_pages.shape
+    else:
+        NB, _, bs, _ = k_pages.shape
     MB = block_table.shape[1]
     if scale is None:
         scale = hd ** -0.5
@@ -229,52 +270,53 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
     return _pallas_paged_attention_split(
         q, k_pages, v_pages, block_table, kv_lens,
         alibi_slopes=alibi_slopes, window=window, scale=float(scale),
-        interpret=interpret, num_kv_splits=int(num_kv_splits))
+        interpret=interpret, num_kv_splits=int(num_kv_splits),
+        kv_major=kv_major)
 
 
 def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
                                   *, alibi_slopes, window, scale, interpret,
-                                  num_kv_splits: int):
+                                  num_kv_splits: int, kv_major: bool):
     """Grid (S, nkv, splits) of unnormalized partials + logsumexp-weighted
     XLA combine (flash-decoding shape).  Inputs arrive NORMALIZED (int32
     tables, float scale) from _pallas_paged_attention_local — the only
     caller."""
     S, nkv, g, hd = q.shape
-    NB, _, bs, _ = k_pages.shape
+    bs = k_pages.shape[3] if kv_major else k_pages.shape[2]
     NS = num_kv_splits
     kernel = functools.partial(
         _split_kernel, bs=bs, scale=float(scale),
         window=int(window) if window is not None else None,
-        has_alibi=alibi_slopes is not None, n_splits=NS)
-    in_specs = [
-        pl.BlockSpec((1, 1, g, hd), lambda s, h, sp, bt, lens: (s, h, 0, 0)),
-    ]
-    inputs = [q]
+        has_alibi=alibi_slopes is not None, n_splits=NS, kv_major=kv_major)
+    n_prefetch = 2
+    prefetch = [block_table, kv_lens]
     if alibi_slopes is not None:
-        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
-        in_specs.append(pl.BlockSpec((1, g),
-                                     lambda s, h, sp, bt, lens: (h, 0)))
-        inputs.append(slopes)
-    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
-                 pl.BlockSpec(memory_space=pl.ANY)]
-    inputs += [k_pages, v_pages]
+        n_prefetch = 3
+        prefetch.append(jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            nkv, g))
+    in_specs = [
+        pl.BlockSpec((1, 1, g, hd), lambda s, h, sp, *_: (s, h, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    buf_shape = (2, hd, bs) if kv_major else (2, bs, hd)
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=n_prefetch,
             grid=(S, nkv, NS),
             in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, 1, 1, g, hd),
-                             lambda s, h, sp, bt, lens: (s, h, sp, 0, 0)),
+                             lambda s, h, sp, *_: (s, h, sp, 0, 0)),
                 pl.BlockSpec((1, 1, 1, g),
-                             lambda s, h, sp, bt, lens: (s, h, sp, 0)),
+                             lambda s, h, sp, *_: (s, h, sp, 0)),
                 pl.BlockSpec((1, 1, 1, g),
-                             lambda s, h, sp, bt, lens: (s, h, sp, 0)),
+                             lambda s, h, sp, *_: (s, h, sp, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((2, bs, hd), k_pages.dtype),
-                pltpu.VMEM((2, bs, hd), v_pages.dtype),
+                pltpu.VMEM(buf_shape, k_pages.dtype),
+                pltpu.VMEM(buf_shape, v_pages.dtype),
                 pltpu.SemaphoreType.DMA((4,)),
             ],
         ),
@@ -286,7 +328,7 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_table, kv_lens, *inputs)
+    )(*prefetch, q, k_pages, v_pages)
     # combine: o = Σ exp(m_s − m*) acc_s / Σ exp(m_s − m*) l_s
     m_star = jnp.max(m, axis=2, keepdims=True)              # [S, nkv, 1, g]
     w = jnp.exp(m - m_star)                                 # [S, nkv, NS, g]
@@ -296,17 +338,30 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
     return (num / den[..., None]).astype(q.dtype)
 
 
+def _dma_layout_ok(hd: int, bs: int, kv_major: bool) -> bool:
+    """Mosaic constraint on the per-page DMA slab: its LANE (last) dim must
+    be 128-aligned and its sublane dim 8-aligned (padded lane dims make the
+    slice non-contiguous and the compile is rejected — found on real v5e)."""
+    if kv_major:
+        return bs % 128 == 0 and hd % 8 == 0
+    return hd % 128 == 0 and bs % 8 == 0
+
+
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
-              alibi_slopes=None, window=None, interpret=None, mesh=None):
+              alibi_slopes=None, window=None, interpret=None, mesh=None,
+              kv_major=False):
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
-    NB, nkv2, bs, hd2 = k_pages.shape
+    if kv_major:
+        NB, nkv2, hd2, bs = k_pages.shape
+    else:
+        NB, nkv2, bs, hd2 = k_pages.shape
     if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
         return False
     if window is not None and int(window) <= 0:
         return False
-    return (nkv == nkv2 and hd == hd2 and hd % 8 == 0 and bs % 8 == 0
+    return (nkv == nkv2 and hd == hd2 and _dma_layout_ok(hd, bs, kv_major)
             and block_table.ndim == 2 and block_table.shape[0] == S)
 
 
@@ -315,12 +370,13 @@ def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                     alibi_slopes=None, window=None,
                     impl: Optional[str] = None,
                     interpret: Optional[bool] = None,
-                    mesh=None):
+                    mesh=None, kv_major=False):
     """Registry entry (ops/__init__ registers this like causal_attention)."""
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("paged_attention", q, k_pages, v_pages, block_table,
                     kv_lens, scale=scale, alibi_slopes=alibi_slopes,
-                    window=window, impl=impl, interpret=interpret, mesh=mesh)
+                    window=window, impl=impl, interpret=interpret, mesh=mesh,
+                    kv_major=kv_major)
 
 
 # ===================================================================
@@ -342,17 +398,18 @@ def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
 def xla_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
                        q_counts, *, scale: Optional[float] = None,
                        alibi_slopes=None, window=None, interpret=None,
-                       mesh=None):
+                       mesh=None, kv_major=False):
     """Ground-truth gather + masked-dense path (the round-2 prefill body)."""
     S, Q, nkv, g, hd = q.shape
-    NB, _, bs, _ = k_pages.shape
+    if kv_major:
+        NB, _, _, bs = k_pages.shape
+    else:
+        NB, _, bs, _ = k_pages.shape
     MB = block_table.shape[1]
     if scale is None:
         scale = hd ** -0.5
-    k_seq = jnp.swapaxes(k_pages[block_table], 2, 3).reshape(
-        S, MB * bs, nkv, hd)
-    v_seq = jnp.swapaxes(v_pages[block_table], 2, 3).reshape(
-        S, MB * bs, nkv, hd)
+    k_seq = _gather_pages(k_pages, block_table, kv_major)
+    v_seq = _gather_pages(v_pages, block_table, kv_major)
     kvpos = jnp.arange(MB * bs)                                # [K]
     rows = jnp.arange(Q)
     qpos = q_starts[:, None] + rows[None, :]                   # [S, Q]
@@ -376,12 +433,13 @@ def xla_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
     return jnp.einsum("snqgk,sknd->sqngd", probs.astype(q.dtype), v_seq)
 
 
-def _prefill_kernel(bt_ref, len_ref, start_ref, count_ref,   # scalar prefetch
-                    q_ref, *rest, bs, cq, g, scale, window, has_alibi):
+def _prefill_kernel(*refs, bs, cq, g, scale, window, has_alibi, kv_major):
     if has_alibi:
-        slopes_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+        bt_ref, len_ref, start_ref, count_ref, slopes_ref, \
+            q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = refs
     else:
-        k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = rest
+        bt_ref, len_ref, start_ref, count_ref, \
+            q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = refs
         slopes_ref = None
     s, h, c = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     count = count_ref[s]
@@ -414,8 +472,10 @@ def _prefill_kernel(bt_ref, len_ref, start_ref, count_ref,   # scalar prefetch
     qpos = start + row0 + rown                     # [cq·g, bs]
     row_live = row0 + rown < count
     if has_alibi:
-        slope_rows = jnp.broadcast_to(slopes_ref[0, :][None, :],
-                                      (cq, g)).reshape(cq * g, 1)
+        # SMEM scalar-prefetch slopes [nkv, g]: row r = j·g+gi needs
+        # slopes[h, r % g] — tile the per-group column cq times
+        sl = jnp.stack([slopes_ref[h, i] for i in range(g)]).reshape(g, 1)
+        slope_rows = jnp.tile(sl, (cq, 1))         # [cq·g, 1]
 
     def body(p, carry):
         m, l, acc = carry
@@ -429,10 +489,11 @@ def _prefill_kernel(bt_ref, len_ref, start_ref, count_ref,   # scalar prefetch
 
         dma(k_hbm, k_buf, slot, p, 0).wait()
         dma(v_hbm, v_buf, slot, p, 1).wait()
-        k = k_buf[slot]                            # [bs, hd]
+        k = k_buf[slot]                # [bs, hd] or [hd, bs] (kv-major)
         v = v_buf[slot]
+        k_dims = ((1,), (0,)) if kv_major else ((1,), (1,))
         scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (k_dims, ((), ())),
             preferred_element_type=jnp.float32) * scale       # [cq·g, bs]
         kvpos = p * bs + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         if has_alibi:
@@ -449,8 +510,9 @@ def _prefill_kernel(bt_ref, len_ref, start_ref, count_ref,   # scalar prefetch
         pr = jnp.where(m_new > _NEG_INF / 2, pr, 0.0)
         alpha = jnp.exp(m - m_new)
         l = alpha * l + jnp.sum(pr, axis=1, keepdims=True)
+        v_dims = ((1,), (1,)) if kv_major else ((1,), (0,))
         pv = jax.lax.dot_general(pr.astype(v.dtype), v,
-                                 (((1,), (0,)), ((), ())),
+                                 (v_dims, ((), ())),
                                  preferred_element_type=jnp.float32)
         return m_new, l, acc * alpha + pv
 
@@ -465,13 +527,15 @@ def _prefill_kernel(bt_ref, len_ref, start_ref, count_ref,   # scalar prefetch
 def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
                           q_counts, *, scale: Optional[float] = None,
                           alibi_slopes=None, window=None,
-                          interpret: Optional[bool] = None, mesh=None):
+                          interpret: Optional[bool] = None, mesh=None,
+                          kv_major=False):
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[2] % mesh.shape["tp"] == 0):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
         inner = functools.partial(_pallas_ragged_prefill_local, scale=scale,
-                                  window=window, interpret=interpret)
+                                  window=window, interpret=interpret,
+                                  kv_major=kv_major)
         q_spec = P(None, None, "tp", None, None)
         kv_spec = P(None, "tp", None, None)
         in_specs = [q_spec, kv_spec, kv_spec, P(None, None), P(None),
@@ -492,7 +556,7 @@ def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
     return _pallas_ragged_prefill_local(
         q, k_pages, v_pages, block_table, kv_lens, q_starts, q_counts,
         scale=scale, alibi_slopes=alibi_slopes, window=window,
-        interpret=interpret)
+        interpret=interpret, kv_major=kv_major)
 
 
 def _prefill_chunk(Q: int) -> Optional[int]:
@@ -506,9 +570,10 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
                                  q_starts, q_counts, *,
                                  scale: Optional[float] = None,
                                  alibi_slopes=None, window=None,
-                                 interpret: Optional[bool] = None):
+                                 interpret: Optional[bool] = None,
+                                 kv_major=False):
     S, Q, nkv, g, hd = q.shape
-    NB, _, bs, _ = k_pages.shape
+    bs = k_pages.shape[3] if kv_major else k_pages.shape[2]
     if scale is None:
         scale = hd ** -0.5
     if interpret is None:
@@ -524,30 +589,31 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
     kernel = functools.partial(
         _prefill_kernel, bs=bs, cq=cq, g=g, scale=float(scale),
         window=int(window) if window is not None else None,
-        has_alibi=has_alibi)
+        has_alibi=has_alibi, kv_major=kv_major)
+    n_prefetch = 4
+    prefetch = [block_table, kv_lens, q_starts, q_counts]
+    if has_alibi:
+        n_prefetch = 5
+        prefetch.append(jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            nkv, g))
     in_specs = [
         pl.BlockSpec((1, cq, 1, g, hd),
                      lambda s, h, c, *_: (s, c, h, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
     ]
-    inputs = [q]
-    if has_alibi:
-        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(nkv, g)
-        in_specs.append(pl.BlockSpec((1, g), lambda s, h, c, *_: (h, 0)))
-        inputs.append(slopes)
-    in_specs += [pl.BlockSpec(memory_space=pl.ANY),
-                 pl.BlockSpec(memory_space=pl.ANY)]
-    inputs += [k_pages, v_pages]
+    buf_shape = (2, hd, bs) if kv_major else (2, bs, hd)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=n_prefetch,
             grid=grid,
             in_specs=in_specs,
             out_specs=pl.BlockSpec((1, cq, 1, g, hd),
                                    lambda s, h, c, *_: (s, c, h, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((2, bs, hd), k_pages.dtype),
-                pltpu.VMEM((2, bs, hd), v_pages.dtype),
+                pltpu.VMEM(buf_shape, k_pages.dtype),
+                pltpu.VMEM(buf_shape, v_pages.dtype),
                 pltpu.SemaphoreType.DMA((4,)),
             ],
         ),
@@ -555,23 +621,26 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_table, kv_lens, q_starts, q_counts, *inputs)
+    )(*prefetch, q, k_pages, v_pages)
     return out
 
 
 def ragged_prefill_supported(q, k_pages, v_pages, block_table, kv_lens,
                              q_starts, q_counts, *, scale=None,
                              alibi_slopes=None, window=None, interpret=None,
-                             mesh=None):
+                             mesh=None, kv_major=False):
     if q.ndim != 5 or k_pages.ndim != 4:
         return False
     S, Q, nkv, g, hd = q.shape
-    NB, nkv2, bs, hd2 = k_pages.shape
+    if kv_major:
+        NB, nkv2, hd2, bs = k_pages.shape
+    else:
+        NB, nkv2, bs, hd2 = k_pages.shape
     if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
         return False
     if window is not None and int(window) <= 0:
         return False
-    return (nkv == nkv2 and hd == hd2 and hd % 8 == 0 and bs % 8 == 0
+    return (nkv == nkv2 and hd == hd2 and _dma_layout_ok(hd, bs, kv_major)
             and _prefill_chunk(Q) is not None
             and block_table.ndim == 2 and block_table.shape[0] == S)
 
@@ -581,10 +650,11 @@ def ragged_prefill_attention(q, k_pages, v_pages, block_table, kv_lens,
                              scale: Optional[float] = None,
                              alibi_slopes=None, window=None,
                              impl: Optional[str] = None,
-                             interpret: Optional[bool] = None, mesh=None):
+                             interpret: Optional[bool] = None, mesh=None,
+                             kv_major=False):
     """Registry entry for the ragged prefill kernel."""
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("ragged_prefill_attention", q, k_pages, v_pages,
                     block_table, kv_lens, q_starts, q_counts, scale=scale,
                     alibi_slopes=alibi_slopes, window=window, impl=impl,
-                    interpret=interpret, mesh=mesh)
+                    interpret=interpret, mesh=mesh, kv_major=kv_major)

@@ -80,6 +80,16 @@ def dispatch(name: str, *args, impl: Optional[str] = None, **kwargs) -> Any:
     return spec.xla(*args, **kwargs)
 
 
+def would_use_pallas(name: str) -> bool:
+    """True when dispatch(name, ...) would consider the Pallas path at all
+    (before the per-call shape predicate).  Engines that must pre-commit a
+    layout/shape choice to satisfy a kernel's constraints (e.g. the v2
+    engine's kv page size) ask HERE instead of re-deriving the gate."""
+    spec = _REGISTRY.get(name)
+    return (spec is not None and spec.pallas is not None
+            and pallas_enabled() and _on_tpu())
+
+
 def op_report() -> str:
     """``ds_report``-style op compatibility matrix (reference env_report.py)."""
     lines = ["op name".ljust(28) + "impls".ljust(16) + "selected"]

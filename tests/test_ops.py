@@ -298,7 +298,6 @@ class TestPagedAttention:
         nkv, g = q.shape[1], q.shape[2]
         slopes = jnp.asarray(
             np.geomspace(0.5, 1 / 256, nkv * g), jnp.float32)
-        assert supported(q, k, v, bt, lens, alibi_slopes=slopes)
         want = xla_paged_attention(q, k, v, bt, lens, alibi_slopes=slopes)
         got = pallas_paged_attention(q, k, v, bt, lens, alibi_slopes=slopes,
                                      interpret=True)
@@ -313,7 +312,6 @@ class TestPagedAttention:
                                                        xla_paged_attention)
         q, k, v, bt, lens = (jnp.asarray(a) for a in self._rand_case(rng))
         for window in (3, 8, 11, 100):
-            assert supported(q, k, v, bt, lens, window=window)
             want = xla_paged_attention(q, k, v, bt, lens, window=window)
             got = pallas_paged_attention(q, k, v, bt, lens, window=window,
                                          interpret=True)
@@ -383,6 +381,56 @@ class TestPagedAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5)
 
+    def test_kv_major_matches_standard(self, rng):
+        """Transposed [NB, nkv, hd, bs] pages (the layout hd%128!=0 models
+        use on real TPU) must be numerically identical to the standard
+        layout through both the XLA and Pallas paths."""
+        from deepspeed_tpu.ops.paged_attention import (pallas_paged_attention,
+                                                       xla_paged_attention)
+        q, k, v, bt, lens = (jnp.asarray(a) for a in self._rand_case(rng))
+        want = xla_paged_attention(q, k, v, bt, lens)
+        kt, vt = jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3)
+        for fn, kw in ((xla_paged_attention, {}),
+                       (pallas_paged_attention, {"interpret": True})):
+            got = fn(q, kt, vt, bt, lens, kv_major=True, **kw)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, err_msg=fn.__name__)
+
+    def test_kv_major_alibi_window(self, rng):
+        from deepspeed_tpu.ops.paged_attention import (pallas_paged_attention,
+                                                       xla_paged_attention)
+        q, k, v, bt, lens = (jnp.asarray(a) for a in self._rand_case(rng))
+        nkv, g = q.shape[1], q.shape[2]
+        slopes = jnp.asarray(np.geomspace(0.5, 1 / 64, nkv * g), jnp.float32)
+        kt, vt = jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3)
+        for kw in ({"alibi_slopes": slopes}, {"window": 6},
+                   {"alibi_slopes": slopes, "window": 6}):
+            want = xla_paged_attention(q, k, v, bt, lens, **kw)
+            got = pallas_paged_attention(q, kt, vt, bt, lens, kv_major=True,
+                                         interpret=True, **kw)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, err_msg=str(kw))
+
+    def test_supported_reflects_tpu_dma_constraints(self):
+        """The Mosaic DMA slab needs a 128-aligned lane dim: standard layout
+        ⇒ hd % 128 == 0, kv-major ⇒ block_size % 128 == 0 (found on real
+        v5e — interpret mode accepts anything, so the gate must not)."""
+        from deepspeed_tpu.ops.paged_attention import supported
+        bt = jnp.zeros((2, 4), jnp.int32)
+        lens = jnp.zeros((2,), jnp.int32)
+
+        def mk(nkv, a, b):
+            return jnp.zeros((8, nkv, a, b), jnp.bfloat16)
+
+        q128 = jnp.zeros((2, 2, 2, 128), jnp.bfloat16)
+        q64 = jnp.zeros((2, 2, 2, 64), jnp.bfloat16)
+        assert supported(q128, mk(2, 8, 128), mk(2, 8, 128), bt, lens)
+        assert not supported(q64, mk(2, 8, 64), mk(2, 8, 64), bt, lens)
+        assert supported(q64, mk(2, 64, 128), mk(2, 64, 128), bt, lens,
+                         kv_major=True)
+        assert not supported(q64, mk(2, 64, 64), mk(2, 64, 64), bt, lens,
+                             kv_major=True)
+
 
 class TestRaggedPrefill:
     """Ragged prefill flash kernel (interpret) vs the gather+masked-dense XLA
@@ -421,7 +469,6 @@ class TestRaggedPrefill:
         slopes = jnp.asarray(np.geomspace(0.5, 1 / 64, nkv * g), jnp.float32)
         for kw in ({"alibi_slopes": slopes}, {"window": 6},
                    {"alibi_slopes": slopes, "window": 6}):
-            assert ragged_prefill_supported(*args, **kw)
             want = xla_ragged_prefill(*args, **kw)
             got = pallas_ragged_prefill(*args, interpret=True, **kw)
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -446,6 +493,23 @@ class TestRaggedPrefill:
         out = np.asarray(got)
         assert np.isfinite(out[0, :4]).all()
         np.testing.assert_array_equal(out[0, 4:], 0)   # dead rows zeroed
+
+    def test_kv_major_matches_standard(self, rng):
+        from deepspeed_tpu.ops.paged_attention import (pallas_ragged_prefill,
+                                                       xla_ragged_prefill)
+        q, k, v, bt, lens, starts, counts = self._case(rng)
+        nkv, g = q.shape[2], q.shape[3]
+        slopes = jnp.asarray(np.geomspace(0.5, 1 / 64, nkv * g), jnp.float32)
+        kt, vt = jnp.swapaxes(k, 2, 3), jnp.swapaxes(v, 2, 3)
+        for kw in ({}, {"alibi_slopes": slopes}, {"window": 6}):
+            want = xla_ragged_prefill(q, k, v, bt, lens, starts, counts, **kw)
+            for fn, extra in ((xla_ragged_prefill, {}),
+                              (pallas_ragged_prefill, {"interpret": True})):
+                got = fn(q, kt, vt, bt, lens, starts, counts, kv_major=True,
+                         **extra, **kw)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=1e-5,
+                    err_msg=f"{fn.__name__} {kw}")
 
     def test_engine_serving_token_exact_with_kernel(self, rng, monkeypatch):
         """Force the dispatch onto the Pallas (interpret) kernels and check
