@@ -117,16 +117,19 @@ def _extra_points(GPTChunkedLoss, GPTConfig, initialize):
 
 
 def _serving_point():
-    """FastGen-analog serving leg (compact form of bench_serving.py): ragged
-    continuous-batching generate tokens/s and its ratio over the static v1
-    baseline on the same weights."""
+    """FastGen-analog serving leg (compact form of bench_serving.py):
+    effective throughput over an oversubscribed heterogeneous workload
+    (mixed prompt lengths AND per-request completion budgets — the workload
+    shape continuous batching exists for), ragged v2 vs the static-batching
+    v1 baseline on the same weights."""
     import dataclasses
 
     import numpy as np
     out = {}
     try:
         import jax.numpy as jnp
-        from bench_serving import run_v1, run_v2
+        import bench_serving
+        from bench_serving import make_workload, run_v1, run_v2
         from deepspeed_tpu.models import GPTConfig
         cfg = GPTConfig.llama(num_layers=12, hidden=1024, heads=16,
                               num_kv_heads=4, vocab_size=32000,
@@ -138,11 +141,11 @@ def _serving_point():
             "max_tracked_sequences": 4, "kv_block_size": 64}}, seed=0)
         params = seed_eng.params
         del seed_eng
-        prompts = [rng.integers(0, cfg.vocab_size,
-                                size=int(rng.integers(32, 513))
-                                ).astype(np.int32) for _ in range(16)]
-        v2_tps = run_v2(cfg, params, prompts, 64)
-        v1_tps, _ = run_v1(cfg, params, prompts, 64)
+        # 2 static batches keeps the leg inside the bench attempt timeout
+        prompts, budgets = make_workload(rng, cfg,
+                                         nreq=2 * bench_serving.SLOTS)
+        v2_tps = run_v2(cfg, params, prompts, budgets)
+        v1_tps = run_v1(cfg, params, prompts, budgets)
         out["serving_ragged_tokens_per_sec"] = round(v2_tps, 1)
         out["serving_static_tokens_per_sec"] = round(v1_tps, 1)
         out["serving_ragged_vs_static"] = round(v2_tps / v1_tps, 3)

@@ -29,7 +29,9 @@ from deepspeed_tpu.inference.config import (GenerationConfig, _DTYPE_ALIASES)
 from deepspeed_tpu.inference.v2.model import (PagedKVCache,
                                               ragged_decode_burst,
                                               ragged_decode_forward,
-                                              ragged_forward)
+                                              ragged_decode_sampled,
+                                              ragged_forward,
+                                              ragged_forward_sampled)
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager, RaggedBatch,
                                                build_ragged_batch)
 from deepspeed_tpu.utils.logging import log_dist
@@ -86,11 +88,22 @@ class _Request:
     uid: int
     prompt: np.ndarray
     max_new_tokens: int
+    # host-materialized tokens (filled from the device records at sync points)
     generated: List[int] = dataclasses.field(default_factory=list)
-    next_token: Optional[int] = None      # sampled, waiting to be decoded
+    # tokens sampled ON DEVICE so far — the host schedules off this count and
+    # only learns the VALUES at materialize time (device-resident feedback)
+    sampled: int = 0
+    # prefill complete: the next input token comes from device feedback
+    decode_ready: bool = False
+    # host-known continuation token (set after a preemption materialize; feeds
+    # the first post-resume decode from the host instead of device feedback)
+    held_token: Optional[int] = None
     done: bool = False
+    # EOS was discovered at a materialize point (values are only inspected
+    # there; post-EOS overshoot tokens are discarded)
+    eos_hit: bool = False
     # set while re-prefilling after preemption: the completion logits must NOT
-    # be sampled (the continuation token is already held in next_token)
+    # be sampled (the continuation token is already held in held_token)
     resume: bool = False
     # how many generated tokens have been folded into .prompt by preemptions
     folded: int = 0
@@ -205,7 +218,9 @@ class InferenceEngineV2:
         # shape analog of the reference's atom decomposition (atom_builder);
         # buckets are powers of two so the compile cache stays small
         self._steps: Dict[Any, Any] = {}
-        self._sampler_cache: Dict[Any, Any] = {}
+        # recompute-preemption observability: how many victims were taken in
+        # steady decode vs mid-(re-)prefill (the latter must keep fold state)
+        self.preempt_stats = {"decode_ready": 0, "mid_prefill": 0}
         self._block_size = eff_bs
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(self.params))
@@ -280,6 +295,21 @@ class InferenceEngineV2:
             seq.seen_tokens += len(toks)
         return logits
 
+    def _buckets(self, rb: RaggedBatch):
+        """Power-of-two compile buckets, shared by the logits (_run) and
+        sampled (_step_sampled) paths so both compile identical program
+        shapes for the same schedule: ``mb`` bounds the block-table WIDTH by
+        the longest live KV, and ``nb`` slices the packed token arrays to the
+        width covering the live tokens — a small step (one admission chunk
+        between decode bursts) must not pay a forward padded to the full
+        ragged budget.  ≤ log2(MB) × log2(budget) compiled programs total."""
+        mb_full = rb.block_table.shape[1]
+        mb_used = max(1, -(-int(rb.kv_len.max()) // self._block_size))
+        mb = min(1 << (mb_used - 1).bit_length(), mb_full)
+        nb = min(max(64, 1 << (max(1, rb.total_tokens) - 1).bit_length()),
+                 rb.tokens.shape[0])
+        return mb, nb
+
     def _run(self, rb: RaggedBatch) -> "jax.Array":
         # small set of compiled programs: a decode-only step (Q=1, Pallas
         # paged attention — the steady-state hot path, ragged_decode_forward)
@@ -292,9 +322,7 @@ class InferenceEngineV2:
         sm = self.config.state_manager
         if int(rb.q_len.max()) <= 1:
             return self._run_decode(rb)
-        mb_full = rb.block_table.shape[1]
-        mb_used = max(1, -(-int(rb.kv_len.max()) // self._block_size))
-        mb = min(1 << (mb_used - 1).bit_length(), mb_full)
+        mb, nb = self._buckets(rb)
         key = ("mixed", sm.max_q_per_seq, mb)
         if key not in self._steps:
             self._steps[key] = jax.jit(
@@ -303,9 +331,9 @@ class InferenceEngineV2:
                                   max_q_per_seq=sm.max_q_per_seq,
                                   mesh=self.mesh),
                 donate_argnums=(1,))
-        batch = {"tokens": rb.tokens, "token_slot": rb.token_slot,
-                 "token_pos": rb.token_pos,
-                 "token_dense_idx": rb.token_dense_idx,
+        batch = {"tokens": rb.tokens[:nb], "token_slot": rb.token_slot[:nb],
+                 "token_pos": rb.token_pos[:nb],
+                 "token_dense_idx": rb.token_dense_idx[:nb],
                  "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len}
         batch = jax.tree_util.tree_map(jnp.asarray, batch)
         logits, self.cache = self._steps[key](self.params, self.cache, batch)
@@ -335,12 +363,21 @@ class InferenceEngineV2:
         logits, self.cache = self._steps[key](self.params, self.cache, batch)
         return logits
 
-    def _run_burst(self, reqs, steps: int, gen, rng) -> np.ndarray:
+    def _sample_fn(self, gen):
+        from deepspeed_tpu.inference.engine import _sample_token
+        return functools.partial(_sample_token, do_sample=gen.do_sample,
+                                 top_k=gen.top_k)
+
+    def _run_burst(self, reqs, steps: int, gen, prev, rng):
         """Fused T-step decode over the running set: one device dispatch for
-        ``steps`` tokens per sequence (see model.ragged_decode_burst).  Blocks
-        for all T positions are pre-allocated; returns tokens [T, S]."""
+        ``steps`` tokens per sequence (see model.ragged_decode_burst).  Each
+        req's first-step token comes from ``held_token`` (host, post-preempt)
+        or from the ``prev`` device feedback vector.  Blocks for all T
+        positions are pre-allocated.  Returns (tokens [T, S] DEVICE array,
+        prev', rng') — no host sync."""
         S = self.state.max_tracked_sequences
         tokens0 = np.zeros(S, np.int32)
+        from_device = np.zeros(S, bool)
         active = np.zeros(S, bool)
         pos0 = np.zeros(S, np.int32)
         block_table = np.zeros((S, self.state.max_blocks_per_seq), np.int32)
@@ -348,30 +385,110 @@ class InferenceEngineV2:
             seq = self.state.get(r.uid)
             self.state.ensure_blocks(seq, steps)
             sl = seq.slot
-            tokens0[sl] = r.next_token
+            if r.held_token is not None:
+                tokens0[sl] = r.held_token
+                r.held_token = None
+            else:
+                from_device[sl] = True
             active[sl] = True
             pos0[sl] = seq.seen_tokens
             bl = np.asarray(seq.blocks, np.int32)
             block_table[sl, :len(bl)] = bl
         key = ("burst", steps, gen.do_sample, gen.top_k)
         if key not in self._steps:
-            from deepspeed_tpu.inference.engine import _sample_token
-            sample_fn = functools.partial(
-                _sample_token, do_sample=gen.do_sample, top_k=gen.top_k)
             self._steps[key] = jax.jit(
                 functools.partial(ragged_decode_burst, cfg=self.model_config,
                                   block_size=self._block_size, steps=steps,
-                                  sample_fn=sample_fn, mesh=self.mesh),
+                                  sample_fn=self._sample_fn(gen),
+                                  mesh=self.mesh),
                 donate_argnums=(1,))
         batch = jax.tree_util.tree_map(jnp.asarray, {
-            "tokens0": tokens0, "active": active, "pos0": pos0,
-            "block_table": block_table})
-        toks, self.cache = self._steps[key](
-            self.params, self.cache, batch, rng,
+            "tokens0": tokens0, "from_device": from_device, "active": active,
+            "pos0": pos0, "block_table": block_table})
+        toks, prev, rng, self.cache = self._steps[key](
+            self.params, self.cache, batch, prev, rng,
             jnp.float32(gen.temperature), jnp.float32(gen.top_p))
         for r in reqs:
             self.state.get(r.uid).seen_tokens += steps
-        return np.asarray(toks)
+        return toks, prev, rng
+
+    def _step_sampled(self, uids, toks_np, from_device, served_slots, gen,
+                      prev, rng):
+        """One scheduled step through the SAMPLED programs: same schedule
+        construction as _put_device but with in-graph sampling and device
+        token feedback — returns (prev', rng'), never touching the host.
+        ``from_device`` marks tokens whose VALUE lives in prev[slot] (their
+        host entry is a placeholder); ``served_slots`` are the slots whose
+        freshly sampled token must be written into prev'."""
+        sm = self.config.state_manager
+        S = self.state.max_tracked_sequences
+        schedule = []
+        for uid, toks in zip(uids, toks_np):
+            seq = self.state.get(uid) or self.state.create(uid)
+            self.state.ensure_blocks(seq, len(toks))
+            schedule.append((seq, toks))
+        served = np.zeros(S, bool)
+        served[list(served_slots)] = True
+        if max(len(t) for t in toks_np) <= 1:
+            # decode-only: slot-indexed [S] program
+            tokens = np.zeros(S, np.int32)
+            active = np.zeros(S, bool)
+            token_pos = np.zeros(S, np.int32)
+            fdev = np.zeros(S, bool)
+            block_table = np.zeros((S, self.state.max_blocks_per_seq),
+                                   np.int32)
+            for (seq, toks), fd in zip(schedule, from_device):
+                sl = seq.slot
+                tokens[sl] = toks[0]
+                active[sl] = True
+                fdev[sl] = fd
+                token_pos[sl] = seq.seen_tokens
+                bl = np.asarray(seq.blocks, np.int32)
+                block_table[sl, :len(bl)] = bl
+            key = ("decode_s", gen.do_sample, gen.top_k)
+            if key not in self._steps:
+                self._steps[key] = jax.jit(
+                    functools.partial(ragged_decode_sampled,
+                                      cfg=self.model_config,
+                                      block_size=self._block_size,
+                                      sample_fn=self._sample_fn(gen),
+                                      mesh=self.mesh),
+                    donate_argnums=(1,))
+            batch = jax.tree_util.tree_map(jnp.asarray, {
+                "tokens": tokens, "active": active, "token_pos": token_pos,
+                "block_table": block_table, "from_device": fdev,
+                "served": served})
+        else:
+            rb = build_ragged_batch(schedule, self.state,
+                                    sm.max_ragged_batch_size, sm.max_q_per_seq)
+            fdev = np.zeros(rb.tokens.shape[0], bool)
+            i = 0
+            for (seq, toks), fd in zip(schedule, from_device):
+                fdev[i:i + len(toks)] = fd
+                i += len(toks)
+            mb, nb = self._buckets(rb)
+            key = ("mixed_s", sm.max_q_per_seq, mb, gen.do_sample, gen.top_k)
+            if key not in self._steps:
+                self._steps[key] = jax.jit(
+                    functools.partial(ragged_forward_sampled,
+                                      cfg=self.model_config,
+                                      block_size=self._block_size,
+                                      max_q_per_seq=sm.max_q_per_seq,
+                                      sample_fn=self._sample_fn(gen),
+                                      mesh=self.mesh),
+                    donate_argnums=(1,))
+            batch = jax.tree_util.tree_map(jnp.asarray, {
+                "tokens": rb.tokens[:nb], "token_slot": rb.token_slot[:nb],
+                "token_pos": rb.token_pos[:nb],
+                "token_dense_idx": rb.token_dense_idx[:nb],
+                "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len,
+                "from_device": fdev[:nb], "served": served})
+        prev, rng, self.cache = self._steps[key](
+            self.params, self.cache, batch, prev, rng,
+            jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+        for seq, toks in schedule:
+            seq.seen_tokens += len(toks)
+        return prev, rng
 
     # ----------------------------------------- reference query()/can_schedule
     def query(self) -> Dict[str, int]:
@@ -410,37 +527,52 @@ class InferenceEngineV2:
             self.state.flush(uid)
 
     # ------------------------------- continuous batching (Dynamic SplitFuse)
-    def _sampler(self, do_sample: bool, top_k: int):
-        key = (do_sample, top_k)
-        if key not in self._sampler_cache:
-            from deepspeed_tpu.inference.engine import _sample_token
-            self._sampler_cache[key] = jax.jit(functools.partial(
-                _sample_token, do_sample=do_sample, top_k=top_k))
-        return self._sampler_cache[key]
-
-    def generate(self, prompts: Sequence[np.ndarray], max_new_tokens: int = 32,
-                 seed: int = 0, **gen_overrides) -> List[np.ndarray]:
+    def generate(self, prompts: Sequence[np.ndarray],
+                 max_new_tokens=32, seed: int = 0,
+                 **gen_overrides) -> List[np.ndarray]:
         """Serve a set of prompts to completion with continuous batching.
 
         Dynamic SplitFuse (reference blogs/deepspeed-fastgen): every step first
         schedules 1 token for each running decode, then fills the remaining
         token budget with prompt chunks (long prompts split across steps);
         new requests are admitted as slots/blocks free up.
+
+        The token feedback loop is DEVICE-RESIDENT: every step program samples
+        in-graph and the next step reads its input tokens from the previous
+        step's on-device output (model.ragged_forward_sampled /
+        ragged_decode_sampled / ragged_decode_burst), so steady state chains
+        async dispatches with no host sync.  Token VALUES are materialized in
+        bulk — once at the end when no eos_token_id is set, else every
+        ``sync_interval`` steps (sequences may overshoot their EOS by up to
+        that many tokens plus at most one smallest-size burst; the extras are
+        discarded at materialize time — bounded discarded decode work traded
+        for eliminating per-step host round trips, which dominate on a
+        high-latency host↔device link).
+
+        max_new_tokens: int, or one int per prompt (heterogeneous completion
+        budgets — the FastGen effective-throughput workload shape).
         """
         gen = self.config.generation.model_copy(update=gen_overrides)
         sm = self.config.state_manager
-        rng_key = jax.random.PRNGKey(seed)
-        sampler = self._sampler(gen.do_sample, gen.top_k)
+        S = self.state.max_tracked_sequences
+        if isinstance(max_new_tokens, (int, np.integer)):
+            max_list = [int(max_new_tokens)] * len(prompts)
+        else:
+            max_list = [int(m) for m in max_new_tokens]
+            if len(max_list) != len(prompts):
+                raise ValueError("max_new_tokens list must match prompts")
         waiting = [
             _Request(uid=-(i + 1), prompt=np.asarray(p, np.int32).reshape(-1),
-                     max_new_tokens=max_new_tokens)
-            for i, p in enumerate(prompts)]
+                     max_new_tokens=m)
+            for i, (p, m) in enumerate(zip(prompts, max_list))]
         pool_blocks = self.state.allocator.num_blocks
         for r in waiting:
-            if len(r.prompt) + max_new_tokens > self.model_config.max_seq_len:
-                raise ValueError(f"prompt {len(r.prompt)} + {max_new_tokens} "
-                                 f"exceeds max_seq_len")
-            need = -(-(len(r.prompt) + max_new_tokens) // self.state.block_size)
+            if (len(r.prompt) + r.max_new_tokens
+                    > self.model_config.max_seq_len):
+                raise ValueError(f"prompt {len(r.prompt)} + "
+                                 f"{r.max_new_tokens} exceeds max_seq_len")
+            need = -(-(len(r.prompt) + r.max_new_tokens)
+                     // self.state.block_size)
             if need > pool_blocks:
                 raise ValueError(
                     f"request needs {need} KV blocks for its full context but "
@@ -449,23 +581,85 @@ class InferenceEngineV2:
         running: List[_Request] = []
         results: Dict[int, _Request] = {r.uid: r for r in waiting}
 
+        eos = gen.eos_token_id
+        sync_interval = 16 if eos is not None else None
+        prev = jnp.zeros(S, jnp.int32)          # device feedback vector
+        rng = jax.random.PRNGKey(seed)          # device-resident, threaded
+        # device records: ("step", arr [S], [(uid, slot)]) or
+        # ("burst", arr [T, S], [(uid, slot)], T) — fetched in ONE transfer
+        records: List[tuple] = []
+        steps_since_sync = 0
+
+        def _append(r: _Request, toks) -> None:
+            for tok in toks:
+                if r.eos_hit or len(r.generated) >= r.max_new_tokens:
+                    return                      # discard overshoot
+                r.generated.append(int(tok))
+                if eos is not None and int(tok) == eos:
+                    r.eos_hit = True
+                    r.done = True
+
+        def materialize() -> None:
+            """Fetch every pending device record (one sync), fill
+            .generated, and retire sequences whose EOS was discovered."""
+            nonlocal steps_since_sync
+            steps_since_sync = 0
+            if not records:
+                return
+            arrs = jax.device_get([rec[1] for rec in records])
+            for rec, arr in zip(records, arrs):
+                if rec[0] == "step":
+                    for uid, sl in rec[2]:
+                        _append(results[uid], [arr[sl]])
+                else:
+                    for uid, sl in rec[2]:
+                        _append(results[uid], arr[:, sl])
+            records.clear()
+            for r in list(running):
+                if r.done:                      # EOS found on materialize
+                    self.flush([r.uid])
+                    running.remove(r)
+
         burst_sizes = (64, 32, 16, 8)
         while waiting or running:
             # ---- decode-burst fast path: every running sequence is in pure
-            # decode and nothing is waiting -> fuse T steps into one dispatch
-            if (not waiting and running
-                    and all(r.next_token is not None and not r.done
-                            for r in running)
+            # decode and no slot is admittable -> fuse T steps into one
+            # dispatch.  With requests WAITING the burst targets the earliest
+            # retirement (free a slot, then admit); otherwise it covers the
+            # longest remaining budget (finish everyone).  Sequences that
+            # finish mid-burst cost nothing extra — the burst computes all
+            # slots every step — and their overshoot tokens are discarded at
+            # materialize.
+            if (running
+                    and (not waiting or self.state.free_sequence_slots == 0)
+                    and all(r.decode_ready and not r.done for r in running)
                     and all(not self.state.get(r.uid).in_flight
                             for r in running)):
-                remaining = min(r.max_new_tokens - len(r.generated)
-                                for r in running)
-                cap = min(remaining,
-                          min(self.model_config.max_seq_len
-                              - self.state.get(r.uid).seen_tokens
-                              for r in running))
+                rem_max = max(r.max_new_tokens - r.sampled for r in running)
+                if waiting:
+                    # earliest retirement frees a slot — but floor the burst
+                    # so retirements CLUMP and the freed slots are refilled by
+                    # one fat admission step instead of one step per slot
+                    rem_min = min(r.max_new_tokens - r.sampled
+                                  for r in running)
+                    need_max = max(rem_min, min(16, rem_max))
+                else:
+                    need_max = rem_max
+                if sync_interval:
+                    # budget the burst against the NEXT materialize point so
+                    # EOS overshoot stays ~sync_interval (plus at most the
+                    # smallest compiled burst), not 2x
+                    need_max = min(need_max,
+                                   max(1, sync_interval - steps_since_sync))
+                cap = min(self.model_config.max_seq_len
+                          - self.state.get(r.uid).seen_tokens
+                          for r in running)
+                target = min(need_max, cap)
+                fitting = [b for b in burst_sizes if b <= cap]
+                covering = [b for b in fitting if b >= target]
+                T = (min(covering) if covering
+                     else (max(fitting) if fitting else 0))
                 # shrink the burst until its block reservation fits the pool
-                T = next((b for b in burst_sizes if b <= cap), 0)
                 while T >= burst_sizes[-1]:
                     need = sum(self.state.get(r.uid).kv_blocks_needed(
                         T, self.state.block_size) for r in running)
@@ -473,41 +667,39 @@ class InferenceEngineV2:
                         break
                     T //= 2
                 if T >= burst_sizes[-1]:
-                    rng_key, sub = jax.random.split(rng_key)
-                    toks = self._run_burst(running, T, gen, sub)  # [T, S]
+                    pairs = [(r.uid, self.state.get(r.uid).slot)
+                             for r in running]
+                    toks, prev, rng = self._run_burst(running, T, gen,
+                                                      prev, rng)
+                    records.append(("burst", toks, pairs, T))
                     for r in list(running):
-                        sl = self.state.get(r.uid).slot
-                        seq_toks = toks[:, sl].tolist()
-                        if gen.eos_token_id is not None and \
-                                gen.eos_token_id in seq_toks:
-                            cut = seq_toks.index(gen.eos_token_id)
-                            r.generated.extend(seq_toks[:cut + 1])
+                        r.sampled += T
+                        if r.sampled >= r.max_new_tokens:
                             r.done = True
-                        else:
-                            r.generated.extend(seq_toks)
-                            r.next_token = seq_toks[-1]
-                            if len(r.generated) >= r.max_new_tokens:
-                                r.done = True
-                        if r.done:
-                            r.next_token = None
                             self.flush([r.uid])
                             running.remove(r)
+                    steps_since_sync += T
+                    if sync_interval and steps_since_sync >= sync_interval:
+                        materialize()
                     continue
 
             budget = sm.max_ragged_batch_size
+            seq_budget = sm.max_ragged_sequence_count   # per-step seq cap
             sched_uids: List[int] = []
             sched_toks: List[np.ndarray] = []
-            want_logits: List[_Request] = []
+            sched_fdev: List[bool] = []
+            served_slots: List[int] = []
+            sampled_now: List[_Request] = []
 
             # 1) running decodes: one token each (decode-priority keeps
             #    latency flat while prompts stream in)
             for r in running:
                 seq = self.state.get(r.uid)
-                # a resumed request may hold next_token while its re-prefill is
-                # still chunked in (in_flight) — its decode must wait
-                if r.done or r.next_token is None or seq.in_flight:
+                # a resumed request may be decode-ready while its re-prefill
+                # is still chunked in (in_flight) — its decode must wait
+                if r.done or not r.decode_ready or seq.in_flight:
                     continue
-                if budget <= 0:
+                if budget <= 0 or len(sched_uids) >= seq_budget:
                     break
                 # reserve the block NOW (allocator state advances with each
                 # reservation, so later checks see the true remaining pool);
@@ -517,14 +709,22 @@ class InferenceEngineV2:
                     continue
                 self.state.ensure_blocks(seq, 1)
                 sched_uids.append(r.uid)
-                sched_toks.append(np.asarray([r.next_token], np.int32))
-                want_logits.append(r)
+                if r.held_token is not None:    # post-preempt continuation
+                    sched_toks.append(np.asarray([r.held_token], np.int32))
+                    sched_fdev.append(False)
+                    r.held_token = None
+                else:                           # device feedback
+                    sched_toks.append(np.zeros(1, np.int32))
+                    sched_fdev.append(True)
+                served_slots.append(seq.slot)
+                sampled_now.append(r)
                 budget -= 1
 
             # 2) prompt chunks fill the rest (running first, then admit new)
             for r in list(running):
                 seq = self.state.get(r.uid)
-                if seq is None or not seq.in_flight or budget <= 0:
+                if (seq is None or not seq.in_flight or budget <= 0
+                        or len(sched_uids) >= seq_budget):
                     continue
                 chunk = min(len(seq.pending), sm.max_q_per_seq, budget)
                 need = seq.kv_blocks_needed(chunk, self.state.block_size)
@@ -534,14 +734,18 @@ class InferenceEngineV2:
                 toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
                 sched_uids.append(r.uid)
                 sched_toks.append(toks)
-                if not seq.in_flight:       # prompt complete -> logits usable
+                sched_fdev.append(False)
+                if not seq.in_flight:       # prompt complete -> decode next
+                    r.decode_ready = True
                     if r.resume:
                         r.resume = False    # continuation token already held
                     else:
-                        want_logits.append(r)
+                        served_slots.append(seq.slot)
+                        sampled_now.append(r)
                 budget -= chunk
 
-            while waiting and budget > 0 and self.state.free_sequence_slots:
+            while (waiting and budget > 0 and self.state.free_sequence_slots
+                   and len(sched_uids) < seq_budget):
                 r = waiting[0]
                 chunk = min(len(r.prompt), sm.max_q_per_seq, budget)
                 if (-(-chunk // self.state.block_size)
@@ -555,32 +759,47 @@ class InferenceEngineV2:
                 toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
                 sched_uids.append(r.uid)
                 sched_toks.append(toks)
+                sched_fdev.append(False)
                 if not seq.in_flight:
+                    r.decode_ready = True
                     if r.resume:
                         r.resume = False
                     else:
-                        want_logits.append(r)
+                        served_slots.append(seq.slot)
+                        sampled_now.append(r)
                 budget -= chunk
 
             if not sched_uids:
-                # KV pool exhausted with everyone mid-generation: preempt the
-                # most recently admitted sequence by RECOMPUTE — free its
-                # blocks and re-queue it with its full context (the vLLM/
-                # FastGen recompute-preemption policy); its re-prefill logits
-                # are not re-sampled (resume flag)
+                # nothing schedulable: first materialize (EOS retirement may
+                # free blocks), then preempt the most recently admitted
+                # sequence by RECOMPUTE — free its blocks and re-queue it with
+                # its full context (the vLLM/FastGen recompute-preemption
+                # policy); its re-prefill logits are not re-sampled (resume)
+                if records:
+                    materialize()
+                    continue
                 if running:
                     victim = running.pop()
-                    # fold generated-but-not-yet-refed tokens into the prompt
-                    # exactly once (folded tracks prior preemptions; the held
-                    # next_token is NOT folded — it replays as a decode)
-                    keep = len(victim.generated) - (
-                        1 if victim.next_token is not None else 0)
-                    new_ctx = victim.generated[victim.folded:keep]
-                    if new_ctx:
-                        victim.prompt = np.concatenate(
-                            [victim.prompt, np.asarray(new_ctx, np.int32)])
-                    victim.folded = keep
-                    victim.resume = victim.next_token is not None
+                    self.preempt_stats["mid_prefill" if not victim.decode_ready
+                                       else "decode_ready"] += 1
+                    if victim.decode_ready:
+                        # fold generated-but-not-yet-refed tokens into the
+                        # prompt exactly once (folded tracks prior
+                        # preemptions; the last sampled token is NOT folded —
+                        # it replays as a decode via held_token)
+                        keep = victim.sampled - 1
+                        new_ctx = victim.generated[victim.folded:keep]
+                        if new_ctx:
+                            victim.prompt = np.concatenate(
+                                [victim.prompt, np.asarray(new_ctx, np.int32)])
+                        victim.folded = keep
+                        victim.resume = True
+                        victim.held_token = victim.generated[keep]
+                        victim.decode_ready = False
+                    # else: preempted mid-(re-)prefill — folded/resume/
+                    # held_token already describe everything sampled; recycle
+                    # the request unchanged (a second fold here would reset
+                    # the state and duplicate the held continuation token)
                     self.state.flush(victim.uid)
                     waiting.insert(0, victim)
                     continue
@@ -588,22 +807,22 @@ class InferenceEngineV2:
                     "scheduler deadlock: the KV pool cannot fit even one "
                     "sequence; raise num_kv_blocks")
 
-            logits_dev = self._put_device(sched_uids, sched_toks)
-            rng_key, sub = jax.random.split(rng_key)
-            slot_tokens = np.asarray(sampler(
-                logits_dev, sub, temperature=jnp.float32(gen.temperature),
-                top_p=jnp.float32(gen.top_p)))          # [S] — 4 bytes/slot
-            for r in want_logits:
-                tok = int(slot_tokens[self.state.get(r.uid).slot])
-                r.generated.append(tok)
-                r.next_token = tok
-                if (len(r.generated) >= r.max_new_tokens
-                        or (gen.eos_token_id is not None
-                            and tok == gen.eos_token_id)):
+            pairs = [(r.uid, self.state.get(r.uid).slot)
+                     for r in sampled_now]
+            prev, rng = self._step_sampled(sched_uids, sched_toks, sched_fdev,
+                                           served_slots, gen, prev, rng)
+            if pairs:
+                records.append(("step", prev, pairs))
+            for r in sampled_now:
+                r.sampled += 1
+                if r.sampled >= r.max_new_tokens:
                     r.done = True
-                    r.next_token = None
                     self.flush([r.uid])
                     running.remove(r)
+            steps_since_sync += 1
+            if sync_interval and steps_since_sync >= sync_interval:
+                materialize()
 
+        materialize()
         return [np.asarray(results[-(i + 1)].generated, np.int32)
                 for i in range(len(prompts))]

@@ -352,7 +352,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     return logits, flat_k_all, flat_v_all
 
 
-def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
+def ragged_decode_burst(params, cache: PagedKVCache, batch, prev_tokens, rng,
                         temperature, top_p,
                         cfg: GPTConfig, *, block_size: int, steps: int,
                         sample_fn, mesh=None):
@@ -361,15 +361,18 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
     burst costs ONE dispatch instead of T× (transfer + step + sample + fetch) —
     the decisive win when the host↔device link has per-call latency.
 
-    batch: tokens0 [S] (first-step tokens), active [S], pos0 [S],
-    block_table [S, MB] — blocks for positions pos0..pos0+T-1 must be
-    pre-allocated.
-    Returns (tokens [T, S], cache).
+    batch: tokens0 [S] (host first-step tokens), from_device [S] (take the
+    first-step token from ``prev_tokens`` instead — the device-resident
+    feedback path, so burst follows burst with no host round trip), active [S],
+    pos0 [S], block_table [S, MB] — blocks for positions pos0..pos0+T-1 must
+    be pre-allocated.
+    Returns (tokens [T, S], prev_tokens' [S], rng', cache).
     """
     flat_k = cache.k.reshape((-1,) + cache.k.shape[2:])
     flat_v = cache.v.reshape((-1,) + cache.v.shape[2:])
     bt = batch["block_table"]
     active = batch["active"]
+    tokens0 = jnp.where(batch["from_device"], prev_tokens, batch["tokens0"])
 
     def step(carry, _):
         flat_k, flat_v, tokens, pos, rng = carry
@@ -378,12 +381,59 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, rng,
             mesh=mesh)
         rng, sub = jax.random.split(rng)
         nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
+        nxt = nxt.astype(jnp.int32)
         return (flat_k, flat_v, nxt, pos + 1, rng), nxt
 
-    carry = (flat_k, flat_v, batch["tokens0"], batch["pos0"], rng)
-    (flat_k, flat_v, *_), toks = jax.lax.scan(step, carry, None, length=steps)
-    return toks, PagedKVCache(k=flat_k.reshape(cache.k.shape),
-                              v=flat_v.reshape(cache.v.shape))
+    carry = (flat_k, flat_v, tokens0, batch["pos0"], rng)
+    (flat_k, flat_v, last, _, rng), toks = jax.lax.scan(
+        step, carry, None, length=steps)
+    prev_out = jnp.where(active, last, prev_tokens)
+    return toks, prev_out, rng, PagedKVCache(k=flat_k.reshape(cache.k.shape),
+                                             v=flat_v.reshape(cache.v.shape))
+
+
+def ragged_forward_sampled(params, cache: PagedKVCache, batch, prev_tokens,
+                           rng, temperature, top_p, cfg: GPTConfig, *,
+                           block_size: int, max_q_per_seq: int, sample_fn,
+                           mesh=None):
+    """Mixed prefill/decode step with in-graph sampling and device-resident
+    token feedback: tokens flagged ``from_device`` are read from
+    ``prev_tokens[slot]`` (the previous step's on-device samples) instead of
+    the host batch, and slots flagged ``served`` get their freshly sampled
+    token written into the returned ``prev_tokens``.  The [S, vocab] logits
+    therefore never leave the device — generate() chains these dispatches
+    without a single host sync (the FastGen hot loop re-shaped for a
+    high-latency host↔device link).
+    Returns (prev_tokens' [S], rng', cache)."""
+    tokens = jnp.where(batch["from_device"],
+                       prev_tokens[jnp.clip(batch["token_slot"], 0)],
+                       batch["tokens"])
+    logits, cache = ragged_forward(
+        params, cache, {**batch, "tokens": tokens}, cfg,
+        block_size=block_size, max_q_per_seq=max_q_per_seq, mesh=mesh)
+    rng, sub = jax.random.split(rng)
+    nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
+    prev_out = jnp.where(batch["served"], nxt.astype(jnp.int32), prev_tokens)
+    return prev_out, rng, cache
+
+
+def ragged_decode_sampled(params, cache: PagedKVCache, batch, prev_tokens,
+                          rng, temperature, top_p, cfg: GPTConfig, *,
+                          block_size: int, sample_fn, mesh=None):
+    """Decode-only step with in-graph sampling + device feedback (see
+    ragged_forward_sampled).  batch tokens/active/token_pos/block_table are
+    slot-indexed [S]; from_device [S] selects prev_tokens as input; served [S]
+    marks the slots whose sample is a real next token (a 1-token mid-prefill
+    chunk is active but NOT served — its logits are mid-prompt garbage).
+    Returns (prev_tokens' [S], rng', cache)."""
+    tokens = jnp.where(batch["from_device"], prev_tokens, batch["tokens"])
+    logits, cache = ragged_decode_forward(
+        params, cache, {**batch, "tokens": tokens}, cfg,
+        block_size=block_size, mesh=mesh)
+    rng, sub = jax.random.split(rng)
+    nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
+    prev_out = jnp.where(batch["served"], nxt.astype(jnp.int32), prev_tokens)
+    return prev_out, rng, cache
 
 
 def ragged_decode_forward(params, cache: PagedKVCache, batch,

@@ -210,6 +210,33 @@ class TestPreemption:
             want = big.generate([p], max_new_tokens=12)[0]
             np.testing.assert_array_equal(want, g)
 
+    def test_repeated_preemption_thrash_roundtrip(self, cfg, rng):
+        """Three requests thrashing a pool that fits ~1.5 of them, with
+        chunked prompts (max_q_per_seq < prompt length) so preemption can
+        strike a victim whose RE-prefill is still in flight — a second
+        preemption must preserve the held continuation token and fold state
+        (double-preemption regression; the fold must never be re-applied)."""
+        mk = lambda nb: InferenceEngineV2(cfg, config={
+            "dtype": "fp32",
+            "state_manager": {"max_tracked_sequences": 4,
+                              "max_ragged_batch_size": 64,
+                              "kv_block_size": 8, "max_q_per_seq": 8,
+                              "num_kv_blocks": nb}}, seed=0)
+        prompts = [rng.integers(0, 97, (18 + 3 * i,)).astype(np.int32)
+                   for i in range(3)]
+        want = [mk(None).generate([p], max_new_tokens=14)[0]
+                for p in prompts]
+        mid_prefill_hits = 0
+        for nb in (6, 7, 8):    # several pressure levels -> several
+            eng = mk(nb)
+            got = eng.generate(prompts, max_new_tokens=14)
+            for w, g in zip(want, got):      # preemption interleavings
+                np.testing.assert_array_equal(w, g)
+            mid_prefill_hits += eng.preempt_stats["mid_prefill"]
+        # the workload must actually strike a victim mid-(re-)prefill, or the
+        # double-preemption fold-preservation path was never exercised
+        assert mid_prefill_hits > 0
+
     def test_single_sequence_too_big_for_pool_raises(self, cfg, rng):
         engine = InferenceEngineV2(cfg, config={
             "dtype": "fp32",
