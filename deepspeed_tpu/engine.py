@@ -143,6 +143,22 @@ class DeepSpeedTPUEngine:
                                    and not self.offloading)
         self.gas = int(config.gradient_accumulation_steps)
 
+        # low-precision mode casts PARAMS, but flax models own their COMPUTE
+        # dtype — fp32 activations silently demote every matmul off the bf16
+        # MXU path (measured ~12 MFU points on GPT-2-small).  Warn when the
+        # model's config disagrees with the precision block.
+        mcfg = getattr(model, "cfg", None)
+        if (mcfg is not None
+                and getattr(mcfg, "dtype", None) == jnp.float32):
+            want = ("bf16" if config.bf16.enabled
+                    else "fp16" if config.fp16.enabled else None)
+            if want:
+                log_dist(
+                    f"WARNING: {want} is enabled but the model computes in "
+                    f"float32 (model cfg.dtype) — matmuls will not hit the "
+                    f"low-precision MXU path.  Set dtype=jnp.{'bfloat16' if want == 'bf16' else 'float16'} "
+                    f"in the model config for full throughput.", ranks=[0])
+
         # ---- model functions ----
         # bind the engine's mesh into mesh-aware models (MoE ep route, Ulysses)
         if (hasattr(model, "clone") and hasattr(model, "mesh")
