@@ -23,7 +23,7 @@ import time
 
 METRIC = "gpt2s_zero2_bf16_train_tokens_per_sec_per_chip"
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
-ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 540))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 900))
 RETRIES = int(os.environ.get("BENCH_RETRIES", 3))
 
 
@@ -216,16 +216,25 @@ def run_bench():
              "params_m": round(engine.num_parameters / 1e6, 1),
              "loss": float(m.loss)}
     del engine
+
+    def emit():
+        print(json.dumps({
+            "metric": METRIC,
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(mfu / 0.35, 4),
+            "extra": extra,
+        }), flush=True)
+
+    # emit the headline number IMMEDIATELY — if a secondary leg hangs past
+    # the attempt timeout, the supervisor salvages this line from the killed
+    # subprocess's partial stdout instead of losing the whole attempt
+    emit()
     if not smoke:
         extra.update(_extra_points(GPTChunkedLoss, GPTConfig,
                                    deepspeed_tpu.initialize))
-    print(json.dumps({
-        "metric": METRIC,
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.35, 4),
-        "extra": extra,
-    }))
+        extra["legs_complete"] = True
+        emit()                 # supervisor keeps the LAST metric line
     return 0
 
 
@@ -272,19 +281,40 @@ def main():
                                 "--run"],
                                timeout=ATTEMPT_TIMEOUT_S, capture_output=True,
                                text=True, cwd=here)
-        except subprocess.TimeoutExpired:
+            out = p.stdout or ""
+        except subprocess.TimeoutExpired as te:
+            # the body prints the headline metric BEFORE the secondary legs —
+            # salvage it from the killed subprocess's partial stdout
+            out = te.stdout or b""
+            out = out.decode() if isinstance(out, bytes) else out
             last_err = f"bench body hung > {ATTEMPT_TIMEOUT_S}s"
-            print(f"bench: attempt {attempt}/{RETRIES}: {last_err}",
-                  file=sys.stderr)
-            continue
-        for line in reversed(p.stdout.strip().splitlines()):
+            print(f"bench: attempt {attempt}/{RETRIES}: {last_err} "
+                  f"(salvaging partial output)", file=sys.stderr)
+            p = None
+        for line in reversed(out.strip().splitlines()):
             try:
                 obj = json.loads(line)
             except (json.JSONDecodeError, ValueError):
                 continue
             if isinstance(obj, dict) and obj.get("metric") == METRIC:
-                print(line)
+                # the early headline emit means a metric line can exist even
+                # when a SECONDARY leg later crashed/hung — keep the headline
+                # but surface the failure instead of silently swallowing it
+                complete = bool(obj.get("extra", {}).get("legs_complete"))
+                failed = p is None or p.returncode != 0
+                if failed and not complete:
+                    reason = (last_err if p is None else
+                              ((p.stderr or "").strip().splitlines()
+                               or [f"rc={p.returncode}"])[-1][:200])
+                    obj.setdefault("extra", {})["secondary_leg_error"] = reason
+                    print(f"bench: headline ok but secondary legs failed: "
+                          f"{reason}", file=sys.stderr)
+                    print(json.dumps(obj))
+                else:
+                    print(line)
                 return 0
+        if p is None:
+            continue                    # timed out with nothing to salvage
         last_err = ((p.stderr.strip().splitlines() or ["no JSON line"])[-1]
                     [:300])
         print(f"bench: attempt {attempt}/{RETRIES} rc={p.returncode}: "

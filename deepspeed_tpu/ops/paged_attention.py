@@ -68,14 +68,30 @@ def _gather_pages(pages, block_table, kv_major):
     return got.reshape(S, -1, nkv, hd)
 
 
+def _gather_scales(scale_pages, block_table):
+    """Gather per-(page, head, token) scales [NB, nkv, bs] for each slot →
+    [S, MB*bs, nkv] (token-major, matching _gather_pages row order)."""
+    got = scale_pages[block_table]         # [S, MB, nkv, bs]
+    S = got.shape[0]
+    got = jnp.swapaxes(got, 2, 3)          # [S, MB, bs, nkv]
+    return got.reshape(S, -1, got.shape[-1])
+
+
+def _dequant_seq(seq, scales, out_dtype):
+    """seq [S, K, nkv, hd] int8 codes × scales [S, K, nkv] → out_dtype."""
+    return (seq.astype(jnp.float32) * scales[..., None]).astype(out_dtype)
+
+
 def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                         scale: Optional[float] = None, alibi_slopes=None,
                         window=None, interpret=None, mesh=None,
-                        kv_major=False):
+                        kv_major=False, k_scale=None, v_scale=None):
     """Ground-truth XLA path: gather this slot's pages, masked softmax.
 
     ``mesh`` is accepted for signature parity with the Pallas path; the XLA
-    body is einsum/gather code the SPMD partitioner shards on its own."""
+    body is einsum/gather code the SPMD partitioner shards on its own.
+    ``k_scale``/``v_scale`` [NB, nkv, bs]: the pages are int8 codes —
+    dequantize after the gather (only the slot's own pages are touched)."""
     S, nkv, g, hd = q.shape
     if kv_major:
         NB, _, _, bs = k_pages.shape
@@ -86,6 +102,11 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
         scale = hd ** -0.5
     k_seq = _gather_pages(k_pages, block_table, kv_major)   # [S, MB*bs, nkv, hd]
     v_seq = _gather_pages(v_pages, block_table, kv_major)
+    if k_scale is not None:
+        k_seq = _dequant_seq(k_seq, _gather_scales(k_scale, block_table),
+                             q.dtype)
+        v_seq = _dequant_seq(v_seq, _gather_scales(v_scale, block_table),
+                             q.dtype)
     kvpos = jnp.arange(MB * bs)
     mask = kvpos[None, :] < kv_lens[:, None]                  # [S, K]
     if window is not None:
@@ -202,11 +223,16 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None,
                            num_kv_splits: Optional[int] = None,
-                           mesh=None, kv_major=False):
+                           mesh=None, kv_major=False,
+                           k_scale=None, v_scale=None):
     """Mesh-aware entry: with a ``tp`` axis the kv-head dim is sharded, and the
     kernel runs per-shard under shard_map (attention is independent per kv
     head, so TP needs no collective here — the reference shards its blocked
     flash the same way, model_implementations/sharding/attn.py)."""
+    if k_scale is not None:
+        raise NotImplementedError(
+            "int8 KV is served by the XLA dequant path; in-kernel dequant is "
+            "tracked follow-up work (supported() gates this off in dispatch)")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[1] % mesh.shape["tp"] == 0):
         from jax import shard_map
@@ -349,7 +375,9 @@ def _dma_layout_ok(hd: int, bs: int, kv_major: bool) -> bool:
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
               alibi_slopes=None, window=None, interpret=None, mesh=None,
-              kv_major=False):
+              kv_major=False, k_scale=None, v_scale=None):
+    if k_scale is not None:     # int8 KV: XLA dequant path (in-kernel
+        return False            # dequant is tracked follow-up work)
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
@@ -370,13 +398,13 @@ def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                     alibi_slopes=None, window=None,
                     impl: Optional[str] = None,
                     interpret: Optional[bool] = None,
-                    mesh=None, kv_major=False):
+                    mesh=None, kv_major=False, k_scale=None, v_scale=None):
     """Registry entry (ops/__init__ registers this like causal_attention)."""
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("paged_attention", q, k_pages, v_pages, block_table,
                     kv_lens, scale=scale, alibi_slopes=alibi_slopes,
                     window=window, impl=impl, interpret=interpret, mesh=mesh,
-                    kv_major=kv_major)
+                    kv_major=kv_major, k_scale=k_scale, v_scale=v_scale)
 
 
 # ===================================================================
@@ -398,8 +426,10 @@ def paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
 def xla_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
                        q_counts, *, scale: Optional[float] = None,
                        alibi_slopes=None, window=None, interpret=None,
-                       mesh=None, kv_major=False):
-    """Ground-truth gather + masked-dense path (the round-2 prefill body)."""
+                       mesh=None, kv_major=False, k_scale=None, v_scale=None):
+    """Ground-truth gather + masked-dense path (the round-2 prefill body).
+    ``k_scale``/``v_scale``: int8-KV dequant after the gather (see
+    xla_paged_attention)."""
     S, Q, nkv, g, hd = q.shape
     if kv_major:
         NB, _, _, bs = k_pages.shape
@@ -410,6 +440,11 @@ def xla_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
         scale = hd ** -0.5
     k_seq = _gather_pages(k_pages, block_table, kv_major)
     v_seq = _gather_pages(v_pages, block_table, kv_major)
+    if k_scale is not None:
+        k_seq = _dequant_seq(k_seq, _gather_scales(k_scale, block_table),
+                             q.dtype)
+        v_seq = _dequant_seq(v_seq, _gather_scales(v_scale, block_table),
+                             q.dtype)
     kvpos = jnp.arange(MB * bs)                                # [K]
     rows = jnp.arange(Q)
     qpos = q_starts[:, None] + rows[None, :]                   # [S, Q]
@@ -528,7 +563,11 @@ def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
                           q_counts, *, scale: Optional[float] = None,
                           alibi_slopes=None, window=None,
                           interpret: Optional[bool] = None, mesh=None,
-                          kv_major=False):
+                          kv_major=False, k_scale=None, v_scale=None):
+    if k_scale is not None:
+        raise NotImplementedError(
+            "int8 KV is served by the XLA dequant path; in-kernel dequant is "
+            "tracked follow-up work (ragged_prefill_supported gates this off)")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[2] % mesh.shape["tp"] == 0):
         from jax import shard_map
@@ -628,7 +667,10 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
 def ragged_prefill_supported(q, k_pages, v_pages, block_table, kv_lens,
                              q_starts, q_counts, *, scale=None,
                              alibi_slopes=None, window=None, interpret=None,
-                             mesh=None, kv_major=False):
+                             mesh=None, kv_major=False,
+                             k_scale=None, v_scale=None):
+    if k_scale is not None:     # int8 KV: XLA dequant path (see supported())
+        return False
     if q.ndim != 5 or k_pages.ndim != 4:
         return False
     S, Q, nkv, g, hd = q.shape
@@ -651,10 +693,11 @@ def ragged_prefill_attention(q, k_pages, v_pages, block_table, kv_lens,
                              alibi_slopes=None, window=None,
                              impl: Optional[str] = None,
                              interpret: Optional[bool] = None, mesh=None,
-                             kv_major=False):
+                             kv_major=False, k_scale=None, v_scale=None):
     """Registry entry for the ragged prefill kernel."""
     from deepspeed_tpu.ops.registry import dispatch
     return dispatch("ragged_prefill_attention", q, k_pages, v_pages,
                     block_table, kv_lens, q_starts, q_counts, scale=scale,
                     alibi_slopes=alibi_slopes, window=window, impl=impl,
-                    interpret=interpret, mesh=mesh, kv_major=kv_major)
+                    interpret=interpret, mesh=mesh, kv_major=kv_major,
+                    k_scale=k_scale, v_scale=v_scale)
