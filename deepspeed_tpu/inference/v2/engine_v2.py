@@ -193,13 +193,26 @@ class InferenceEngineV2:
         # the configured granularity there
         eff_bs = sm.kv_block_size
         if would_use_pallas("paged_attention"):
-            eff_bs = kv_block_size_for(model_cfg, sm.kv_block_size)
+            eff_bs = kv_block_size_for(model_cfg, sm.kv_block_size,
+                                       quant=sm.kv_quant is not None)
         if eff_bs != sm.kv_block_size:
             log_dist(
-                f"kv_block_size {sm.kv_block_size} -> {eff_bs}: head_dim="
-                f"{model_cfg.head_dim} uses the kv-major page layout, whose "
-                f"Pallas DMA needs 128-aligned pages (ops/paged_attention.py)",
-                ranks=[0])
+                f"kv_block_size {sm.kv_block_size} -> {eff_bs}: the "
+                f"kv-major page layout (head_dim={model_cfg.head_dim}) and "
+                f"int8-quantized pages both need 128-aligned pages for the "
+                f"Pallas DMA (ops/paged_attention.py)", ranks=[0])
+        if sm.kv_quant is not None and would_use_pallas("paged_attention"):
+            from deepspeed_tpu.inference.v2.model import kv_major_layout
+            from deepspeed_tpu.ops.paged_attention import _dma_layout_ok
+            if not _dma_layout_ok(model_cfg.head_dim, eff_bs,
+                                  kv_major_layout(model_cfg), quant=True):
+                log_dist(
+                    f"WARNING: kv_quant=int8 with head_dim="
+                    f"{model_cfg.head_dim} cannot use the Pallas decode "
+                    f"kernel (int8 pages tile (32, 128)); decode falls back "
+                    f"to the XLA dequant path, which gathers full page spans "
+                    f"— expect MORE bandwidth than unquantized bf16, not "
+                    f"less", ranks=[0])
         blocks_per_seq = -(-model_cfg.max_seq_len // eff_bs)
         if sm.num_kv_blocks:
             # the user sized the pool in THEIR block units — preserve the

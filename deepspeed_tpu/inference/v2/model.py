@@ -48,9 +48,12 @@ def kv_major_layout(cfg: GPTConfig) -> bool:
     return cfg.head_dim % 128 != 0
 
 
-def kv_block_size_for(cfg: GPTConfig, requested: int) -> int:
-    """Effective page size: kv-major pages need block_size % 128 == 0."""
-    if kv_major_layout(cfg) and requested % 128 != 0:
+def kv_block_size_for(cfg: GPTConfig, requested: int,
+                      quant: bool = False) -> int:
+    """Effective page size: kv-major pages need block_size % 128 == 0, and
+    int8-quantized pages need it in EITHER layout (the per-token scale slab
+    [bs] f32 is DMA'd per page and its lane dim must be 128-aligned)."""
+    if (kv_major_layout(cfg) or quant) and requested % 128 != 0:
         return -(-requested // 128) * 128
     return requested
 

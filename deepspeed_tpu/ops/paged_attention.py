@@ -126,7 +126,8 @@ def xla_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     return jnp.einsum("sngk,sknd->sngd", probs.astype(q.dtype), v_seq)
 
 
-def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major):
+def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major,
+                  quant=False):
     """Flash-decoding-SHAPED kernel (one grid step = one KV split of one
     (slot, kv-head)): the page loop covers only this split's share of the
     slot's live pages and emits UNNORMALIZED partials (acc, m, l) that a
@@ -138,14 +139,31 @@ def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major):
     Alibi slopes ride in SMEM scalar prefetch ([nkv, g] f32): a (1, g)
     VMEM BlockSpec is rejected by Mosaic when nkv > 1 (sublane block of 1
     against an nkv-sized axis), and per-head scalars are SMEM-natured
-    anyway."""
-    if has_alibi:
+    anyway.
+
+    ``quant``: pages are int8 codes and two extra HBM inputs carry the
+    per-(page, head, token) fp32 scales — the page loop DMAs the scale rows
+    alongside the pages (double-buffered the same way) and dequantizes in
+    VMEM right before the dots.  The HBM traffic that decode is bound by is
+    the int8 payload: half the bf16 bytes."""
+    if quant:
+        if has_alibi:
+            bt_ref, len_ref, slopes_ref, q_ref, k_hbm, v_hbm, ks_hbm, \
+                vs_hbm, o_ref, m_ref, l_ref, k_buf, v_buf, ks_buf, vs_buf, \
+                sem = refs
+        else:
+            bt_ref, len_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, \
+                o_ref, m_ref, l_ref, k_buf, v_buf, ks_buf, vs_buf, sem = refs
+            slopes_ref = None
+    elif has_alibi:
         bt_ref, len_ref, slopes_ref, q_ref, k_hbm, v_hbm, \
             o_ref, m_ref, l_ref, k_buf, v_buf, sem = refs
     else:
         bt_ref, len_ref, q_ref, k_hbm, v_hbm, \
             o_ref, m_ref, l_ref, k_buf, v_buf, sem = refs
         slopes_ref = None
+    if not quant:
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
     s, h, sp = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     length = len_ref[s]
     n_pages = (length + bs - 1) // bs
@@ -166,11 +184,16 @@ def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major):
         return pltpu.make_async_copy(
             hbm.at[bt_ref[s, p], h], buf.at[slot], sem.at[way * 2 + slot])
 
+    def start_page(slot, p):
+        dma(k_hbm, k_buf, slot, p, 0).start()
+        dma(v_hbm, v_buf, slot, p, 1).start()
+        if quant:
+            dma(ks_hbm, ks_buf, slot, p, 2).start()
+            dma(vs_hbm, vs_buf, slot, p, 3).start()
+
     @pl.when(p_end > p_start)
     def _warmup():
-        slot0 = jax.lax.rem(p_start, 2)
-        dma(k_hbm, k_buf, slot0, p_start, 0).start()
-        dma(v_hbm, v_buf, slot0, p_start, 1).start()
+        start_page(jax.lax.rem(p_start, 2), p_start)
 
     def body(p, carry):
         m, l, acc = carry
@@ -179,13 +202,22 @@ def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major):
 
         @pl.when(p + 1 < p_end)
         def _prefetch():
-            dma(k_hbm, k_buf, nxt, p + 1, 0).start()
-            dma(v_hbm, v_buf, nxt, p + 1, 1).start()
+            start_page(nxt, p + 1)
 
         dma(k_hbm, k_buf, slot, p, 0).wait()
         dma(v_hbm, v_buf, slot, p, 1).wait()
         k = k_buf[slot]                # [bs, hd] or [hd, bs] (kv-major)
         v = v_buf[slot]
+        if quant:
+            dma(ks_hbm, ks_buf, slot, p, 2).wait()
+            dma(vs_hbm, vs_buf, slot, p, 3).wait()
+            ks, vs = ks_buf[slot], vs_buf[slot]        # [bs] f32
+            if kv_major:               # pages [hd, bs]: token axis on lanes
+                k = (k.astype(jnp.float32) * ks[None, :]).astype(q.dtype)
+                v = (v.astype(jnp.float32) * vs[None, :]).astype(q.dtype)
+            else:                      # pages [bs, hd]: token axis sublanes
+                k = (k.astype(jnp.float32) * ks[:, None]).astype(q.dtype)
+                v = (v.astype(jnp.float32) * vs[:, None]).astype(q.dtype)
         k_dims = ((1,), (0,)) if kv_major else ((1,), (1,))
         scores = jax.lax.dot_general(
             q, k, (k_dims, ((), ())),
@@ -229,10 +261,6 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
     kernel runs per-shard under shard_map (attention is independent per kv
     head, so TP needs no collective here — the reference shards its blocked
     flash the same way, model_implementations/sharding/attn.py)."""
-    if k_scale is not None:
-        raise NotImplementedError(
-            "int8 KV is served by the XLA dequant path; in-kernel dequant is "
-            "tracked follow-up work (supported() gates this off in dispatch)")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[1] % mesh.shape["tp"] == 0):
         from jax import shard_map
@@ -245,14 +273,23 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
         kv_spec = P(None, "tp", None, None)
         in_specs = [kv_spec, kv_spec, kv_spec, P(None, None), P(None)]
         args = [q, k_pages, v_pages, block_table, kv_lens]
+        n_scales = 0
+        if k_scale is not None:        # [NB, nkv, bs]: kv-head axis shards
+            args += [k_scale, v_scale]
+            in_specs += [P(None, "tp", None)] * 2
+            n_scales = 2
         if alibi_slopes is not None:
             # slopes [nkv, g] shard with the kv-head axis
             args.append(jnp.asarray(alibi_slopes, jnp.float32).reshape(
                 q.shape[1], q.shape[2]))
             in_specs.append(P("tp", None))
 
-        def wrapped(q_, k_, v_, bt_, lens_, *sl):
+        def wrapped(q_, k_, v_, bt_, lens_, *rest):
+            sc = rest[:n_scales]
+            sl = rest[n_scales:]
             return inner(q_, k_, v_, bt_, lens_,
+                         k_scale=sc[0] if sc else None,
+                         v_scale=sc[1] if sc else None,
                          alibi_slopes=sl[0] if sl else None)
         return shard_map(
             wrapped, mesh=mesh,
@@ -264,7 +301,8 @@ def pallas_paged_attention(q, k_pages, v_pages, block_table, kv_lens, *,
                                          window=window, scale=scale,
                                          interpret=interpret,
                                          num_kv_splits=num_kv_splits,
-                                         kv_major=kv_major)
+                                         kv_major=kv_major,
+                                         k_scale=k_scale, v_scale=v_scale)
 
 
 def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
@@ -272,7 +310,7 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
                                   scale: Optional[float] = None,
                                   interpret: Optional[bool] = None,
                                   num_kv_splits: Optional[int] = None,
-                                  kv_major=False):
+                                  kv_major=False, k_scale=None, v_scale=None):
     S, nkv, g, hd = q.shape
     if kv_major:
         NB, _, _, bs = k_pages.shape
@@ -297,12 +335,13 @@ def _pallas_paged_attention_local(q, k_pages, v_pages, block_table, kv_lens, *,
         q, k_pages, v_pages, block_table, kv_lens,
         alibi_slopes=alibi_slopes, window=window, scale=float(scale),
         interpret=interpret, num_kv_splits=int(num_kv_splits),
-        kv_major=kv_major)
+        kv_major=kv_major, k_scale=k_scale, v_scale=v_scale)
 
 
 def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
                                   *, alibi_slopes, window, scale, interpret,
-                                  num_kv_splits: int, kv_major: bool):
+                                  num_kv_splits: int, kv_major: bool,
+                                  k_scale=None, v_scale=None):
     """Grid (S, nkv, splits) of unnormalized partials + logsumexp-weighted
     XLA combine (flash-decoding shape).  Inputs arrive NORMALIZED (int32
     tables, float scale) from _pallas_paged_attention_local — the only
@@ -310,10 +349,12 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
     S, nkv, g, hd = q.shape
     bs = k_pages.shape[3] if kv_major else k_pages.shape[2]
     NS = num_kv_splits
+    quant = k_scale is not None
     kernel = functools.partial(
         _split_kernel, bs=bs, scale=float(scale),
         window=int(window) if window is not None else None,
-        has_alibi=alibi_slopes is not None, n_splits=NS, kv_major=kv_major)
+        has_alibi=alibi_slopes is not None, n_splits=NS, kv_major=kv_major,
+        quant=quant)
     n_prefetch = 2
     prefetch = [block_table, kv_lens]
     if alibi_slopes is not None:
@@ -325,7 +366,19 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
+    inputs = [q, k_pages, v_pages]
     buf_shape = (2, hd, bs) if kv_major else (2, bs, hd)
+    scratch = [
+        pltpu.VMEM(buf_shape, k_pages.dtype),
+        pltpu.VMEM(buf_shape, v_pages.dtype),
+    ]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        inputs += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+        scratch += [pltpu.VMEM((2, bs), jnp.float32),
+                    pltpu.VMEM((2, bs), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((8 if quant else 4,)))
     acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -340,11 +393,7 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
                 pl.BlockSpec((1, 1, 1, g),
                              lambda s, h, sp, *_: (s, h, sp, 0)),
             ],
-            scratch_shapes=[
-                pltpu.VMEM(buf_shape, k_pages.dtype),
-                pltpu.VMEM(buf_shape, v_pages.dtype),
-                pltpu.SemaphoreType.DMA((4,)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((S, nkv, NS, g, hd), jnp.float32),
@@ -354,7 +403,7 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(*prefetch, q, k_pages, v_pages)
+    )(*prefetch, *inputs)
     # combine: o = Σ exp(m_s − m*) acc_s / Σ exp(m_s − m*) l_s
     m_star = jnp.max(m, axis=2, keepdims=True)              # [S, nkv, 1, g]
     w = jnp.exp(m - m_star)                                 # [S, nkv, NS, g]
@@ -364,20 +413,23 @@ def _pallas_paged_attention_split(q, k_pages, v_pages, block_table, kv_lens,
     return (num / den[..., None]).astype(q.dtype)
 
 
-def _dma_layout_ok(hd: int, bs: int, kv_major: bool) -> bool:
+def _dma_layout_ok(hd: int, bs: int, kv_major: bool,
+                   quant: bool = False) -> bool:
     """Mosaic constraint on the per-page DMA slab: its LANE (last) dim must
     be 128-aligned and its sublane dim 8-aligned (padded lane dims make the
-    slice non-contiguous and the compile is rejected — found on real v5e)."""
+    slice non-contiguous and the compile is rejected — found on real v5e).
+    int8 pages tile (32, 128), so the sublane requirement tightens to 32;
+    the [bs] f32 scale slab additionally needs bs % 128 == 0."""
+    sub = 32 if quant else 8
     if kv_major:
-        return bs % 128 == 0 and hd % 8 == 0
-    return hd % 128 == 0 and bs % 8 == 0
+        return bs % 128 == 0 and hd % sub == 0
+    return (hd % 128 == 0 and bs % sub == 0
+            and (not quant or bs % 128 == 0))
 
 
 def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
               alibi_slopes=None, window=None, interpret=None, mesh=None,
               kv_major=False, k_scale=None, v_scale=None):
-    if k_scale is not None:     # int8 KV: XLA dequant path (in-kernel
-        return False            # dequant is tracked follow-up work)
     if q.ndim != 4 or k_pages.ndim != 4:
         return False
     S, nkv, g, hd = q.shape
@@ -385,11 +437,19 @@ def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
         NB, nkv2, hd2, bs = k_pages.shape
     else:
         NB, nkv2, bs, hd2 = k_pages.shape
+    quant = k_scale is not None
+    if quant and (v_scale is None
+                  or k_pages.dtype != jnp.int8
+                  or v_pages.dtype != jnp.int8
+                  or k_scale.shape != (NB, nkv2, bs)
+                  or v_scale.shape != (NB, nkv2, bs)):
+        return False
     if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
         return False
     if window is not None and int(window) <= 0:
         return False
-    return (nkv == nkv2 and hd == hd2 and _dma_layout_ok(hd, bs, kv_major)
+    return (nkv == nkv2 and hd == hd2
+            and _dma_layout_ok(hd, bs, kv_major, quant=quant)
             and block_table.ndim == 2 and block_table.shape[0] == S)
 
 

@@ -345,6 +345,38 @@ class TestPagedAttention:
                                    np.asarray(want, np.float32),
                                    atol=2e-2, rtol=2e-2)
 
+    def test_kernel_int8_kv_matches_xla(self, rng):
+        """In-kernel dequant: int8 pages + per-token scales DMA'd alongside,
+        dequantized in VMEM before the dots — parity vs the XLA dequant
+        path, both layouts."""
+        from deepspeed_tpu.inference.v2.model import quantize_kv_token
+        from deepspeed_tpu.ops.paged_attention import (pallas_paged_attention,
+                                                       supported,
+                                                       xla_paged_attention)
+        for kv_major in (False, True):
+            # standard layout needs hd % 128 == 0; kv-major needs bs % 128
+            # (and int8 tightens the sublane requirement to 32)
+            hd = 128 if not kv_major else 32
+            S, nkv, g, NB, bs, MB = 4, 2, 3, 16, 128, 2
+            q = jnp.asarray(rng.standard_normal((S, nkv, g, hd)), jnp.float32)
+            # quantize token-major KV then lay out pages per the layout flag
+            kt = rng.standard_normal((NB, nkv, bs, hd)).astype(np.float32)
+            vt = rng.standard_normal((NB, nkv, bs, hd)).astype(np.float32)
+            kq, ks = quantize_kv_token(jnp.asarray(kt))     # [NB,nkv,bs,hd]
+            vq, vs = quantize_kv_token(jnp.asarray(vt))
+            if kv_major:
+                kq, vq = (jnp.swapaxes(a, 2, 3) for a in (kq, vq))
+            bt = jnp.asarray(rng.permutation(NB)[:S * MB].reshape(S, MB),
+                             jnp.int32)
+            lens = jnp.asarray([0, 7, bs, 2 * bs], jnp.int32)
+            kw = dict(kv_major=kv_major, k_scale=ks, v_scale=vs)
+            assert supported(q, kq, vq, bt, lens, **kw)
+            want = xla_paged_attention(q, kq, vq, bt, lens, **kw)
+            got = pallas_paged_attention(q, kq, vq, bt, lens,
+                                         interpret=True, **kw)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, err_msg=f"{kv_major=}")
+
     def test_kernel_alibi_matches_xla(self, rng):
         """Alibi slope×key-pos bias inside the online softmax (BLOOM /
         falcon-rw decode hits the kernel path now)."""
