@@ -57,7 +57,7 @@ def pad_batch(chunk, length=None, rows=None):
     return batch, mask
 
 
-def run_v2(cfg, params, prompts, budgets, block_size=64):
+def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None):
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
     eng = InferenceEngineV2(
@@ -67,7 +67,8 @@ def run_v2(cfg, params, prompts, budgets, block_size=64):
             "max_ragged_batch_size": TOKEN_BUDGET,
             "max_ragged_sequence_count": SLOTS,
             "max_q_per_seq": 512,
-            "kv_block_size": block_size},
+            "kv_block_size": block_size,
+            "kv_quant": kv_quant},
          "generation": {"do_sample": False}},
         params=params)
     # warm every compiled path (prefill buckets, decode, burst sizes) by
@@ -151,6 +152,7 @@ def main():
     prompts, budgets = make_workload(rng, cfg, nreq=4 * SLOTS)
     v2_tps = run_v2(cfg, params, prompts, budgets)
     v1_tps = run_v1(cfg, params, prompts, budgets)
+    int8_tps = run_v2(cfg, params, prompts, budgets, kv_quant="int8")
     one_v2, one_v1 = run_oneshot(cfg, params, rng)
 
     print(json.dumps({
@@ -159,6 +161,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(v2_tps / v1_tps, 3),
         "extra": {"static_batch_tokens_per_sec": round(v1_tps, 1),
+                  "ragged_int8_kv_tokens_per_sec": round(int8_tps, 1),
                   "oneshot_equal_lengths_ragged": round(one_v2, 1),
                   "oneshot_equal_lengths_static": round(one_v1, 1),
                   "n_requests": len(prompts), "slots": SLOTS,

@@ -52,6 +52,29 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _quant_inputs_ok(k_pages, v_pages, k_scale, v_scale, NB, nkv, bs) -> bool:
+    """Shared int8-KV input contract for the decode and prefill gates: both
+    pools int8 with matching per-(page, head, token) scale arrays."""
+    return (v_scale is not None
+            and k_pages.dtype == jnp.int8
+            and v_pages.dtype == jnp.int8
+            and k_scale.shape == (NB, nkv, bs)
+            and v_scale.shape == (NB, nkv, bs))
+
+
+def _dequant_page(k, v, ks, vs, kv_major, dtype):
+    """int8 page codes × per-token fp32 scale row → compute dtype.  The token
+    axis is the LANE axis of a kv-major page ([hd, bs]) and the SUBLANE axis
+    otherwise ([bs, hd]) — single source of truth for both kernels."""
+    if kv_major:
+        k = (k.astype(jnp.float32) * ks[None, :]).astype(dtype)
+        v = (v.astype(jnp.float32) * vs[None, :]).astype(dtype)
+    else:
+        k = (k.astype(jnp.float32) * ks[:, None]).astype(dtype)
+        v = (v.astype(jnp.float32) * vs[:, None]).astype(dtype)
+    return k, v
+
+
 def _gather_pages(pages, block_table, kv_major):
     """Gather each slot's pages THEN normalize the layout — transposing only
     the [S, MB, …] gather result, never the whole pool.  Returns
@@ -211,13 +234,8 @@ def _split_kernel(*refs, bs, scale, window, has_alibi, n_splits, kv_major,
         if quant:
             dma(ks_hbm, ks_buf, slot, p, 2).wait()
             dma(vs_hbm, vs_buf, slot, p, 3).wait()
-            ks, vs = ks_buf[slot], vs_buf[slot]        # [bs] f32
-            if kv_major:               # pages [hd, bs]: token axis on lanes
-                k = (k.astype(jnp.float32) * ks[None, :]).astype(q.dtype)
-                v = (v.astype(jnp.float32) * vs[None, :]).astype(q.dtype)
-            else:                      # pages [bs, hd]: token axis sublanes
-                k = (k.astype(jnp.float32) * ks[:, None]).astype(q.dtype)
-                v = (v.astype(jnp.float32) * vs[:, None]).astype(q.dtype)
+            k, v = _dequant_page(k, v, ks_buf[slot], vs_buf[slot],
+                                 kv_major, q.dtype)
         k_dims = ((1,), (0,)) if kv_major else ((1,), (1,))
         scores = jax.lax.dot_general(
             q, k, (k_dims, ((), ())),
@@ -438,11 +456,8 @@ def supported(q, k_pages, v_pages, block_table, kv_lens, *, scale=None,
     else:
         NB, nkv2, bs, hd2 = k_pages.shape
     quant = k_scale is not None
-    if quant and (v_scale is None
-                  or k_pages.dtype != jnp.int8
-                  or v_pages.dtype != jnp.int8
-                  or k_scale.shape != (NB, nkv2, bs)
-                  or v_scale.shape != (NB, nkv2, bs)):
+    if quant and not _quant_inputs_ok(k_pages, v_pages, k_scale, v_scale,
+                                      NB, nkv2, bs):
         return False
     if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
         return False
@@ -528,14 +543,27 @@ def xla_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
     return jnp.einsum("snqgk,sknd->sqngd", probs.astype(q.dtype), v_seq)
 
 
-def _prefill_kernel(*refs, bs, cq, g, scale, window, has_alibi, kv_major):
-    if has_alibi:
+def _prefill_kernel(*refs, bs, cq, g, scale, window, has_alibi, kv_major,
+                    quant=False):
+    if quant:
+        if has_alibi:
+            bt_ref, len_ref, start_ref, count_ref, slopes_ref, \
+                q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, \
+                k_buf, v_buf, ks_buf, vs_buf, sem = refs
+        else:
+            bt_ref, len_ref, start_ref, count_ref, \
+                q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, \
+                k_buf, v_buf, ks_buf, vs_buf, sem = refs
+            slopes_ref = None
+    elif has_alibi:
         bt_ref, len_ref, start_ref, count_ref, slopes_ref, \
             q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = refs
     else:
         bt_ref, len_ref, start_ref, count_ref, \
             q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem = refs
         slopes_ref = None
+    if not quant:
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
     s, h, c = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     count = count_ref[s]
     start = start_ref[s]
@@ -556,11 +584,16 @@ def _prefill_kernel(*refs, bs, cq, g, scale, window, has_alibi, kv_major):
         return pltpu.make_async_copy(
             hbm.at[bt_ref[s, p], h], buf.at[slot], sem.at[way * 2 + slot])
 
+    def start_page(slot, p):
+        dma(k_hbm, k_buf, slot, p, 0).start()
+        dma(v_hbm, v_buf, slot, p, 1).start()
+        if quant:
+            dma(ks_hbm, ks_buf, slot, p, 2).start()
+            dma(vs_hbm, vs_buf, slot, p, 3).start()
+
     @pl.when(n_pages > p_start)
     def _warmup():
-        slot0 = jax.lax.rem(p_start, 2)
-        dma(k_hbm, k_buf, slot0, p_start, 0).start()
-        dma(v_hbm, v_buf, slot0, p_start, 1).start()
+        start_page(jax.lax.rem(p_start, 2), p_start)
 
     q = q_ref[0, :, 0].reshape(cq * g, hd)         # [cq·g, hd] row r=(j·g+gi)
     rown = jax.lax.broadcasted_iota(jnp.int32, (cq * g, bs), 0) // g
@@ -579,13 +612,17 @@ def _prefill_kernel(*refs, bs, cq, g, scale, window, has_alibi, kv_major):
 
         @pl.when(p + 1 < n_pages)
         def _prefetch():
-            dma(k_hbm, k_buf, nxt, p + 1, 0).start()
-            dma(v_hbm, v_buf, nxt, p + 1, 1).start()
+            start_page(nxt, p + 1)
 
         dma(k_hbm, k_buf, slot, p, 0).wait()
         dma(v_hbm, v_buf, slot, p, 1).wait()
         k = k_buf[slot]                # [bs, hd] or [hd, bs] (kv-major)
         v = v_buf[slot]
+        if quant:
+            dma(ks_hbm, ks_buf, slot, p, 2).wait()
+            dma(vs_hbm, vs_buf, slot, p, 3).wait()
+            k, v = _dequant_page(k, v, ks_buf[slot], vs_buf[slot],
+                                 kv_major, q.dtype)
         k_dims = ((1,), (0,)) if kv_major else ((1,), (1,))
         scores = jax.lax.dot_general(
             q, k, (k_dims, ((), ())),
@@ -624,10 +661,6 @@ def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
                           alibi_slopes=None, window=None,
                           interpret: Optional[bool] = None, mesh=None,
                           kv_major=False, k_scale=None, v_scale=None):
-    if k_scale is not None:
-        raise NotImplementedError(
-            "int8 KV is served by the XLA dequant path; in-kernel dequant is "
-            "tracked follow-up work (ragged_prefill_supported gates this off)")
     if (mesh is not None and mesh.shape.get("tp", 1) > 1
             and q.shape[2] % mesh.shape["tp"] == 0):
         from jax import shard_map
@@ -640,13 +673,22 @@ def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
         in_specs = [q_spec, kv_spec, kv_spec, P(None, None), P(None),
                     P(None), P(None)]
         args = [q, k_pages, v_pages, block_table, kv_lens, q_starts, q_counts]
+        n_scales = 0
+        if k_scale is not None:        # [NB, nkv, bs]: kv-head axis shards
+            args += [k_scale, v_scale]
+            in_specs += [P(None, "tp", None)] * 2
+            n_scales = 2
         if alibi_slopes is not None:
             args.append(jnp.asarray(alibi_slopes, jnp.float32).reshape(
                 q.shape[2], q.shape[3]))
             in_specs.append(P("tp", None))
 
-        def wrapped(q_, k_, v_, bt_, lens_, st_, ct_, *sl):
+        def wrapped(q_, k_, v_, bt_, lens_, st_, ct_, *rest):
+            sc = rest[:n_scales]
+            sl = rest[n_scales:]
             return inner(q_, k_, v_, bt_, lens_, st_, ct_,
+                         k_scale=sc[0] if sc else None,
+                         v_scale=sc[1] if sc else None,
                          alibi_slopes=sl[0] if sl else None)
         return shard_map(
             wrapped, mesh=mesh, in_specs=tuple(in_specs),
@@ -655,7 +697,8 @@ def pallas_ragged_prefill(q, k_pages, v_pages, block_table, kv_lens, q_starts,
     return _pallas_ragged_prefill_local(
         q, k_pages, v_pages, block_table, kv_lens, q_starts, q_counts,
         scale=scale, alibi_slopes=alibi_slopes, window=window,
-        interpret=interpret, kv_major=kv_major)
+        interpret=interpret, kv_major=kv_major,
+        k_scale=k_scale, v_scale=v_scale)
 
 
 def _prefill_chunk(Q: int) -> Optional[int]:
@@ -670,7 +713,7 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
                                  scale: Optional[float] = None,
                                  alibi_slopes=None, window=None,
                                  interpret: Optional[bool] = None,
-                                 kv_major=False):
+                                 kv_major=False, k_scale=None, v_scale=None):
     S, Q, nkv, g, hd = q.shape
     bs = k_pages.shape[3] if kv_major else k_pages.shape[2]
     if scale is None:
@@ -683,12 +726,13 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
     q_starts = q_starts.astype(jnp.int32)
     q_counts = q_counts.astype(jnp.int32)
     has_alibi = alibi_slopes is not None
+    quant = k_scale is not None
 
     grid = (S, nkv, Q // cq)
     kernel = functools.partial(
         _prefill_kernel, bs=bs, cq=cq, g=g, scale=float(scale),
         window=int(window) if window is not None else None,
-        has_alibi=has_alibi, kv_major=kv_major)
+        has_alibi=has_alibi, kv_major=kv_major, quant=quant)
     n_prefetch = 4
     prefetch = [block_table, kv_lens, q_starts, q_counts]
     if has_alibi:
@@ -701,7 +745,19 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
+    inputs = [q, k_pages, v_pages]
     buf_shape = (2, hd, bs) if kv_major else (2, bs, hd)
+    scratch = [
+        pltpu.VMEM(buf_shape, k_pages.dtype),
+        pltpu.VMEM(buf_shape, v_pages.dtype),
+    ]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        inputs += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+        scratch += [pltpu.VMEM((2, bs), jnp.float32),
+                    pltpu.VMEM((2, bs), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((8 if quant else 4,)))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -710,17 +766,13 @@ def _pallas_ragged_prefill_local(q, k_pages, v_pages, block_table, kv_lens,
             in_specs=in_specs,
             out_specs=pl.BlockSpec((1, cq, 1, g, hd),
                                    lambda s, h, c, *_: (s, c, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM(buf_shape, k_pages.dtype),
-                pltpu.VMEM(buf_shape, v_pages.dtype),
-                pltpu.SemaphoreType.DMA((4,)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((S, Q, nkv, g, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(*prefetch, q, k_pages, v_pages)
+    )(*prefetch, *inputs)
     return out
 
 
@@ -729,8 +781,6 @@ def ragged_prefill_supported(q, k_pages, v_pages, block_table, kv_lens,
                              alibi_slopes=None, window=None, interpret=None,
                              mesh=None, kv_major=False,
                              k_scale=None, v_scale=None):
-    if k_scale is not None:     # int8 KV: XLA dequant path (see supported())
-        return False
     if q.ndim != 5 or k_pages.ndim != 4:
         return False
     S, Q, nkv, g, hd = q.shape
@@ -738,11 +788,16 @@ def ragged_prefill_supported(q, k_pages, v_pages, block_table, kv_lens,
         NB, nkv2, hd2, bs = k_pages.shape
     else:
         NB, nkv2, bs, hd2 = k_pages.shape
+    quant = k_scale is not None
+    if quant and not _quant_inputs_ok(k_pages, v_pages, k_scale, v_scale,
+                                      NB, nkv2, bs):
+        return False
     if alibi_slopes is not None and np.size(alibi_slopes) != nkv * g:
         return False
     if window is not None and int(window) <= 0:
         return False
-    return (nkv == nkv2 and hd == hd2 and _dma_layout_ok(hd, bs, kv_major)
+    return (nkv == nkv2 and hd == hd2
+            and _dma_layout_ok(hd, bs, kv_major, quant=quant)
             and _prefill_chunk(Q) is not None
             and block_table.ndim == 2 and block_table.shape[0] == S)
 

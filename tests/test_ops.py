@@ -549,6 +549,37 @@ class TestRaggedPrefill:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5)
 
+    def test_int8_kv_matches_xla(self, rng):
+        """int8 pages + in-kernel dequant in the prefill kernel, both
+        layouts, mixed decode/prefill slots."""
+        from deepspeed_tpu.inference.v2.model import quantize_kv_token
+        from deepspeed_tpu.ops.paged_attention import (
+            pallas_ragged_prefill, ragged_prefill_supported,
+            xla_ragged_prefill)
+        for kv_major in (False, True):
+            hd = 128 if not kv_major else 32
+            S, Q, nkv, g, NB, bs, MB = 4, 8, 2, 2, 12, 128, 2
+            q = jnp.asarray(rng.standard_normal((S, Q, nkv, g, hd)),
+                            jnp.float32)
+            kt = rng.standard_normal((NB, nkv, bs, hd)).astype(np.float32)
+            vt = rng.standard_normal((NB, nkv, bs, hd)).astype(np.float32)
+            kq, ks = quantize_kv_token(jnp.asarray(kt))
+            vq, vs = quantize_kv_token(jnp.asarray(vt))
+            if kv_major:
+                kq, vq = (jnp.swapaxes(a, 2, 3) for a in (kq, vq))
+            bt = jnp.asarray(rng.permutation(NB)[:S * MB].reshape(S, MB),
+                             jnp.int32)
+            counts = jnp.asarray([0, 1, 5, Q], jnp.int32)
+            lens = jnp.asarray([0, bs + 9, 14, Q], jnp.int32)
+            starts = lens - counts
+            args = (q, kq, vq, bt, lens, starts, counts)
+            kw = dict(kv_major=kv_major, k_scale=ks, v_scale=vs)
+            assert ragged_prefill_supported(*args, **kw)
+            want = xla_ragged_prefill(*args, **kw)
+            got = pallas_ragged_prefill(*args, interpret=True, **kw)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, err_msg=f"{kv_major=}")
+
     def test_alibi_and_window(self, rng):
         from deepspeed_tpu.ops.paged_attention import (
             pallas_ragged_prefill, ragged_prefill_supported,
