@@ -30,8 +30,11 @@ from deepspeed_tpu.inference.v2.model import (PagedKVCache,
                                               ragged_decode_burst,
                                               ragged_decode_forward,
                                               ragged_decode_sampled,
+                                              ragged_decode_sampled_draft,
                                               ragged_forward,
-                                              ragged_forward_sampled)
+                                              ragged_forward_sampled,
+                                              ragged_forward_sampled_draft,
+                                              speculative_burst)
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager, RaggedBatch,
                                                build_ragged_batch)
 from deepspeed_tpu.utils.logging import log_dist
@@ -58,6 +61,14 @@ class V2TPConfig(DeepSpeedConfigModel):
     tp_size: int = 1
 
 
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """Greedy draft-and-verify decoding (engine kwarg ``draft_model``/
+    ``draft_params`` supplies the draft)."""
+
+    gamma: int = 4              # draft tokens per verify
+    outer_steps: int = 8        # draft+verify rounds fused per dispatch
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig."""
 
@@ -66,6 +77,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     state_manager: DSStateManagerConfig = Field(
         default_factory=DSStateManagerConfig)
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
+    speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
 
     @classmethod
     def parse(cls, config):
@@ -118,7 +130,7 @@ class InferenceEngineV2:
     fresh init for testing).  See reference engine_v2.py:30."""
 
     def __init__(self, model, config=None, params=None, seed: int = 0,
-                 mesh=None):
+                 mesh=None, draft_model=None, draft_params=None):
         from deepspeed_tpu.models.gpt import GPTConfig, GPTLogits
         from deepspeed_tpu.parallel.metadata import unbox
         from deepspeed_tpu.checkpoint.hf import (is_hf_model_dir,
@@ -226,6 +238,37 @@ class InferenceEngineV2:
             max_seq_len=model_cfg.max_seq_len)
         self.cache = PagedKVCache.create(model_cfg, num_blocks, eff_bs, dt,
                                          quant=sm.kv_quant)
+        # ---- speculative decoding draft (greedy draft-and-verify) ----
+        self.draft_config = self.draft_params = self.draft_cache = None
+        if draft_model is not None:
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "speculative decoding with tensor parallelism: shard the "
+                    "draft like the target (future work); drop tp or draft")
+            dcfg = (draft_model if isinstance(draft_model, GPTConfig)
+                    else draft_model.cfg)
+            dcfg = dataclasses.replace(dcfg, dtype=dt, dropout=0.0)
+            if dcfg.max_seq_len < model_cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {dcfg.max_seq_len} < target "
+                    f"{model_cfg.max_seq_len}")
+            self.draft_config = dcfg
+            if draft_params is None:
+                dlm = GPTLogits(dcfg)
+                draft_params = unbox(dlm.init(
+                    jax.random.PRNGKey(seed + 1),
+                    jnp.zeros((1, 8), jnp.int32)))["params"]
+            draft_params = unbox(draft_params)
+            if isinstance(draft_params, dict) and "params" in draft_params:
+                draft_params = draft_params["params"]
+            self.draft_params = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p).astype(dt)
+                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
+                else jnp.asarray(p), draft_params)
+            # the draft shares the pool GEOMETRY (same block table indexes
+            # both caches) but holds its own pages
+            self.draft_cache = PagedKVCache.create(dcfg, num_blocks, eff_bs,
+                                                   dt, quant=sm.kv_quant)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             kv_sh = NamedSharding(self.mesh, P(None, None, "tp", None, None))
@@ -245,6 +288,9 @@ class InferenceEngineV2:
         # recompute-preemption observability: how many victims were taken in
         # steady decode vs mid-(re-)prefill (the latter must keep fold state)
         self.preempt_stats = {"decode_ready": 0, "mid_prefill": 0}
+        # speculative observability: accepted tokens per (slot × outer step);
+        # tokens/outer_steps ≈ gamma+1 means the draft tracks the target
+        self.spec_stats = {"outer_steps": 0, "tokens": 0}
         self._block_size = eff_bs
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(self.params))
@@ -392,6 +438,56 @@ class InferenceEngineV2:
         return functools.partial(_sample_token, do_sample=gen.do_sample,
                                  top_k=gen.top_k)
 
+    def _spec_active(self, gen) -> bool:
+        """Speculative decoding runs when a draft is loaded and decoding is
+        greedy (acceptance-by-exact-match keeps the output token-identical
+        to target-only decoding; sampled rejection-sampling is future work)."""
+        return self.draft_params is not None and not gen.do_sample
+
+    def _run_spec(self, reqs, outer: int, gamma: int, prev):
+        """One fused draft-and-verify dispatch over the running set, then ONE
+        sync to learn the per-step acceptance counts (the host cannot
+        schedule past a spec burst without them).  Returns
+        (toks [outer, gamma+1, S] np, counts [outer, S] np, prev')."""
+        S = self.state.max_tracked_sequences
+        tokens0 = np.zeros(S, np.int32)
+        from_device = np.zeros(S, bool)
+        active = np.zeros(S, bool)
+        pos0 = np.zeros(S, np.int32)
+        block_table = np.zeros((S, self.state.max_blocks_per_seq), np.int32)
+        for r in reqs:
+            seq = self.state.get(r.uid)
+            self.state.ensure_blocks(seq, outer * (gamma + 1))
+            sl = seq.slot
+            if r.held_token is not None:
+                tokens0[sl] = r.held_token
+                r.held_token = None
+            else:
+                from_device[sl] = True
+            active[sl] = True
+            pos0[sl] = seq.seen_tokens
+            bl = np.asarray(seq.blocks, np.int32)
+            block_table[sl, :len(bl)] = bl
+        key = ("spec", outer, gamma)
+        if key not in self._steps:
+            self._steps[key] = jax.jit(
+                functools.partial(speculative_burst, cfg=self.model_config,
+                                  draft_cfg=self.draft_config,
+                                  block_size=self._block_size, gamma=gamma,
+                                  steps=outer, mesh=self.mesh),
+                donate_argnums=(2, 3))
+        batch = jax.tree_util.tree_map(jnp.asarray, {
+            "tokens0": tokens0, "from_device": from_device, "active": active,
+            "pos0": pos0, "block_table": block_table})
+        toks, counts, prev, self.cache, self.draft_cache = self._steps[key](
+            self.params, self.draft_params, self.cache, self.draft_cache,
+            batch, prev)
+        toks_h, counts_h = jax.device_get([toks, counts])
+        self.spec_stats["outer_steps"] += outer * len(reqs)
+        self.spec_stats["tokens"] += int(
+            counts_h[:, [self.state.get(r.uid).slot for r in reqs]].sum())
+        return np.asarray(toks_h), np.asarray(counts_h), prev
+
     def _run_burst(self, reqs, steps: int, gen, prev, rng):
         """Fused T-step decode over the running set: one device dispatch for
         ``steps`` tokens per sequence (see model.ragged_decode_burst).  Each
@@ -469,6 +565,29 @@ class InferenceEngineV2:
                 token_pos[sl] = seq.seen_tokens
                 bl = np.asarray(seq.blocks, np.int32)
                 block_table[sl, :len(bl)] = bl
+            batch = jax.tree_util.tree_map(jnp.asarray, {
+                "tokens": tokens, "active": active, "token_pos": token_pos,
+                "block_table": block_table, "from_device": fdev,
+                "served": served})
+            if self._spec_active(gen):
+                # lockstep draft ingestion (see mixed_sd)
+                key = ("decode_sd", gen.do_sample, gen.top_k)
+                if key not in self._steps:
+                    self._steps[key] = jax.jit(
+                        functools.partial(ragged_decode_sampled_draft,
+                                          cfg=self.model_config,
+                                          draft_cfg=self.draft_config,
+                                          block_size=self._block_size,
+                                          sample_fn=self._sample_fn(gen),
+                                          mesh=self.mesh),
+                        donate_argnums=(2, 3))
+                prev, rng, self.cache, self.draft_cache = self._steps[key](
+                    self.params, self.draft_params, self.cache,
+                    self.draft_cache, batch, prev, rng,
+                    jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+                for seq, toks in schedule:
+                    seq.seen_tokens += len(toks)
+                return prev, rng
             key = ("decode_s", gen.do_sample, gen.top_k)
             if key not in self._steps:
                 self._steps[key] = jax.jit(
@@ -478,10 +597,6 @@ class InferenceEngineV2:
                                       sample_fn=self._sample_fn(gen),
                                       mesh=self.mesh),
                     donate_argnums=(1,))
-            batch = jax.tree_util.tree_map(jnp.asarray, {
-                "tokens": tokens, "active": active, "token_pos": token_pos,
-                "block_table": block_table, "from_device": fdev,
-                "served": served})
         else:
             rb = build_ragged_batch(schedule, self.state,
                                     sm.max_ragged_batch_size, sm.max_q_per_seq)
@@ -491,6 +606,35 @@ class InferenceEngineV2:
                 fdev[i:i + len(toks)] = fd
                 i += len(toks)
             mb, nb = self._buckets(rb)
+            batch = jax.tree_util.tree_map(jnp.asarray, {
+                "tokens": rb.tokens[:nb], "token_slot": rb.token_slot[:nb],
+                "token_pos": rb.token_pos[:nb],
+                "token_dense_idx": rb.token_dense_idx[:nb],
+                "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len,
+                "from_device": fdev[:nb], "served": served})
+            if self._spec_active(gen):
+                # dual prefill: the draft ingests every prompt chunk in
+                # lockstep so speculative acceptance has something to work
+                # with (draft staleness can't affect correctness)
+                key = ("mixed_sd", sm.max_q_per_seq, mb, gen.do_sample,
+                       gen.top_k)
+                if key not in self._steps:
+                    self._steps[key] = jax.jit(
+                        functools.partial(ragged_forward_sampled_draft,
+                                          cfg=self.model_config,
+                                          draft_cfg=self.draft_config,
+                                          block_size=self._block_size,
+                                          max_q_per_seq=sm.max_q_per_seq,
+                                          sample_fn=self._sample_fn(gen),
+                                          mesh=self.mesh),
+                        donate_argnums=(2, 3))
+                prev, rng, self.cache, self.draft_cache = self._steps[key](
+                    self.params, self.draft_params, self.cache,
+                    self.draft_cache, batch, prev, rng,
+                    jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+                for seq, toks in schedule:
+                    seq.seen_tokens += len(toks)
+                return prev, rng
             key = ("mixed_s", sm.max_q_per_seq, mb, gen.do_sample, gen.top_k)
             if key not in self._steps:
                 self._steps[key] = jax.jit(
@@ -501,12 +645,6 @@ class InferenceEngineV2:
                                       sample_fn=self._sample_fn(gen),
                                       mesh=self.mesh),
                     donate_argnums=(1,))
-            batch = jax.tree_util.tree_map(jnp.asarray, {
-                "tokens": rb.tokens[:nb], "token_slot": rb.token_slot[:nb],
-                "token_pos": rb.token_pos[:nb],
-                "token_dense_idx": rb.token_dense_idx[:nb],
-                "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len,
-                "from_device": fdev[:nb], "served": served})
         prev, rng, self.cache = self._steps[key](
             self.params, self.cache, batch, prev, rng,
             jnp.float32(gen.temperature), jnp.float32(gen.top_p))
@@ -646,6 +784,60 @@ class InferenceEngineV2:
 
         burst_sizes = (64, 32, 16, 8)
         while waiting or running:
+            # ---- speculative draft-and-verify fast path: same eligibility
+            # as the decode burst, preferred when a draft is loaded and
+            # decoding is greedy.  Each outer step yields 1..gamma+1 tokens
+            # per slot; the host syncs after the burst (it cannot schedule
+            # without the acceptance counts), which also materializes EOS.
+            if (self._spec_active(gen) and running
+                    and (not waiting or self.state.free_sequence_slots == 0)
+                    and all(r.decode_ready and not r.done for r in running)
+                    and all(not self.state.get(r.uid).in_flight
+                            for r in running)):
+                sp = self.config.speculative
+                worst = sp.gamma + 1            # tokens per outer step, max
+                need_max = max(r.max_new_tokens - r.sampled for r in running)
+                cap = min(self.model_config.max_seq_len
+                          - self.state.get(r.uid).seen_tokens
+                          for r in running)
+                # size for ~half acceptance (2x the full-acceptance need),
+                # then round DOWN to a power of two so the compile cache
+                # holds at most log2(outer_steps) spec programs
+                outer = min(sp.outer_steps, 2 * -(-need_max // worst),
+                            cap // worst)
+                if outer >= 1:
+                    outer = 1 << (outer.bit_length() - 1)
+                while outer >= 1:
+                    need = sum(self.state.get(r.uid).kv_blocks_needed(
+                        outer * worst, self.state.block_size) for r in running)
+                    if need <= self.state.allocator.free_blocks:
+                        break
+                    outer //= 2
+                if outer >= 1:
+                    n_before = len(running)
+                    materialize()               # keep .generated chronological
+                    if len(running) != n_before:
+                        continue    # EOS retirements changed the set (maybe
+                        # to empty) — recompute eligibility and sizing
+                    pairs = [(r.uid, self.state.get(r.uid).slot)
+                             for r in running]
+                    toks_h, counts_h, prev = self._run_spec(
+                        running, outer, sp.gamma, prev)
+                    for r, (uid, sl) in zip(list(running), pairs):
+                        total = int(counts_h[:, sl].sum())
+                        self.state.get(uid).seen_tokens += total
+                        vals = []
+                        for k in range(outer):
+                            c = int(counts_h[k, sl])
+                            vals.extend(int(t) for t in toks_h[k, :c, sl])
+                        _append(r, vals)
+                        r.sampled += total
+                        if r.done or r.sampled >= r.max_new_tokens:
+                            r.done = True
+                            self.flush([r.uid])
+                            running.remove(r)
+                    continue
+
             # ---- decode-burst fast path: every running sequence is in pure
             # decode and no slot is admittable -> fuse T steps into one
             # dispatch.  With requests WAITING the burst targets the earliest
@@ -653,8 +845,10 @@ class InferenceEngineV2:
             # longest remaining budget (finish everyone).  Sequences that
             # finish mid-burst cost nothing extra — the burst computes all
             # slots every step — and their overshoot tokens are discarded at
-            # materialize.
-            if (running
+            # materialize.  Disabled while speculation is active: the plain
+            # burst would advance the target without the draft, leaving
+            # permanent draft-cache holes (single steps stay dual-model).
+            if (running and not self._spec_active(gen)
                     and (not waiting or self.state.free_sequence_slots == 0)
                     and all(r.decode_ready and not r.done for r in running)
                     and all(not self.state.get(r.uid).in_flight

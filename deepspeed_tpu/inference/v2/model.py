@@ -498,6 +498,56 @@ def ragged_forward_sampled(params, cache: PagedKVCache, batch, prev_tokens,
     return prev_out, rng, cache
 
 
+def ragged_forward_sampled_draft(params, draft_params, cache: PagedKVCache,
+                                 draft_cache: PagedKVCache, batch,
+                                 prev_tokens, rng, temperature, top_p,
+                                 cfg: GPTConfig, draft_cfg: GPTConfig, *,
+                                 block_size: int, max_q_per_seq: int,
+                                 sample_fn, mesh=None):
+    """ragged_forward_sampled that ALSO runs the draft model over the same
+    ragged batch (its logits discarded) so the draft's paged KV ingests
+    every prompt chunk in lockstep with the target — the prerequisite for
+    useful speculative acceptance.  Draft staleness never affects
+    correctness (greedy verify is exact for any draft), only acceptance.
+    Returns (prev', rng', cache', draft_cache')."""
+    tokens = jnp.where(batch["from_device"],
+                       prev_tokens[jnp.clip(batch["token_slot"], 0)],
+                       batch["tokens"])
+    batch = {**batch, "tokens": tokens}
+    logits, cache = ragged_forward(
+        params, cache, batch, cfg,
+        block_size=block_size, max_q_per_seq=max_q_per_seq, mesh=mesh)
+    _, draft_cache = ragged_forward(
+        draft_params, draft_cache, batch, draft_cfg,
+        block_size=block_size, max_q_per_seq=max_q_per_seq, mesh=mesh)
+    rng, sub = jax.random.split(rng)
+    nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
+    prev_out = jnp.where(batch["served"], nxt.astype(jnp.int32), prev_tokens)
+    return prev_out, rng, cache, draft_cache
+
+
+def ragged_decode_sampled_draft(params, draft_params, cache: PagedKVCache,
+                                draft_cache: PagedKVCache, batch,
+                                prev_tokens, rng, temperature, top_p,
+                                cfg: GPTConfig, draft_cfg: GPTConfig, *,
+                                block_size: int, sample_fn, mesh=None):
+    """ragged_decode_sampled with the draft model ingesting the same tokens
+    (logits discarded) — keeps the draft KV in lockstep through decode-only
+    scheduler rounds so later speculative bursts don't attend draft-cache
+    holes.  Returns (prev', rng', cache', draft_cache')."""
+    tokens = jnp.where(batch["from_device"], prev_tokens, batch["tokens"])
+    batch = {**batch, "tokens": tokens}
+    logits, cache = ragged_decode_forward(
+        params, cache, batch, cfg, block_size=block_size, mesh=mesh)
+    _, draft_cache = ragged_decode_forward(
+        draft_params, draft_cache, batch, draft_cfg,
+        block_size=block_size, mesh=mesh)
+    rng, sub = jax.random.split(rng)
+    nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
+    prev_out = jnp.where(batch["served"], nxt.astype(jnp.int32), prev_tokens)
+    return prev_out, rng, cache, draft_cache
+
+
 def ragged_decode_sampled(params, cache: PagedKVCache, batch, prev_tokens,
                           rng, temperature, top_p, cfg: GPTConfig, *,
                           block_size: int, sample_fn, mesh=None):
@@ -515,6 +565,187 @@ def ragged_decode_sampled(params, cache: PagedKVCache, batch, prev_tokens,
     nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
     prev_out = jnp.where(batch["served"], nxt.astype(jnp.int32), prev_tokens)
     return prev_out, rng, cache
+
+
+def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
+                 pos0, block_table, cfg: GPTConfig, block_size: int,
+                 mesh=None):
+    """Multi-token scoring forward for speculative decoding: every active
+    slot ingests G contiguous tokens at positions pos0..pos0+G-1 (KV written
+    into its pages) and gets logits for ALL G positions back — one program
+    scores a whole draft run.  Dense [S, G] layout (no packing: every slot
+    scores the same G), attention through the ragged-prefill op with
+    q_counts=G.  Returns (logits [S, G, V], updated flat views)."""
+    from deepspeed_tpu import ops
+    bb = params["backbone"]
+    dtype = cfg.dtype
+    S, G = tokens.shape
+    L = cfg.num_layers
+    NB = flat_k.shape[0] // L
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    g = nh // nkv
+    km = kv_major_layout(cfg)
+    quant = flat_ks is not None
+
+    positions = pos0[:, None] + jnp.arange(G, dtype=jnp.int32)[None]  # [S,G]
+    x = bb["wte"].astype(dtype)[tokens]                               # [S,G,H]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.embed_scale, dtype)
+    if cfg.embed_norm:
+        x = _norm(bb["embed_norm"], x, cfg)
+    if not cfg.use_rope and not cfg.use_alibi:
+        x = x + bb["wpe"].astype(dtype)[positions]
+
+    big = jnp.iinfo(jnp.int32).max
+    flat_pos = positions.reshape(-1)                                  # [S*G]
+    page = block_table[
+        jnp.repeat(jnp.arange(S), G), flat_pos // block_size]         # [S*G]
+    off = flat_pos % block_size
+    act_flat = jnp.repeat(active, G)
+    kv_len = jnp.where(active, pos0 + G, 0)
+
+    for li in range(cfg.num_layers):
+        blk = bb[f"block_{li}"]
+        ap = blk["Attention_0"]
+        h = _norm(blk["Norm_0"], x, cfg)
+        q, k, v = _qkv(ap, h, cfg, "sgh,hkd->sgkd")
+        if cfg.use_rope:
+            q, k = rope(q, k, positions, hd, base=cfg.rope_theta,
+                        rope_pct=cfg.rope_pct, scaling=cfg.rope_scaling,
+                        seq_lens=kv_len[:, None])
+        page_li = jnp.where(act_flat, li * NB + page, big)
+        kf = k.reshape(S * G, nkv, hd)
+        vf = v.reshape(S * G, nkv, hd)
+        if quant:
+            k_store, ks = quantize_kv_token(kf)
+            v_store, vs = quantize_kv_token(vf)
+            flat_ks = flat_ks.at[page_li, :, off].set(ks, mode="drop")
+            flat_vs = flat_vs.at[page_li, :, off].set(vs, mode="drop")
+        else:
+            k_store, v_store = kf, vf
+        if km:
+            flat_k = flat_k.at[page_li, :, :, off].set(
+                k_store.astype(flat_k.dtype), mode="drop")
+            flat_v = flat_v.at[page_li, :, :, off].set(
+                v_store.astype(flat_v.dtype), mode="drop")
+        else:
+            flat_k = flat_k.at[page_li, :, off].set(
+                k_store.astype(flat_k.dtype), mode="drop")
+            flat_v = flat_v.at[page_li, :, off].set(
+                v_store.astype(flat_v.dtype), mode="drop")
+
+        k_pool = jax.lax.dynamic_slice_in_dim(flat_k, li * NB, NB)
+        v_pool = jax.lax.dynamic_slice_in_dim(flat_v, li * NB, NB)
+        if quant:
+            kv_extra = dict(
+                k_scale=jax.lax.dynamic_slice_in_dim(flat_ks, li * NB, NB),
+                v_scale=jax.lax.dynamic_slice_in_dim(flat_vs, li * NB, NB))
+        else:
+            k_pool, v_pool = k_pool.astype(dtype), v_pool.astype(dtype)
+            kv_extra = {}
+        slopes = None
+        if cfg.use_alibi:
+            from deepspeed_tpu.models.gpt import alibi_slopes
+            slopes = jnp.asarray(alibi_slopes(nh, hd, cfg.alibi_prescale))
+        win = cfg.window_for_layer(li)
+        o = ops.ragged_prefill_attention(
+            q.reshape(S, G, nkv, g, hd).astype(dtype), k_pool, v_pool,
+            block_table, kv_len, pos0,
+            jnp.where(active, G, 0).astype(jnp.int32),
+            scale=cfg.attn_scale, alibi_slopes=slopes, window=win,
+            mesh=mesh, kv_major=km, **kv_extra).reshape(S, G, nh, hd)
+        attn_delta = _attn_out(ap, o, cfg, "sgkd,kdh->sgh")
+        # FFN/MoE body is token-wise and (for MoE) expects FLAT tokens
+        H = x.shape[-1]
+        x = _block_residual(blk, x.reshape(S * G, H), h.reshape(S * G, H),
+                            attn_delta.reshape(S * G, H), cfg
+                            ).reshape(S, G, H)
+
+    x = _norm(bb["final_norm"], x, cfg)
+    if cfg.tie_embeddings:
+        unembed = bb["wte"].astype(dtype).T
+    else:
+        unembed = params["lm_head"].astype(dtype)
+    logits = (x @ unembed).astype(jnp.float32)                 # [S, G, V]
+    if cfg.unembed_bias:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    return logits, flat_k, flat_v, flat_ks, flat_vs
+
+
+def speculative_burst(params, draft_params, cache: PagedKVCache,
+                      draft_cache: PagedKVCache, batch, prev_tokens,
+                      cfg: GPTConfig, draft_cfg: GPTConfig, *,
+                      block_size: int, gamma: int, steps: int, mesh=None):
+    """GREEDY speculative decoding, fully device-resident: each outer step
+    runs the draft model for ``gamma`` cheap decode steps, scores the whole
+    run with ONE multi-token target forward (_verify_core), accepts the
+    longest matching prefix, and emits accepted + 1 correction token — the
+    classic draft-and-verify recipe, with the paged KV design making
+    rollback free (positions past the accepted point are simply overwritten
+    by later writes; attention masks by kv_len).
+
+    Greedy only: acceptance is exact token match, so the output is
+    token-identical to target-only greedy decoding for ANY draft — the
+    invariant the tests pin.
+
+    batch: tokens0/from_device/active/pos0/block_table as in
+    ragged_decode_burst; blocks for positions pos0..pos0+steps*(gamma+1)-1
+    must be pre-allocated.
+    Returns (toks [steps, gamma+1, S], counts [steps, S], prev', cache',
+    draft_cache') — the first counts[k, s] of toks[k, :, s] are real."""
+    fk, fv, fks, fvs = _flat_cache_views(cache)
+    dk, dv, dks, dvs = _flat_cache_views(draft_cache)
+    bt = batch["block_table"]
+    active = batch["active"]
+    S = prev_tokens.shape[0]
+    prev0 = jnp.where(batch["from_device"], prev_tokens, batch["tokens0"])
+
+    def outer(carry, _):
+        fk, fv, fks, fvs, dk, dv, dks, dvs, prev, pos = carry
+        # --- draft: gamma+1 decodes, ingesting prev, d_1..d_gamma — the
+        # extra step writes d_gamma's KV so a FULLY-accepted round leaves no
+        # hole at pos+gamma in the draft cache (all later draft attention
+        # would read garbage there forever, silently decaying acceptance);
+        # its own output d_{gamma+1} is discarded ---
+        d_list = []
+        dtok, dpos = prev, pos
+        ddk, ddv, ddks, ddvs = dk, dv, dks, dvs
+        for _j in range(gamma + 1):
+            dlogits, ddk, ddv, ddks, ddvs = _decode_core(
+                draft_params, ddk, ddv, dtok, active, dpos, bt, draft_cfg,
+                block_size, mesh=mesh, flat_ks=ddks, flat_vs=ddvs)
+            dtok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            d_list.append(dtok)
+            dpos = dpos + 1
+        d = jnp.stack(d_list[:gamma])                   # [gamma, S] drafts
+        # --- target: score [prev, d_1..d_gamma] in one forward ---
+        ver_in = jnp.concatenate([prev[None], d], axis=0).T   # [S, gamma+1]
+        vlogits, fk, fv, fks, fvs = _verify_core(
+            params, fk, fv, fks, fvs, ver_in, active, pos, bt, cfg,
+            block_size, mesh=mesh)
+        t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)    # [S, gamma+1]
+        # acceptance: longest prefix with d_j == t_{j-1}
+        match = (d.T == t[:, :gamma])                         # [S, gamma]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)                               # [S] 0..gamma
+        # emitted tokens: d_1..d_n then the correction t_n
+        j = jnp.arange(gamma + 1)[None]                       # [1, gamma+1]
+        correction = jnp.take_along_axis(t, n_acc[:, None], axis=1)[:, 0]
+        emit = jnp.where(j < n_acc[:, None], jnp.pad(d.T, ((0, 0), (0, 1))),
+                         correction[:, None])                 # [S, gamma+1]
+        counts = n_acc + 1
+        new_prev = jnp.where(active, correction, prev)
+        new_pos = jnp.where(active, pos + counts, pos)
+        return ((fk, fv, fks, fvs, ddk, ddv, ddks, ddvs, new_prev, new_pos),
+                (emit.T, jnp.where(active, counts, 0)))
+
+    carry = (fk, fv, fks, fvs, dk, dv, dks, dvs, prev0, batch["pos0"])
+    (fk, fv, fks, fvs, dk, dv, dks, dvs, prev, _), (toks, counts) = \
+        jax.lax.scan(outer, carry, None, length=steps)
+    prev_out = jnp.where(active, prev, prev_tokens)
+    return (toks, counts, prev_out,
+            _rebuild_cache(cache, fk, fv, fks, fvs),
+            _rebuild_cache(draft_cache, dk, dv, dks, dvs))
 
 
 def ragged_decode_forward(params, cache: PagedKVCache, batch,

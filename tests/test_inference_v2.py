@@ -237,6 +237,59 @@ class TestInt8KVCache:
         assert agree / total > 0.7, (agree, total)
 
 
+class TestSpeculative:
+    """Greedy draft-and-verify decoding: acceptance is exact token match, so
+    for ANY draft the output must be token-identical to target-only greedy
+    decoding — the invariant every test here pins."""
+
+    def test_identical_draft_exact_and_accepts(self, cfg, v2cfg, rng):
+        prompts = [rng.integers(0, 97, (10 + 3 * i,)).astype(np.int32)
+                   for i in range(3)]
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        want = base.generate(prompts, max_new_tokens=18)
+        spec = InferenceEngineV2(cfg, config=v2cfg, params=base.params,
+                                 draft_model=cfg, draft_params=base.params)
+        got = spec.generate(prompts, max_new_tokens=18)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        st = spec.spec_stats
+        assert st["outer_steps"] > 0          # the spec path actually ran
+        # identical weights: the draft should track the target closely
+        # (decode vs verify run different-but-equivalent fp32 programs, so
+        # rare near-tie divergence is tolerated)
+        gamma = spec.config.speculative.gamma
+        assert st["tokens"] / st["outer_steps"] > 0.8 * (gamma + 1), st
+
+    def test_random_draft_still_exact(self, cfg, v2cfg, rng):
+        prompts = [rng.integers(0, 97, (12 + i,)).astype(np.int32)
+                   for i in range(3)]
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        want = base.generate(prompts, max_new_tokens=15)
+        # draft_params=None -> fresh random draft (low acceptance)
+        spec = InferenceEngineV2(cfg, config=v2cfg, params=base.params,
+                                 draft_model=cfg)
+        got = spec.generate(prompts, max_new_tokens=15)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        assert spec.spec_stats["outer_steps"] > 0
+
+    def test_eos_and_heterogeneous_budgets(self, cfg, v2cfg, rng):
+        prompts = [rng.integers(0, 97, (11 + i,)).astype(np.int32)
+                   for i in range(3)]
+        budgets = [7, 13, 18]
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        want = base.generate(prompts, max_new_tokens=budgets)
+        eos = int(want[2][4])                  # force an early stop on seq 2
+        want_eos = base.generate(prompts, max_new_tokens=budgets,
+                                 eos_token_id=eos)
+        spec = InferenceEngineV2(cfg, config=v2cfg, params=base.params,
+                                 draft_model=cfg, draft_params=base.params)
+        got = spec.generate(prompts, max_new_tokens=budgets,
+                            eos_token_id=eos)
+        for w, g in zip(want_eos, got):
+            np.testing.assert_array_equal(w, g)
+
+
 class TestSampledGenerate:
     def test_same_seed_reproduces_from_same_state(self, cfg, v2cfg, rng):
         """do_sample=True with the device-resident rng: same seed + same
