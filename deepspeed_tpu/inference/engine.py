@@ -30,27 +30,36 @@ from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
 from deepspeed_tpu.utils.logging import log_dist
 
 
-def _sample_token(logits, rng, *, do_sample, temperature, top_k, top_p):
-    """One sampling step over [B, V] fp32 logits (greedy / temp / top-k / top-p)."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _sampling_logits(logits, *, temperature, top_k, top_p):
+    """Filtered/scaled logits whose softmax IS the sampling distribution
+    (temp / top-k / top-p).  Shared by _sample_token and the speculative
+    rejection-sampling accept step (which needs the full distributions of
+    BOTH models under the same transforms).  Works on [..., V]."""
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k:
         # top_k >= vocab is the common "disabled" idiom — clamp instead of
         # letting lax.top_k fail at trace time with an opaque XLA error
         top_k = min(int(top_k), logits.shape[-1])
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     # top-p (traced scalar; p=1.0 keeps everything — the cutoff lands on the
     # smallest logit)
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # keep the smallest prefix with cumulative mass >= top_p
     cutoff_idx = jnp.minimum(jnp.sum(cum < top_p, axis=-1, keepdims=True),
                              logits.shape[-1] - 1)
     cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-    logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def _sample_token(logits, rng, *, do_sample, temperature, top_k, top_p):
+    """One sampling step over [B, V] fp32 logits (greedy / temp / top-k / top-p)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _sampling_logits(logits, temperature=temperature, top_k=top_k,
+                              top_p=top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 

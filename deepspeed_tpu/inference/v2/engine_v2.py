@@ -34,7 +34,8 @@ from deepspeed_tpu.inference.v2.model import (PagedKVCache,
                                               ragged_forward,
                                               ragged_forward_sampled,
                                               ragged_forward_sampled_draft,
-                                              speculative_burst)
+                                              speculative_burst,
+                                              speculative_burst_sampled)
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager, RaggedBatch,
                                                build_ragged_batch)
 from deepspeed_tpu.utils.logging import log_dist
@@ -439,16 +440,17 @@ class InferenceEngineV2:
                                  top_k=gen.top_k)
 
     def _spec_active(self, gen) -> bool:
-        """Speculative decoding runs when a draft is loaded and decoding is
-        greedy (acceptance-by-exact-match keeps the output token-identical
-        to target-only decoding; sampled rejection-sampling is future work)."""
-        return self.draft_params is not None and not gen.do_sample
+        """Speculative decoding runs whenever a draft is loaded: greedy uses
+        exact-match acceptance (token-identical output), sampling uses
+        rejection-sampling acceptance (exactly target-distributed output) —
+        both correct for ANY draft."""
+        return self.draft_params is not None
 
-    def _run_spec(self, reqs, outer: int, gamma: int, prev):
+    def _run_spec(self, reqs, outer: int, gamma: int, gen, prev, rng):
         """One fused draft-and-verify dispatch over the running set, then ONE
         sync to learn the per-step acceptance counts (the host cannot
         schedule past a spec burst without them).  Returns
-        (toks [outer, gamma+1, S] np, counts [outer, S] np, prev')."""
+        (toks [outer, gamma+1, S] np, counts [outer, S] np, prev', rng')."""
         S = self.state.max_tracked_sequences
         tokens0 = np.zeros(S, np.int32)
         from_device = np.zeros(S, bool)
@@ -468,25 +470,44 @@ class InferenceEngineV2:
             pos0[sl] = seq.seen_tokens
             bl = np.asarray(seq.blocks, np.int32)
             block_table[sl, :len(bl)] = bl
-        key = ("spec", outer, gamma)
-        if key not in self._steps:
-            self._steps[key] = jax.jit(
-                functools.partial(speculative_burst, cfg=self.model_config,
-                                  draft_cfg=self.draft_config,
-                                  block_size=self._block_size, gamma=gamma,
-                                  steps=outer, mesh=self.mesh),
-                donate_argnums=(2, 3))
         batch = jax.tree_util.tree_map(jnp.asarray, {
             "tokens0": tokens0, "from_device": from_device, "active": active,
             "pos0": pos0, "block_table": block_table})
-        toks, counts, prev, self.cache, self.draft_cache = self._steps[key](
-            self.params, self.draft_params, self.cache, self.draft_cache,
-            batch, prev)
+        if gen.do_sample:
+            key = ("spec_rs", outer, gamma, gen.top_k)
+            if key not in self._steps:
+                self._steps[key] = jax.jit(
+                    functools.partial(speculative_burst_sampled,
+                                      cfg=self.model_config,
+                                      draft_cfg=self.draft_config,
+                                      block_size=self._block_size,
+                                      gamma=gamma, steps=outer,
+                                      top_k=gen.top_k, mesh=self.mesh),
+                    donate_argnums=(2, 3))
+            toks, counts, prev, rng, self.cache, self.draft_cache = \
+                self._steps[key](self.params, self.draft_params, self.cache,
+                                 self.draft_cache, batch, prev, rng,
+                                 jnp.float32(gen.temperature),
+                                 jnp.float32(gen.top_p))
+        else:
+            key = ("spec", outer, gamma)
+            if key not in self._steps:
+                self._steps[key] = jax.jit(
+                    functools.partial(speculative_burst,
+                                      cfg=self.model_config,
+                                      draft_cfg=self.draft_config,
+                                      block_size=self._block_size,
+                                      gamma=gamma, steps=outer,
+                                      mesh=self.mesh),
+                    donate_argnums=(2, 3))
+            toks, counts, prev, self.cache, self.draft_cache = \
+                self._steps[key](self.params, self.draft_params, self.cache,
+                                 self.draft_cache, batch, prev)
         toks_h, counts_h = jax.device_get([toks, counts])
         self.spec_stats["outer_steps"] += outer * len(reqs)
         self.spec_stats["tokens"] += int(
             counts_h[:, [self.state.get(r.uid).slot for r in reqs]].sum())
-        return np.asarray(toks_h), np.asarray(counts_h), prev
+        return np.asarray(toks_h), np.asarray(counts_h), prev, rng
 
     def _run_burst(self, reqs, steps: int, gen, prev, rng):
         """Fused T-step decode over the running set: one device dispatch for
@@ -821,8 +842,8 @@ class InferenceEngineV2:
                         # to empty) — recompute eligibility and sizing
                     pairs = [(r.uid, self.state.get(r.uid).slot)
                              for r in running]
-                    toks_h, counts_h, prev = self._run_spec(
-                        running, outer, sp.gamma, prev)
+                    toks_h, counts_h, prev, rng = self._run_spec(
+                        running, outer, sp.gamma, gen, prev, rng)
                     for r, (uid, sl) in zip(list(running), pairs):
                         total = int(counts_h[:, sl].sum())
                         self.state.get(uid).seen_tokens += total

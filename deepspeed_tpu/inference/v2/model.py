@@ -19,6 +19,7 @@ Qmax new tokens per sequence per step.  Raggedness is carried by index arrays
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -672,80 +673,173 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
     return logits, flat_k, flat_v, flat_ks, flat_vs
 
 
-def speculative_burst(params, draft_params, cache: PagedKVCache,
-                      draft_cache: PagedKVCache, batch, prev_tokens,
-                      cfg: GPTConfig, draft_cfg: GPTConfig, *,
-                      block_size: int, gamma: int, steps: int, mesh=None):
-    """GREEDY speculative decoding, fully device-resident: each outer step
-    runs the draft model for ``gamma`` cheap decode steps, scores the whole
-    run with ONE multi-token target forward (_verify_core), accepts the
-    longest matching prefix, and emits accepted + 1 correction token — the
-    classic draft-and-verify recipe, with the paged KV design making
-    rollback free (positions past the accepted point are simply overwritten
-    by later writes; attention masks by kv_len).
-
-    Greedy only: acceptance is exact token match, so the output is
-    token-identical to target-only greedy decoding for ANY draft — the
-    invariant the tests pin.
+def _speculative_burst_core(params, draft_params, cache: PagedKVCache,
+                            draft_cache: PagedKVCache, batch, prev_tokens,
+                            rng, xform, cfg: GPTConfig,
+                            draft_cfg: GPTConfig, *, block_size: int,
+                            gamma: int, steps: int, sampled: bool,
+                            mesh=None):
+    """Shared draft-and-verify choreography (greedy and rejection-sampling
+    differ ONLY in the token choice and the acceptance rule): each outer
+    step runs the draft for gamma cheap decodes — plus one extra ingest so
+    a fully-accepted round leaves no draft-cache hole at pos+gamma (later
+    draft attention would read garbage there forever, silently decaying
+    acceptance) — scores the whole run with ONE multi-token target forward
+    (_verify_core), accepts a prefix, and emits accepted + 1 correction
+    token.  The paged KV design makes rollback free: positions past the
+    accepted point are simply overwritten by later writes.
 
     batch: tokens0/from_device/active/pos0/block_table as in
     ragged_decode_burst; blocks for positions pos0..pos0+steps*(gamma+1)-1
     must be pre-allocated.
-    Returns (toks [steps, gamma+1, S], counts [steps, S], prev', cache',
-    draft_cache') — the first counts[k, s] of toks[k, :, s] are real."""
+    Returns (toks [steps, gamma+1, S], counts [steps, S], prev', rng',
+    cache', draft_cache') — the first counts[k, s] of toks[k, :, s] are
+    real."""
     fk, fv, fks, fvs = _flat_cache_views(cache)
     dk, dv, dks, dvs = _flat_cache_views(draft_cache)
     bt = batch["block_table"]
     active = batch["active"]
-    S = prev_tokens.shape[0]
     prev0 = jnp.where(batch["from_device"], prev_tokens, batch["tokens0"])
+    if rng is None:
+        rng = jax.random.PRNGKey(0)         # greedy: threaded but unused
 
     def outer(carry, _):
-        fk, fv, fks, fvs, dk, dv, dks, dvs, prev, pos = carry
-        # --- draft: gamma+1 decodes, ingesting prev, d_1..d_gamma — the
-        # extra step writes d_gamma's KV so a FULLY-accepted round leaves no
-        # hole at pos+gamma in the draft cache (all later draft attention
-        # would read garbage there forever, silently decaying acceptance);
-        # its own output d_{gamma+1} is discarded ---
-        d_list = []
+        fk, fv, fks, fvs, dk, dv, dks, dvs, prev, pos, rng = carry
+        d_list, q_list = [], []
         dtok, dpos = prev, pos
         ddk, ddv, ddks, ddvs = dk, dv, dks, dvs
-        for _j in range(gamma + 1):
+        for j in range(gamma + 1):
             dlogits, ddk, ddv, ddks, ddvs = _decode_core(
                 draft_params, ddk, ddv, dtok, active, dpos, bt, draft_cfg,
                 block_size, mesh=mesh, flat_ks=ddks, flat_vs=ddvs)
-            dtok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
-            d_list.append(dtok)
+            if j < gamma:
+                if sampled:
+                    ql = xform(dlogits)
+                    rng, sub = jax.random.split(rng)
+                    dtok = jax.random.categorical(sub, ql, axis=-1).astype(
+                        jnp.int32)
+                    q_list.append(ql)
+                else:
+                    dtok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                d_list.append(dtok)
+            # the j == gamma pass only ingests d_gamma's KV
             dpos = dpos + 1
-        d = jnp.stack(d_list[:gamma])                   # [gamma, S] drafts
-        # --- target: score [prev, d_1..d_gamma] in one forward ---
-        ver_in = jnp.concatenate([prev[None], d], axis=0).T   # [S, gamma+1]
+        d = jnp.stack(d_list, axis=1)                   # [S, gamma]
+        ver_in = jnp.concatenate([prev[:, None], d], axis=1)  # [S, gamma+1]
         vlogits, fk, fv, fks, fvs = _verify_core(
             params, fk, fv, fks, fvs, ver_in, active, pos, bt, cfg,
             block_size, mesh=mesh)
-        t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)    # [S, gamma+1]
-        # acceptance: longest prefix with d_j == t_{j-1}
-        match = (d.T == t[:, :gamma])                         # [S, gamma]
-        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                        axis=1)                               # [S] 0..gamma
-        # emitted tokens: d_1..d_n then the correction t_n
-        j = jnp.arange(gamma + 1)[None]                       # [1, gamma+1]
-        correction = jnp.take_along_axis(t, n_acc[:, None], axis=1)[:, 0]
-        emit = jnp.where(j < n_acc[:, None], jnp.pad(d.T, ((0, 0), (0, 1))),
-                         correction[:, None])                 # [S, gamma+1]
-        counts = n_acc + 1
-        new_prev = jnp.where(active, correction, prev)
+        if sampled:
+            rng, sub = jax.random.split(rng)
+            emit, counts = spec_accept(sub, jnp.stack(q_list, axis=1),
+                                       xform(vlogits), d)
+        else:
+            t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [S, g+1]
+            match = (d == t[:, :gamma])
+            n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                            axis=1)                             # 0..gamma
+            correction = jnp.take_along_axis(t, n_acc[:, None],
+                                             axis=1)[:, 0]
+            j_idx = jnp.arange(gamma + 1)[None]
+            emit = jnp.where(j_idx < n_acc[:, None],
+                             jnp.pad(d, ((0, 0), (0, 1))),
+                             correction[:, None])               # [S, g+1]
+            counts = n_acc + 1
+        counts = jnp.where(active, counts, 0)
+        last = jnp.take_along_axis(
+            emit, jnp.maximum(counts - 1, 0)[:, None], axis=1)[:, 0]
+        new_prev = jnp.where(active, last, prev)
         new_pos = jnp.where(active, pos + counts, pos)
-        return ((fk, fv, fks, fvs, ddk, ddv, ddks, ddvs, new_prev, new_pos),
-                (emit.T, jnp.where(active, counts, 0)))
+        return ((fk, fv, fks, fvs, ddk, ddv, ddks, ddvs, new_prev, new_pos,
+                 rng), (emit.T, counts))
 
-    carry = (fk, fv, fks, fvs, dk, dv, dks, dvs, prev0, batch["pos0"])
-    (fk, fv, fks, fvs, dk, dv, dks, dvs, prev, _), (toks, counts) = \
+    carry = (fk, fv, fks, fvs, dk, dv, dks, dvs, prev0, batch["pos0"], rng)
+    (fk, fv, fks, fvs, dk, dv, dks, dvs, prev, _, rng), (toks, counts) = \
         jax.lax.scan(outer, carry, None, length=steps)
     prev_out = jnp.where(active, prev, prev_tokens)
-    return (toks, counts, prev_out,
+    return (toks, counts, prev_out, rng,
             _rebuild_cache(cache, fk, fv, fks, fvs),
             _rebuild_cache(draft_cache, dk, dv, dks, dvs))
+
+
+def speculative_burst(params, draft_params, cache: PagedKVCache,
+                      draft_cache: PagedKVCache, batch, prev_tokens,
+                      cfg: GPTConfig, draft_cfg: GPTConfig, *,
+                      block_size: int, gamma: int, steps: int, mesh=None):
+    """GREEDY speculative decoding: acceptance is exact token match, so the
+    output is token-identical to target-only greedy decoding for ANY draft
+    — the invariant the tests pin.  See _speculative_burst_core.
+    Returns (toks, counts, prev', cache', draft_cache')."""
+    toks, counts, prev, _, cache, draft_cache = _speculative_burst_core(
+        params, draft_params, cache, draft_cache, batch, prev_tokens,
+        None, None, cfg, draft_cfg, block_size=block_size, gamma=gamma,
+        steps=steps, sampled=False, mesh=mesh)
+    return toks, counts, prev, cache, draft_cache
+
+
+def spec_accept(rng, q_logits, p_logits, d):
+    """Rejection-sampling acceptance for speculative decoding (Leviathan et
+    al. 2023) — PURE math, unit-tested distributionally in isolation.
+
+    q_logits [S, gamma, V]: the draft's POST-transform sampling logits at
+    each draft position (d[s, j] was sampled from softmax(q_logits[s, j])).
+    p_logits [S, gamma+1, V]: the target's post-transform logits for the
+    same positions plus the bonus position.
+    d [S, gamma]: the draft tokens.
+
+    Per position: accept d_j w.p. min(1, p(d_j)/q(d_j)); at the first
+    rejection emit a token from the residual max(p − q, 0)/Z; if all gamma
+    accepted emit a bonus token from the gamma+1-th target distribution.
+    Each emitted token is exactly target-distributed for ANY draft.
+
+    Returns (emit [S, gamma+1], counts [S] in 1..gamma+1)."""
+    S, gamma = d.shape
+    q = jax.nn.softmax(q_logits, axis=-1)            # [S, gamma, V]
+    p = jax.nn.softmax(p_logits, axis=-1)            # [S, gamma+1, V]
+    pd = jnp.take_along_axis(p[:, :gamma], d[..., None], axis=-1)[..., 0]
+    qd = jnp.take_along_axis(q, d[..., None], axis=-1)[..., 0]
+    r_acc, r_cor = jax.random.split(rng)
+    u = jax.random.uniform(r_acc, (S, gamma))
+    accept = u * qd < pd                             # u < min(1, pd/qd)
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)  # [S]
+    # correction distribution at the stop position: residual when rejected,
+    # the bonus target distribution when everything was accepted
+    p_n = jnp.take_along_axis(p, n[:, None, None], axis=1)[:, 0]  # [S, V]
+    q_n = jnp.take_along_axis(
+        q, jnp.minimum(n, gamma - 1)[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_n - q_n, 0.0)
+    resid_mass = jnp.sum(resid, axis=-1, keepdims=True)
+    # numerically-empty residual (p ≈ q) degrades gracefully to p itself
+    resid = jnp.where(resid_mass > 1e-9, resid / jnp.maximum(resid_mass,
+                                                             1e-9), p_n)
+    dist = jnp.where((n == gamma)[:, None], p_n, resid)           # [S, V]
+    correction = jax.random.categorical(
+        r_cor, jnp.log(jnp.maximum(dist, 1e-30)), axis=-1).astype(jnp.int32)
+    j = jnp.arange(gamma + 1)[None]
+    emit = jnp.where(j < n[:, None], jnp.pad(d, ((0, 0), (0, 1))),
+                     correction[:, None])            # [S, gamma+1]
+    return emit, n + 1
+
+
+def speculative_burst_sampled(params, draft_params, cache: PagedKVCache,
+                              draft_cache: PagedKVCache, batch, prev_tokens,
+                              rng, temperature, top_p,
+                              cfg: GPTConfig, draft_cfg: GPTConfig, *,
+                              block_size: int, gamma: int, steps: int,
+                              top_k: int = 0, mesh=None):
+    """Sampled speculative decoding: the draft SAMPLES its tokens and the
+    verify step runs rejection-sampling acceptance (spec_accept), so every
+    emitted token is distributed exactly as target-only sampling under the
+    same temperature/top-k/top-p transforms — for any draft.  See
+    _speculative_burst_core for the shared choreography.
+    Returns (toks, counts, prev', rng', cache', draft_cache')."""
+    from deepspeed_tpu.inference.engine import _sampling_logits
+    xform = functools.partial(_sampling_logits, temperature=temperature,
+                              top_k=top_k, top_p=top_p)
+    return _speculative_burst_core(
+        params, draft_params, cache, draft_cache, batch, prev_tokens,
+        rng, xform, cfg, draft_cfg, block_size=block_size, gamma=gamma,
+        steps=steps, sampled=True, mesh=mesh)
 
 
 def ragged_decode_forward(params, cache: PagedKVCache, batch,

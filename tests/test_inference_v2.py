@@ -290,6 +290,63 @@ class TestSpeculative:
             np.testing.assert_array_equal(w, g)
 
 
+class TestSpeculativeSampled:
+    """Rejection-sampling speculative decoding: every emitted token must be
+    exactly target-distributed for any draft (Leviathan et al.)."""
+
+    def test_spec_accept_preserves_target_distribution(self):
+        """Monte Carlo over the pure accept math: 200k vectorized trials of
+        fixed q/p; the first emitted token's empirical distribution must
+        match softmax(p_0), and the second (where reached) softmax(p_1)."""
+        from deepspeed_tpu.inference.v2.model import spec_accept
+        V, gamma, N = 6, 3, 200_000
+        rng = np.random.default_rng(0)
+        q_log = jnp.asarray(rng.standard_normal((1, gamma, V)), jnp.float32)
+        p_log = jnp.asarray(rng.standard_normal((1, gamma + 1, V)),
+                            jnp.float32)
+        qN = jnp.broadcast_to(q_log, (N, gamma, V))
+        pN = jnp.broadcast_to(p_log, (N, gamma + 1, V))
+        kd, ka = jax.random.split(jax.random.PRNGKey(0))
+        d = jax.random.categorical(kd, qN, axis=-1).astype(jnp.int32)
+        emit, counts = jax.jit(spec_accept)(ka, qN, pN, d)
+        emit, counts = np.asarray(emit), np.asarray(counts)
+        p0 = np.asarray(jax.nn.softmax(p_log[0, 0]))
+        freq0 = np.bincount(emit[:, 0], minlength=V) / N
+        np.testing.assert_allclose(freq0, p0, atol=0.01)
+        m = counts >= 2           # second token emitted (first draft accepted)
+        p1 = np.asarray(jax.nn.softmax(p_log[0, 1]))
+        freq1 = np.bincount(emit[m, 1], minlength=V) / m.sum()
+        np.testing.assert_allclose(freq1, p1, atol=0.02)
+
+    def test_near_greedy_limit_matches_greedy(self, cfg, v2cfg, rng):
+        """temperature→0 sampling degenerates to greedy; the sampled spec
+        path must then reproduce the target-only greedy output exactly —
+        a deterministic end-to-end exercise of the rejection machinery."""
+        prompts = [rng.integers(0, 97, (10 + 3 * i,)).astype(np.int32)
+                   for i in range(3)]
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        want = base.generate(prompts, max_new_tokens=14)
+        spec = InferenceEngineV2(cfg, config=v2cfg, params=base.params,
+                                 draft_model=cfg)   # random draft
+        got = spec.generate(prompts, max_new_tokens=14, do_sample=True,
+                            temperature=1e-5)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        assert spec.spec_stats["outer_steps"] > 0
+
+    def test_same_seed_reproduces(self, cfg, v2cfg, rng):
+        prompts = [rng.integers(0, 97, (12 + i,)).astype(np.int32)
+                   for i in range(2)]
+        mk = lambda: InferenceEngineV2(cfg, config=v2cfg, seed=0,
+                                       draft_model=cfg)
+        a = mk().generate(prompts, max_new_tokens=16, seed=5,
+                          do_sample=True, temperature=1.0)
+        b = mk().generate(prompts, max_new_tokens=16, seed=5,
+                          do_sample=True, temperature=1.0)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
 class TestSampledGenerate:
     def test_same_seed_reproduces_from_same_state(self, cfg, v2cfg, rng):
         """do_sample=True with the device-resident rng: same seed + same
