@@ -428,11 +428,6 @@ def warn_inert_config(cfg: DeepSpeedTPUConfig) -> list:
         inert.append("zero_optimization.zero_quantized_weights (qwZ is the "
                      "stage-3 weight all-gather; inert at stage "
                      f"{z.stage} — set stage 3 and an fsdp mesh axis > 1)")
-    if z.zero_quantized_gradients:
-        inert.append("zero_optimization.zero_quantized_gradients (qgZ "
-                     "quantized grad reduce-scatter; the collective exists — "
-                     "ops/quantization.quantized_psum_scatter — but the "
-                     "engine grad path does not route through it yet)")
     # reference top-level blocks that are accepted for schema parity but have
     # no TPU behavior (extra="allow" would otherwise swallow them silently)
     aio_defaults = AIOConfig()

@@ -111,16 +111,66 @@ def quantized_all_gather(x, mesh, axis: str, *, bits: int = 8,
     in_spec[gather_dim] = axis
 
     def local(xs):
-        qb = quantize_blockwise(xs, bits=bits, block_size=block_size)
-        vg = jax.lax.all_gather(qb.values, axis)         # int8 on the wire
-        sg = jax.lax.all_gather(qb.scales, axis)
-        parts = [
-            dequantize_blockwise(qb._replace(values=vg[i], scales=sg[i]))
-            for i in range(size)]
-        return jnp.concatenate(parts, axis=gather_dim)
+        return qag_local(xs, axis, size, gather_dim,
+                         bits=bits, block_size=block_size)
 
     return shard_map(local, mesh=mesh, in_specs=P(*in_spec),
                      out_specs=P(), check_vma=False)(x)
+
+
+def qag_local(xs, axis: str, size: int, gather_dim: int = 0, *,
+              bits: int = 8, block_size: int = 256):
+    """Per-device body of a quantized all-gather (inside ``shard_map`` over
+    ``axis``): int values + fp32 block scales on the wire, per-member dequant,
+    concat along ``gather_dim``.  Shared by ``quantized_all_gather`` and
+    ``qpsum_local``."""
+    qb = quantize_blockwise(xs, bits=bits, block_size=block_size)
+    vg = jax.lax.all_gather(qb.values, axis)             # int8 on the wire
+    sg = jax.lax.all_gather(qb.scales, axis)
+    parts = [
+        dequantize_blockwise(qb._replace(values=vg[i], scales=sg[i]))
+        for i in range(size)]
+    return jnp.concatenate(parts, axis=gather_dim)
+
+
+def qrs_local(xs, axis: str, size: int, scatter_dim: int = 0, *,
+              bits: int = 8, block_size: int = 256):
+    """Per-device body of a quantized reduce-scatter, for use INSIDE an
+    existing ``shard_map`` over ``axis`` (the engine's qgZ grad path calls
+    this directly; ``quantized_psum_scatter`` wraps it for standalone use).
+
+    ``xs`` is this device's full-shape partial contribution.  Quantize each
+    target shard's slice INDEPENDENTLY (blocks never straddle shard
+    boundaries), all_to_all so member i receives every member's contribution
+    for slice i, dequant + sum.  Wire format: int values + fp32 block scales
+    — bits/32 of the fp32 reduce volume (+ scales overhead).
+    Returns this device's reduced slice (shape[scatter_dim] / size).
+    """
+    parts = jnp.split(xs, size, axis=scatter_dim)
+    qbs = [quantize_blockwise(p, bits=bits, block_size=block_size)
+           for p in parts]
+    v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),
+                           axis, 0, 0, tiled=False)
+    s = jax.lax.all_to_all(jnp.stack([q.scales for q in qbs]),
+                           axis, 0, 0, tiled=False)
+    total = jnp.zeros(parts[0].shape, jnp.float32)
+    for i in range(size):
+        qi = qbs[0]._replace(values=v[i], scales=s[i])
+        total = total + dequantize_blockwise(qi).astype(jnp.float32)
+    return total.astype(xs.dtype)
+
+
+def qpsum_local(xs, axis: str, size: int, scatter_dim: int = 0, *,
+                bits: int = 8, block_size: int = 256):
+    """Quantized all-reduce body (inside ``shard_map`` over ``axis``):
+    quantized reduce-scatter then a quantized all-gather of the reduced
+    slices, so BOTH wire phases move ints — total ≈ (1 + 1/size)·bits/32 of
+    one fp32 ring allreduce.  Used for qgZ leaves whose layout stays
+    replicated.  Returns the full reduced array on every device."""
+    total = qrs_local(xs, axis, size, scatter_dim,
+                      bits=bits, block_size=block_size)
+    return qag_local(total, axis, size, scatter_dim,
+                     bits=bits, block_size=block_size).astype(xs.dtype)
 
 
 def quantized_psum_scatter(x, mesh, axis: str, *, bits: int = 8,
@@ -148,22 +198,8 @@ def quantized_psum_scatter(x, mesh, axis: str, *, bits: int = 8,
     out_spec[scatter_dim] = axis
 
     def local(xs):
-        # xs: full array (replicated view per member).  Quantize each target
-        # shard's slice INDEPENDENTLY (blocks never straddle shard
-        # boundaries), all_to_all so member i receives every member's
-        # contribution for slice i, dequant + sum.
-        parts = jnp.split(xs, size, axis=scatter_dim)
-        qbs = [quantize_blockwise(p, bits=bits, block_size=block_size)
-               for p in parts]
-        v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),
-                               axis, 0, 0, tiled=False)
-        s = jax.lax.all_to_all(jnp.stack([q.scales for q in qbs]),
-                               axis, 0, 0, tiled=False)
-        total = jnp.zeros(parts[0].shape, jnp.float32)
-        for i in range(size):
-            qi = qbs[0]._replace(values=v[i], scales=s[i])
-            total = total + dequantize_blockwise(qi).astype(jnp.float32)
-        return total.astype(xs.dtype)
+        return qrs_local(xs, axis, size, scatter_dim,
+                         bits=bits, block_size=block_size)
 
     return shard_map(local, mesh=mesh, in_specs=P(),
                      out_specs=P(*out_spec), check_vma=False)(x)

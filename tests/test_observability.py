@@ -190,13 +190,15 @@ class TestInertConfigWarnings:
                 "stage": 2,
                 # implemented at stage 3 only — inert at stage 2 must warn
                 "zero_quantized_weights": True,
+                # zero_quantized_gradients is LIVE (engine._qgz_grads) — must
+                # NOT be in the inert list
                 "zero_quantized_gradients": True,
             },
         })
         inert = warn_inert_config(cfg)
         joined = " ".join(inert)
         assert "zero_quantized_weights" in joined
-        assert "zero_quantized_gradients" in joined
+        assert "zero_quantized_gradients" not in joined
         # offload_param is LIVE now (runtime/infinity.py) — must not warn
         cfg2 = parse_config({"zero_optimization": {
             "stage": 3, "offload_param": {"device": "cpu"}}})

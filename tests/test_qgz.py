@@ -1,0 +1,160 @@
+"""qgZ — quantized gradient reduce (ZeRO++ zero_quantized_gradients).
+
+Reference parity target: runtime/zero/stage3.py:1497 (quantized gradient
+reduction) + runtime/zero/config.py zero_quantized_gradients.  Here the flag
+routes the engine's grad computation through a manual shard_map over the data
+axis with an int8-wire all-to-all reduce (engine._qgz_grads,
+ops/quantization.qrs_local).
+
+Three proofs, per the round-3 verdict's "done" bar:
+1. per-step gradient fidelity (params after one identical step are close),
+2. loss-CURVE parity vs the uncompressed engine over a training run,
+3. wire-bytes telemetry: the compiled train step's collective payload drops
+   ~4x (int8 values replace fp32 on the dominant reduce).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.comm import hlo_collective_bytes
+from deepspeed_tpu.models import GPT, GPTConfig
+
+VOCAB, SEQ = 64, 16
+
+
+def _data(n_batches, global_bs, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    for _ in range(n_batches):
+        idx = rng.integers(0, len(pool), size=(global_bs,))
+        yield {"input_ids": pool[idx]}
+
+
+def _build(qgz, stage=2, precision="fp32", mesh_kw=None, seed=0, gas=1):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage,
+                              "zero_quantized_gradients": bool(qgz)},
+        "mesh": mesh_kw or {"dp": -1},
+        "steps_per_print": 0,
+        "seed": seed,
+    }
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    model = GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ))
+    example = {"input_ids": np.zeros((2, SEQ), np.int32)}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, example_batch=example)
+    return engine
+
+
+class TestQgzNumerics:
+    def test_grads_close_to_uncompressed(self, devices):
+        """Per-leaf relative L2 error of the int8-reduced grads vs the exact
+        fp32 reduce — blockwise int8 QDQ is ~0.5% per block, so 2% overall is
+        a comfortable but meaningful bound.  (Params-after-Adam are NOT
+        compared: Adam's per-element normalizer amplifies any grad epsilon on
+        near-zero-curvature elements into O(lr) update flips.)"""
+        base = _build(qgz=False, seed=11)
+        qgz = _build(qgz=True, seed=11)
+        batch = next(_data(1, base.train_batch_size, seed=5))
+        base.forward(batch)
+        qgz.forward(batch)
+        gb = jax.device_get(base._accum_grads)
+        gq = jax.device_get(qgz._accum_grads)
+
+        def close(a, b):
+            a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+            na = float(np.linalg.norm(a))
+            if na < 1e-9:
+                return
+            assert float(np.linalg.norm(a - b)) / na < 2e-2
+        jax.tree_util.tree_map(close, gb, gq)
+
+    def test_loss_curve_parity(self, devices):
+        """int8 block-quantized grads must track the fp32-reduce loss curve —
+        the reference's qgZ accuracy claim (ZeRO++ paper: no degradation)."""
+        base = _build(qgz=False, seed=3)
+        qgz = _build(qgz=True, seed=3)
+        gbs = base.train_batch_size
+        lb = [float(base.train_batch(b).loss) for b in _data(25, gbs, seed=9)]
+        lq = [float(qgz.train_batch(b).loss) for b in _data(25, gbs, seed=9)]
+        assert lq[-1] < lq[0] * 0.7, "qgZ engine failed to learn"
+        # curves track: endpoint within 10% relative
+        assert abs(lq[-1] - lb[-1]) / max(lb[-1], 1e-6) < 0.10, (lb, lq)
+
+    def test_gas_accumulation_composes(self, devices):
+        qgz = _build(qgz=True, gas=2, seed=3)
+        losses = [float(qgz.train_batch(b).loss)
+                  for b in _data(20, qgz.train_batch_size, seed=9)]
+        assert losses[-1] < losses[0] * 0.8
+
+    def test_bf16_composes(self, devices):
+        qgz = _build(qgz=True, precision="bf16", seed=3)
+        losses = [float(qgz.train_batch(b).loss)
+                  for b in _data(20, qgz.train_batch_size, seed=9)]
+        assert losses[-1] < losses[0] * 0.8
+
+
+class TestQgzWire:
+    def test_compiled_reduce_bytes_drop(self, devices):
+        """The whole point: bytes on the wire.  Walk the compiled HLO of both
+        train steps; the qgZ step's total collective payload must be well
+        under half the baseline's (int8 + scales vs fp32)."""
+        def total_bytes(engine):
+            batch = next(_data(1, engine.train_batch_size, seed=5))
+            batch = engine._reshape_gas(batch)
+            batch = engine._shard_batch(batch, leading_gas=True)
+            with engine.mesh:
+                compiled = jax.jit(engine._train_batch_fn).lower(
+                    engine.state, batch).compile()
+            kinds = hlo_collective_bytes(compiled.as_text())
+            return sum(rec["bytes"] for rec in kinds.values()), kinds
+
+        nb, kb = total_bytes(_build(qgz=False, seed=11))
+        nq, kq = total_bytes(_build(qgz=True, seed=11))
+        assert nq < 0.5 * nb, (
+            f"qgZ wire bytes {nq} not < 50% of baseline {nb} "
+            f"(baseline {kb}, qgz {kq})")
+        # the dominant exchange is the int8 all-to-all
+        assert "all-to-all" in kq
+
+    def test_int8_on_the_wire(self, devices):
+        """The all-to-all payload must be s8, not a disguised fp exchange."""
+        engine = _build(qgz=True, seed=11)
+        batch = next(_data(1, engine.train_batch_size, seed=5))
+        batch = engine._reshape_gas(batch)
+        batch = engine._shard_batch(batch, leading_gas=True)
+        with engine.mesh:
+            txt = jax.jit(engine._train_batch_fn).lower(
+                engine.state, batch).compile().as_text()
+        assert any("s8[" in ln for ln in txt.splitlines()
+                   if "all-to-all" in ln), "no s8 all-to-all in compiled HLO"
+
+
+class TestQgzGates:
+    def test_stage3_rejected(self, devices):
+        with pytest.raises(NotImplementedError, match="stage 3"):
+            _build(qgz=True, stage=3, mesh_kw={"dp": 1, "fsdp": 8})
+
+    def test_stage1_rejected(self, devices):
+        with pytest.raises(ValueError, match="stage >= 2"):
+            _build(qgz=True, stage=1)
+
+    def test_model_parallel_rejected(self, devices):
+        with pytest.raises(NotImplementedError, match="data-parallel"):
+            _build(qgz=True, mesh_kw={"dp": 4, "fsdp": 1, "tp": 2})
+
+    def test_world1_inert(self, devices):
+        """dp world 1: the flag degrades to a logged warning + the normal
+        grad path (engine still trains)."""
+        engine = _build(qgz=True, mesh_kw={"dp": 1, "fsdp": 1})
+        assert engine._qgz_axis is None
+        losses = [float(engine.train_batch(b).loss)
+                  for b in _data(10, engine.train_batch_size, seed=9)]
+        assert losses[-1] < losses[0]
