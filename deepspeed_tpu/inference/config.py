@@ -33,7 +33,12 @@ class DeepSpeedTPConfig(DeepSpeedConfigModel):
 
 class QuantizationConfig(DeepSpeedConfigModel):
     """Weight-quantized inference (ZeRO-Inference analog,
-    reference inference/quantization/)."""
+    reference inference/quantization/).  Storage is the shape-preserving
+    ``ops/quantization.quantize_weight`` store (int8 codes + dim-0 group
+    scales), so quantized weights shard like the weights they replace and
+    compose with tp>1.  ``bits=4`` narrows the quantization grid; bytes stay
+    at int8 granularity (nibble-packing would break the shape-preserving
+    sharding property)."""
 
     enabled: bool = False
     bits: int = 8

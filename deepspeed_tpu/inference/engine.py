@@ -115,23 +115,28 @@ class InferenceEngine:
 
         # ZeRO-Inference: store weights int-quantized, dequantize on the fly
         # per consumer (reference inference/quantization/; the dead "quant"
-        # knob found in round-2 review now does what it says)
+        # knob found in round-2 review now does what it says).  The store is
+        # shape-preserving (ops/quantization.quantize_weight), so it shards
+        # like the weights it replaces — quant composes with tp>1 and
+        # in_shardings stays intact (round-3 verdict item 4).
         self._materialize = None
+        self.store_shardings = self.param_shardings
         if self.config.quant.enabled:
-            if mesh.shape["tp"] > 1:
-                raise NotImplementedError(
-                    "quant.enabled with tp>1 serving is not supported yet; "
-                    "quantized weights target single-chip HBM savings")
-            from deepspeed_tpu.ops.quantization import make_param_store
+            from deepspeed_tpu.ops.quantization import (make_param_store,
+                                                        store_shardings)
             self.params, self._materialize = make_param_store(
                 self.params, bits=self.config.quant.bits,
                 block_size=self.config.quant.group_size)
+            self.store_shardings = store_shardings(
+                self.params, self.param_shardings, mesh)
+            with self.mesh:
+                self.params = jax.device_put(self.params,
+                                             self.store_shardings)
 
         mat = self._materialize or (lambda p: p)
         self._jit_forward = jax.jit(
             lambda p, ids: self.module.apply({"params": mat(p)}, ids),
-            in_shardings=None if self._materialize else (
-                self.param_shardings, NamedSharding(mesh, P())))
+            in_shardings=(self.store_shardings, NamedSharding(mesh, P())))
         self._gen_cache = {}
         log_dist(f"inference engine ready: params="
                  f"{self.num_parameters/1e6:.1f}M tp={mesh.shape['tp']} "
@@ -212,10 +217,8 @@ class InferenceEngine:
                                    jnp.arange(max_new_tokens - 1))
             return jnp.concatenate([tok0[:, None], toks.T], axis=1)
 
-        if self._materialize is not None:
-            return jax.jit(gen)
         return jax.jit(gen, in_shardings=(
-            self.param_shardings, NamedSharding(self.mesh, P()),
+            self.store_shardings, NamedSharding(self.mesh, P()),
             NamedSharding(self.mesh, P()), NamedSharding(self.mesh, P()),
             None, None))
 

@@ -70,6 +70,20 @@ class SpeculativeConfig(DeepSpeedConfigModel):
     outer_steps: int = 8        # draft+verify rounds fused per dispatch
 
 
+class V2QuantConfig(DeepSpeedConfigModel):
+    """Quantized weight serving (reference
+    inference/v2/modules/implementations/linear/quantized_linear.py W6A16 +
+    inference/quantization/layers.py matmul-time dequant): weights live in
+    HBM as int8 codes + group scales (~half the bf16 bytes) and every
+    consumer dequantizes at its use site — the bf16 tree never exists at
+    rest.  Composes with tensor parallelism (the store shards like the
+    weights it replaces)."""
+
+    enabled: bool = False
+    bits: int = 8               # int8 range; 4 narrows the grid (same bytes)
+    group_size: int = 128       # scale granularity along each weight's dim 0
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig."""
 
@@ -79,6 +93,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
         default_factory=DSStateManagerConfig)
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
+    quant: V2QuantConfig = Field(default_factory=V2QuantConfig)
 
     @classmethod
     def parse(cls, config):
@@ -179,6 +194,26 @@ class InferenceEngineV2:
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)
             else jnp.asarray(p), params)
 
+        # ---- quantized weight store (config block ``quant``): int8 codes +
+        # group scales in HBM; model.py's _w/_embed dequantize per use site
+        # (reference quantized_linear.py:205 — weights stay quantized through
+        # serving; the bf16 tree never exists at rest)
+        qc = self.config.quant
+        if qc.enabled:
+            from deepspeed_tpu.ops.quantization import (quantize_weight,
+                                                        weight_group_size)
+
+            def pack(path, p):
+                name = getattr(path[-1], "key", str(path[-1]))
+                if (name != "wpe"          # positional gather stays direct
+                        and jnp.issubdtype(p.dtype, jnp.floating)
+                        and p.ndim >= 2 and p.size >= 8 * qc.group_size
+                        and weight_group_size(p.shape, qc.group_size)):
+                    return quantize_weight(p, bits=qc.bits,
+                                           group=qc.group_size)
+                return p
+            self.params = jax.tree_util.tree_map_with_path(pack, self.params)
+
         if self.mesh is not None:
             # TP: same logical-axis rules as the v1 engine (AutoTP analog) —
             # params shard over the tp axis, attention stays per-kv-head local
@@ -197,6 +232,9 @@ class InferenceEngineV2:
             annotated = annotate_abstract(boxed["params"])
             shardings = partition.param_shardings(annotated, self.mesh,
                                                   zero_stage=0)
+            if qc.enabled:
+                from deepspeed_tpu.ops.quantization import store_shardings
+                shardings = store_shardings(self.params, shardings, self.mesh)
             self.params = jax.device_put(self.params, shardings)
 
         from deepspeed_tpu.inference.v2.model import kv_block_size_for

@@ -113,14 +113,14 @@ def _norm(p, x, cfg):
 
 
 def _mlp(p, x, cfg):
-    h = x @ p["wi"].astype(x.dtype)
+    h = x @ _w(p["wi"], x.dtype)
     if cfg.mlp_bias:
         h = h + p["bi"].astype(x.dtype)
     if cfg.gated_mlp:
-        h = mlp_activation(cfg.gate_act)(x @ p["wg"].astype(x.dtype)) * h
+        h = mlp_activation(cfg.gate_act)(x @ _w(p["wg"], x.dtype)) * h
     else:
         h = mlp_activation(cfg.activation)(h)
-    y = h @ p["wo"].astype(x.dtype)
+    y = h @ _w(p["wo"], x.dtype)
     if cfg.mlp_bias:
         y = y + p["bo"].astype(x.dtype)
     return y
@@ -139,6 +139,30 @@ def _block_residual(blk, x, h, attn_delta, cfg):
     return x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
 
 
+def _w(p, dtype):
+    """Weight accessor: dequantize a ``quantize_weight`` store leaf at its
+    USE SITE (reference quantized_linear.py:205 matmul-time dequant — the
+    full-precision tensor exists only transiently inside the layer that
+    consumes it), or cast a plain array."""
+    from deepspeed_tpu.ops.quantization import (dequantize_weight,
+                                                is_quantized_weight)
+    if is_quantized_weight(p):
+        return dequantize_weight(p, dtype)
+    return p.astype(dtype)
+
+
+def _embed(wte, tokens, dtype):
+    """Row-gather from a possibly int8-quantized table: gather codes AND the
+    gathered rows' group scales — dequant cost scales with the tokens
+    actually read, never the vocab."""
+    from deepspeed_tpu.ops.quantization import is_quantized_weight
+    if is_quantized_weight(wte):
+        v, s = wte["v"], wte["s"]
+        g = v.shape[0] // s.shape[0]
+        return (v[tokens].astype(jnp.float32) * s[tokens // g]).astype(dtype)
+    return wte.astype(dtype)[tokens]
+
+
 def _ffn(blk, x, cfg):
     """Dense MLP or MoE block body on FLAT tokens [N, H] — MoE routes through
     the dropless ragged grouped GEMM (moe/layer.py), which fits serving
@@ -149,20 +173,20 @@ def _ffn(blk, x, cfg):
         from deepspeed_tpu.moe.layer import _expert_ffn_ragged
         from deepspeed_tpu.moe.sharded_moe import dropless_topk
         mp = blk["moe"]
-        logits = x @ mp["gate"].astype(x.dtype)
+        logits = x @ _w(mp["gate"], x.dtype)
         _, idx, w = dropless_topk(logits, cfg.moe_k)
-        weg = mp["wge"].astype(x.dtype) if "wge" in mp else None
-        return _expert_ffn_ragged(x, idx, w, mp["wi"].astype(x.dtype),
-                                  mp["wo"].astype(x.dtype), weg)
+        weg = _w(mp["wge"], x.dtype) if "wge" in mp else None
+        return _expert_ffn_ragged(x, idx, w, _w(mp["wi"], x.dtype),
+                                  _w(mp["wo"], x.dtype), weg)
     return _mlp(blk["MLP_0"], x, cfg)
 
 
 def _qkv(ap, h, cfg, eq):
     """q/k/v projections with optional biases (qwen2/gpt2 checkpoints)."""
     dtype = h.dtype
-    q = jnp.einsum(eq, h, ap["wq"].astype(dtype))
-    k = jnp.einsum(eq, h, ap["wk"].astype(dtype))
-    v = jnp.einsum(eq, h, ap["wv"].astype(dtype))
+    q = jnp.einsum(eq, h, _w(ap["wq"], dtype))
+    k = jnp.einsum(eq, h, _w(ap["wk"], dtype))
+    v = jnp.einsum(eq, h, _w(ap["wv"], dtype))
     if cfg.qkv_bias:
         q = q + ap["bq"].astype(dtype)
         k = k + ap["bk"].astype(dtype)
@@ -171,7 +195,7 @@ def _qkv(ap, h, cfg, eq):
 
 
 def _attn_out(ap, o, cfg, eq):
-    y = jnp.einsum(eq, o, ap["wo"].astype(o.dtype))
+    y = jnp.einsum(eq, o, _w(ap["wo"], o.dtype))
     if cfg.attn_out_bias:
         y = y + ap["bo"].astype(o.dtype)
     return y
@@ -200,7 +224,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     valid = token_slot >= 0                # [N]
 
     # ---- embed (reference ragged_ops/embed) ----
-    x = bb["wte"].astype(dtype)[tokens]
+    x = _embed(bb["wte"], tokens, dtype)
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.embed_scale, dtype)
     if cfg.embed_norm:
@@ -307,9 +331,9 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         jnp.arange(N, dtype=jnp.int32), mode="drop")
     rows = x[last_flat]                                      # [S, H]
     if cfg.tie_embeddings:
-        unembed = bb["wte"].astype(dtype).T
+        unembed = _w(bb["wte"], dtype).T
     else:
-        unembed = params["lm_head"].astype(dtype)
+        unembed = _w(params["lm_head"], dtype)
     logits = (rows @ unembed).astype(jnp.float32)            # [S, V]
     if cfg.unembed_bias:
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
@@ -339,7 +363,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     g = nh // nkv
     km = kv_major_layout(cfg)
 
-    x = bb["wte"].astype(dtype)[tokens]                       # [S, H]
+    x = _embed(bb["wte"], tokens, dtype)                       # [S, H]
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.embed_scale, dtype)
     if cfg.embed_norm:
@@ -408,9 +432,9 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
 
     x = _norm(bb["final_norm"], x, cfg)
     if cfg.tie_embeddings:
-        unembed = bb["wte"].astype(dtype).T
+        unembed = _w(bb["wte"], dtype).T
     else:
-        unembed = params["lm_head"].astype(dtype)
+        unembed = _w(params["lm_head"], dtype)
     logits = (x @ unembed).astype(jnp.float32)                # [S, V]
     if cfg.unembed_bias:
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
@@ -589,7 +613,7 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
     quant = flat_ks is not None
 
     positions = pos0[:, None] + jnp.arange(G, dtype=jnp.int32)[None]  # [S,G]
-    x = bb["wte"].astype(dtype)[tokens]                               # [S,G,H]
+    x = _embed(bb["wte"], tokens, dtype)                               # [S,G,H]
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.embed_scale, dtype)
     if cfg.embed_norm:
@@ -655,6 +679,10 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
             jnp.where(active, G, 0).astype(jnp.int32),
             scale=cfg.attn_scale, alibi_slopes=slopes, window=win,
             mesh=mesh, kv_major=km, **kv_extra).reshape(S, G, nh, hd)
+        # inactive slots (kv_len=0, q_counts=0) produce 0/0 garbage from the
+        # kernel combine; zero them like ragged_forward does so no future
+        # cross-row op (capacity MoE, aux stats) can see NaNs from dead rows
+        o = jnp.where(active[:, None, None, None], o, 0)
         attn_delta = _attn_out(ap, o, cfg, "sgkd,kdh->sgh")
         # FFN/MoE body is token-wise and (for MoE) expects FLAT tokens
         H = x.shape[-1]
@@ -664,9 +692,9 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
 
     x = _norm(bb["final_norm"], x, cfg)
     if cfg.tie_embeddings:
-        unembed = bb["wte"].astype(dtype).T
+        unembed = _w(bb["wte"], dtype).T
     else:
-        unembed = params["lm_head"].astype(dtype)
+        unembed = _w(params["lm_head"], dtype)
     logits = (x @ unembed).astype(jnp.float32)                 # [S, G, V]
     if cfg.unembed_bias:
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
@@ -768,7 +796,11 @@ def speculative_burst(params, draft_params, cache: PagedKVCache,
                       block_size: int, gamma: int, steps: int, mesh=None):
     """GREEDY speculative decoding: acceptance is exact token match, so the
     output is token-identical to target-only greedy decoding for ANY draft
-    — the invariant the tests pin.  See _speculative_burst_core.
+    *up to floating-point argmax ties* — the verify step is a multi-token
+    (prefill-shaped) program, numerically different from the Q=1 decode
+    baseline, so near-tied logits can argmax differently on low-precision
+    hardware.  The tests pin exactness on fp32 configs.  See
+    _speculative_burst_core.
     Returns (toks, counts, prev', cache', draft_cache')."""
     toks, counts, prev, _, cache, draft_cache = _speculative_burst_core(
         params, draft_params, cache, draft_cache, batch, prev_tokens,

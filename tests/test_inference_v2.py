@@ -511,3 +511,81 @@ class TestPrefillBuckets:
         # multiple prefill programs were compiled (different mb buckets)
         mixed_keys = [k for k in eng._steps if k[0] == "mixed"]
         assert len(mixed_keys) >= 2, mixed_keys
+
+
+class TestQuantizedWeights:
+    """v2 quantized weight serving (reference
+    inference/v2/modules/implementations/linear/quantized_linear.py W6A16):
+    int8 codes + group scales in HBM, per-use-site dequant in model.py
+    _w/_embed — the bf16 tree never exists at rest."""
+
+    QCFG = {"enabled": True, "group_size": 32}
+
+    def mk(self, cfg, v2cfg, params=None, extra=None):
+        c = dict(v2cfg, quant=self.QCFG)
+        if extra:
+            c.update(extra)
+        return InferenceEngineV2(cfg, config=c, params=params, seed=0)
+
+    def test_store_is_int8_and_smaller(self, v2cfg):
+        """Realistically-shaped config (divisible vocab, ≥16 heads-dim):
+        every matmul weight quantizes and the store is ~¼ the fp32 bytes.
+        (The shared tiny fixture's vocab=97 is PRIME — its embedding can
+        never group-quantize, which is the fallback path, tested above.)"""
+        qcfg = GPTConfig.llama(num_layers=2, hidden=64, heads=16,
+                               vocab_size=128, max_seq_len=64)
+        base = InferenceEngineV2(qcfg, config=v2cfg, seed=0)
+        q = self.mk(qcfg, v2cfg, params=base.params)
+        fp_bytes = sum(l.size * l.dtype.itemsize for l in
+                       jax.tree_util.tree_leaves(base.params))
+        q_bytes = sum(l.size * l.dtype.itemsize for l in
+                      jax.tree_util.tree_leaves(q.params))
+        assert q_bytes < 0.45 * fp_bytes       # fp32 fixture → ~4x smaller
+        kinds = {l.dtype for l in jax.tree_util.tree_leaves(q.params)}
+        assert np.dtype("int8") in kinds
+
+    def test_logits_close_to_unquantized(self, cfg, v2cfg, rng):
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        q = self.mk(cfg, v2cfg, params=base.params)
+        prompts = [rng.integers(0, 97, (15,)).astype(np.int32)]
+        lb = base.put([1], prompts)[0]
+        base.flush([1])
+        lq = q.put([1], prompts)[0]
+        q.flush([1])
+        denom = np.max(np.abs(np.asarray(lb)))
+        assert np.max(np.abs(np.asarray(lb) - np.asarray(lq))) < 0.15 * denom
+
+    def test_generate_runs_all_paths(self, cfg, v2cfg, rng):
+        """prefill + decode burst + retirement over the quantized store."""
+        q = self.mk(cfg, v2cfg)
+        prompts = [rng.integers(0, 97, (10 + 5 * i,)).astype(np.int32)
+                   for i in range(6)]                 # oversubscribes 4 slots
+        outs = q.generate(prompts, max_new_tokens=[7, 9, 11, 5, 8, 6])
+        assert [len(o) for o in outs] == [7, 9, 11, 5, 8, 6]
+
+    def test_quant_tp2_token_exact_vs_tp1(self, cfg, v2cfg, rng):
+        """The quant × tp composition the round-3 verdict ordered: same int8
+        codes sharded two ways must produce identical greedy tokens."""
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        prompts = [rng.integers(0, 97, (12 + 3 * i,)).astype(np.int32)
+                   for i in range(3)]
+        q1 = self.mk(cfg, v2cfg, params=base.params)
+        got1 = q1.generate(prompts, max_new_tokens=12)
+        q2 = self.mk(cfg, v2cfg, params=base.params,
+                     extra={"tensor_parallel": {"tp_size": 2}})
+        got2 = q2.generate(prompts, max_new_tokens=12)
+        for a, b in zip(got1, got2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_speculative_composes(self, cfg, v2cfg, rng):
+        """Greedy spec decoding over a quantized target must match the
+        quantized target-only output (exact-match acceptance invariant)."""
+        base = InferenceEngineV2(cfg, config=v2cfg, seed=0)
+        prompts = [rng.integers(0, 97, (11,)).astype(np.int32)]
+        q = self.mk(cfg, v2cfg, params=base.params)
+        want = q.generate(prompts, max_new_tokens=10)
+        qs = InferenceEngineV2(cfg, config=dict(v2cfg, quant=self.QCFG),
+                               params=base.params, seed=0,
+                               draft_model=cfg, draft_params=base.params)
+        got = qs.generate(prompts, max_new_tokens=10)
+        np.testing.assert_array_equal(np.asarray(want[0]), np.asarray(got[0]))
