@@ -1,6 +1,8 @@
-"""Evoformer attention (DS4Science analog).
+"""Evoformer attention (DS4Science analog) — Pallas blockwise kernel + XLA
+ground truth.
 
-Reference parity: ``csrc/deepspeed4science/evoformer_attn/`` +
+Reference parity: ``csrc/deepspeed4science/evoformer_attn/`` (CUTLASS fMHA,
+14.9k LoC — kernel_forward.h / kernel_backward.h) +
 ``deepspeed/ops/deepspeed4science/evoformer_attn.py`` — AlphaFold2-style
 attention over [B, N, S, H, D] (N = MSA rows / residue pairs) with two
 broadcastable bias terms folded into the logits:
@@ -9,41 +11,464 @@ broadcastable bias terms folded into the logits:
     bias1: [B, N, 1, 1, S]   (per-key mask bias, e.g. -1e9 padding)
     bias2: [B, 1, H, S, S]   (pair-representation bias, shared over N)
 
-The reference builds this on CUTLASS fMHA; on TPU the fused einsum chain is
-exactly what XLA maps onto the MXU, and the bias adds fuse into the softmax —
-the op exists for API/semantics parity and as the numeric ground truth for a
-future Pallas blockwise version at long S.
+The reference subtree exists to avoid materializing the [B, N, H, S, S]
+logits at long S; ``evoformer_attention`` here does the same with a
+flash-style online-softmax Pallas kernel: (bq, bk) logit tiles live only in
+VMEM, the two bias terms stream in per tile (bias2 is itself S×S but only
+[bq, bk] of it is resident), and the forward saves just the per-row
+logsumexp.  Peak HBM is O(B·N·S·H·D + B·H·S²·|bias2|) instead of
+O(B·N·H·S²) — the N-factor on the score tensor is gone.
+
+Backward is four Pallas passes sharing one tile recompute recipe: dq (and
+dk/dv) mirror ops/flash_attention.py; dbias2 accumulates ds over the N MSA
+rows with N innermost in the grid; dbias1 accumulates ds over heads and
+query rows.  Unused bias cotangents DCE away under jit.
+
+``_evoformer_xla`` keeps the einsum ground truth for numerics tests and
+unsupported shapes.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _evoformer_xla(q, k, v, bias1=None, bias2=None):
+    """Numeric ground truth: full [B, N, H, S, S] fp32 logits (the memory
+    shape the Pallas kernel exists to avoid)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if bias1 is not None:
+        logits = logits + jnp.asarray(bias1, jnp.float32)
+    if bias2 is not None:
+        logits = logits + jnp.asarray(bias2, jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _block_sizes(s: int, prefer: int = 256):
+    for b in (prefer, 256, 128, 64, 32, 16, 8):
+        if b <= s and s % b == 0:
+            return b
+    return None
+
+
+def supported(q, k, v, bias1=None, bias2=None):
+    if q.ndim != 5 or k.shape != q.shape or v.shape != q.shape:
+        return False
+    s, d = q.shape[2], q.shape[4]
+    return _block_sizes(s) is not None and d % 8 == 0 and s >= 8
+
+
+def _tile_scores(q, k, b1_ref, b2_ref, scale, has_b1, has_b2):
+    """One [bq, bk] logit tile: scaled q·kᵀ + streamed bias tiles."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if has_b1:
+        s = s + b1_ref[0, 0].astype(jnp.float32)       # [1, bk] → rows
+    if has_b2:
+        s = s + b2_ref[0, 0].astype(jnp.float32)       # [bq, bk]
+    return s
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, b1_ref, b2_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, has_b1, has_b2):
+    ik, nk = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    s = _tile_scores(q_ref[0, 0], k_ref[0, 0], b1_ref, b2_ref, scale,
+                     has_b1, has_b2)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # a fully -inf-masked row (bias1 = -1e9 padding over every key) must not
+    # alias exp(-inf − -inf) to 1
+    p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    v = v_ref[0, 0]
+    pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc_scr[...] = acc_scr[...] * alpha + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+
+
+def _layout(q, k, v, bias1, bias2):
+    """[B, N, S, H, D] → kernel layout [BN, H, S, D] (+ flattened biases)."""
+    b, n, s, h, d = q.shape
+    qt = q.transpose(0, 1, 3, 2, 4).reshape(b * n, h, s, d)
+    kt = k.transpose(0, 1, 3, 2, 4).reshape(b * n, h, s, d)
+    vt = v.transpose(0, 1, 3, 2, 4).reshape(b * n, h, s, d)
+    b1 = (jnp.broadcast_to(jnp.asarray(bias1), (b, n, 1, 1, s))
+          .reshape(b * n, 1, s) if bias1 is not None else
+          jnp.zeros((1, 1, 8), jnp.float32))
+    b2 = (jnp.broadcast_to(jnp.asarray(bias2), (b, 1, h, s, s))
+          .reshape(b, h, s, s) if bias2 is not None else
+          jnp.zeros((1, 1, 8, 8), jnp.float32))
+    return qt, kt, vt, b1, b2
+
+
+def _bias_specs(bq, bk, n, has_b1, has_b2):
+    """Index maps for the streamed bias tiles on the (bn, h, iq, ik) grid."""
+    b1_spec = (pl.BlockSpec((1, 1, bk), lambda bn, h, iq, ik: (bn, 0, ik))
+               if has_b1 else
+               pl.BlockSpec((1, 1, 8), lambda bn, h, iq, ik: (0, 0, 0)))
+    b2_spec = (pl.BlockSpec((1, 1, bq, bk),
+                            lambda bn, h, iq, ik: (bn // n, h, iq, ik))
+               if has_b2 else
+               pl.BlockSpec((1, 1, 8, 8), lambda bn, h, iq, ik: (0, 0, 0, 0)))
+    return b1_spec, b2_spec
+
+
+def _fwd(q, k, v, bias1, bias2, interpret):
+    b, n, s, h, d = q.shape
+    qt, kt, vt, b1, b2 = _layout(q, k, v, bias1, bias2)
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    bq = bk = _block_sizes(s)
+    scale = d ** -0.5
+    qkv_spec = pl.BlockSpec((1, 1, bq, d), lambda bn, h_, iq, ik: (bn, h_, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda bn, h_, iq, ik: (bn, h_, ik, 0))
+    b1_spec, b2_spec = _bias_specs(bq, bk, n, has_b1, has_b2)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, has_b1=has_b1,
+                          has_b2=has_b2),
+        grid=(b * n, h, s // bq, s // bk),
+        in_specs=[qkv_spec, kv_spec, kv_spec, b1_spec, b2_spec],
+        out_specs=[
+            qkv_spec,
+            pl.BlockSpec((1, 1, 1, bq), lambda bn, h_, iq, ik: (bn, h_, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * n, h, 1, s), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, b1, b2)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _tile_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b1_ref, b2_ref,
+             *, scale, has_b1, has_b2):
+    """Shared backward tile recompute: (p, ds) for one (bq, bk) tile.
+    ds is the UNSCALED logit cotangent (bias grads); q/k grads multiply by
+    ``scale`` at their use sites."""
+    s = _tile_scores(q_ref[0, 0], k_ref[0, 0], b1_ref, b2_ref, scale,
+                     has_b1, has_b2)
+    lse = lse_ref[0, 0, 0][:, None]
+    p = jnp.exp(s - lse)
+    p = jnp.where(lse > _NEG_INF / 2, p, 0.0)
+    do = do_ref[0, 0]
+    dp = jax.lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, 0, 0][:, None])
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b1_ref,
+               b2_ref, dq_ref, dq_scr, *, scale, has_b1, has_b2):
+    ik, nk = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    _, ds = _tile_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     b1_ref, b2_ref, scale=scale, has_b1=has_b1,
+                     has_b2=has_b2)
+    k = k_ref[0, 0]
+    dq_scr[...] += scale * jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b1_ref,
+                b2_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, has_b1,
+                has_b2):
+    iq, nq = pl.program_id(3), pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    p, ds = _tile_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     b1_ref, b2_ref, scale=scale, has_b1=has_b1,
+                     has_b2=has_b2)
+    do = do_ref[0, 0]
+    q = q_ref[0, 0]
+    dv_scr[...] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                       (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+    dk_scr[...] += scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _db2_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b1_ref,
+                b2_ref, db2_ref, db2_scr, *, scale, has_b1, has_b2):
+    """dbias2[b, h, q, k] = Σ_n ds — N is the innermost (arbitrary) grid dim
+    so the sum accumulates in VMEM while the output tile stays put."""
+    jn, nn = pl.program_id(4), pl.num_programs(4)
+
+    @pl.when(jn == 0)
+    def _init():
+        db2_scr[...] = jnp.zeros(db2_scr.shape, jnp.float32)
+
+    _, ds = _tile_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     b1_ref, b2_ref, scale=scale, has_b1=has_b1,
+                     has_b2=has_b2)
+    db2_scr[...] += ds
+
+    @pl.when(jn == nn - 1)
+    def _finalize():
+        db2_ref[0, 0] = db2_scr[...].astype(db2_ref.dtype)
+
+
+def _db1_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, b1_ref,
+                b2_ref, db1_ref, db1_scr, *, scale, has_b1, has_b2):
+    """dbias1[bn, k] = Σ_{h, q} ds — (h, iq) fused innermost."""
+    j, nj = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        db1_scr[...] = jnp.zeros(db1_scr.shape, jnp.float32)
+
+    _, ds = _tile_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     b1_ref, b2_ref, scale=scale, has_b1=has_b1,
+                     has_b2=has_b2)
+    db1_scr[:1, :] += jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        db1_ref[0, 0] = db1_scr[:1, :][0].astype(db1_ref.dtype)
+
+
+def _bwd_impl(q, k, v, bias1, bias2, o, lse, do, interpret):
+    b, n, s, h, d = q.shape
+    qt, kt, vt, b1, b2 = _layout(q, k, v, bias1, bias2)
+    dot = do.transpose(0, 1, 3, 2, 4).reshape(b * n, h, s, d)
+    ot = o.transpose(0, 1, 3, 2, 4).reshape(b * n, h, s, d)
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    bq = bk = _block_sizes(s)
+    scale = d ** -0.5
+    delta = jnp.sum(ot.astype(jnp.float32) * dot.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]                  # [BN, H, 1, S]
+    kw = dict(scale=scale, has_b1=has_b1, has_b2=has_b2)
+    sem = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda bn, h_, iq, ik: (bn, h_, iq, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, d), lambda bn, h_, iq, ik: (bn, h_, ik, 0))
+    row_spec = pl.BlockSpec((1, 1, 1, bq),
+                            lambda bn, h_, iq, ik: (bn, h_, 0, iq))
+    b1_spec, b2_spec = _bias_specs(bq, bk, n, has_b1, has_b2)
+    args = (qt, kt, vt, dot, lse, delta, b1, b2)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        grid=(b * n, h, s // bq, s // bk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
+                  b1_spec, b2_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * n, h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=sem, interpret=interpret)(*args)
+
+    # dkv: swap loop order — (bn, h, ik, iq), q-blocks innermost
+    q_spec2 = pl.BlockSpec((1, 1, bq, d),
+                           lambda bn, h_, ik, iq: (bn, h_, iq, 0))
+    k_spec2 = pl.BlockSpec((1, 1, bk, d),
+                           lambda bn, h_, ik, iq: (bn, h_, ik, 0))
+    row_spec2 = pl.BlockSpec((1, 1, 1, bq),
+                             lambda bn, h_, ik, iq: (bn, h_, 0, iq))
+    b1_spec2 = (pl.BlockSpec((1, 1, bk), lambda bn, h_, ik, iq: (bn, 0, ik))
+                if has_b1 else
+                pl.BlockSpec((1, 1, 8), lambda bn, h_, ik, iq: (0, 0, 0)))
+    b2_spec2 = (pl.BlockSpec((1, 1, bq, bk),
+                             lambda bn, h_, ik, iq: (bn // n, h_, iq, ik))
+                if has_b2 else
+                pl.BlockSpec((1, 1, 8, 8),
+                             lambda bn, h_, ik, iq: (0, 0, 0, 0)))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kw),
+        grid=(b * n, h, s // bk, s // bq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2,
+                  b1_spec2, b2_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b * n, h, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * n, h, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=sem, interpret=interpret)(*args)
+
+    db1 = db2 = None
+    if has_b2:
+        # grid (b, h, iq, ik, n): n innermost accumulates Σ_n in VMEM
+        db2 = pl.pallas_call(
+            functools.partial(_db2_kernel, **kw),
+            grid=(b, h, s // bq, s // bk, n),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h_, iq, ik, jn: (b_ * n + jn, h_, iq, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda b_, h_, iq, ik, jn: (b_ * n + jn, h_, ik, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda b_, h_, iq, ik, jn: (b_ * n + jn, h_, ik, 0)),
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h_, iq, ik, jn: (b_ * n + jn, h_, iq, 0)),
+                pl.BlockSpec((1, 1, 1, bq),
+                             lambda b_, h_, iq, ik, jn: (b_ * n + jn, h_, 0, iq)),
+                pl.BlockSpec((1, 1, 1, bq),
+                             lambda b_, h_, iq, ik, jn: (b_ * n + jn, h_, 0, iq)),
+                (pl.BlockSpec((1, 1, bk),
+                              lambda b_, h_, iq, ik, jn: (b_ * n + jn, 0, ik))
+                 if has_b1 else
+                 pl.BlockSpec((1, 1, 8),
+                              lambda b_, h_, iq, ik, jn: (0, 0, 0))),
+                pl.BlockSpec((1, 1, bq, bk),
+                             lambda b_, h_, iq, ik, jn: (b_, h_, iq, ik)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, bq, bk), lambda b_, h_, iq, ik, jn: (b_, h_, iq, ik)),
+            out_shape=jax.ShapeDtypeStruct((b, h, s, s), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((bq, bk), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "parallel", "arbitrary")),
+            interpret=interpret)(*args)
+        db2 = db2.reshape(b, 1, h, s, s)
+    if has_b1:
+        nqb = s // bq
+        db1 = pl.pallas_call(
+            functools.partial(_db1_kernel, **kw),
+            grid=(b * n, s // bk, h * nqb),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda bn, ik, j: (bn, j // nqb, j % nqb, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda bn, ik, j: (bn, j // nqb, ik, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda bn, ik, j: (bn, j // nqb, ik, 0)),
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda bn, ik, j: (bn, j // nqb, j % nqb, 0)),
+                pl.BlockSpec((1, 1, 1, bq),
+                             lambda bn, ik, j: (bn, j // nqb, 0, j % nqb)),
+                pl.BlockSpec((1, 1, 1, bq),
+                             lambda bn, ik, j: (bn, j // nqb, 0, j % nqb)),
+                (pl.BlockSpec((1, 1, bk), lambda bn, ik, j: (bn, 0, ik))
+                 if has_b1 else
+                 pl.BlockSpec((1, 1, 8), lambda bn, ik, j: (0, 0, 0))),
+                (pl.BlockSpec((1, 1, bq, bk),
+                              lambda bn, ik, j: (bn // n, j // nqb, j % nqb,
+                                                 ik))
+                 if has_b2 else
+                 pl.BlockSpec((1, 1, 8, 8), lambda bn, ik, j: (0, 0, 0, 0))),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bk), lambda bn, ik, j: (bn, 0, ik)),
+            out_shape=jax.ShapeDtypeStruct((b * n, 1, s), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((8, bk), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret)(*args)
+        db1 = db1.reshape(b, n, 1, 1, s)
+    return dq, dk, dv, db1, db2
+
+
+# ------------------------------------------------------- custom_vjp plumbing
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _evo(q, k, v, b1, b2, has_b1, has_b2, interpret):
+    o, _ = _fwd(q, k, v, b1 if has_b1 else None, b2 if has_b2 else None,
+                interpret)
+    b, n, s, h, d = q.shape
+    return o.reshape(b, n, h, s, d).transpose(0, 1, 3, 2, 4)
+
+
+def _evo_fwd(q, k, v, b1, b2, has_b1, has_b2, interpret):
+    o, lse = _fwd(q, k, v, b1 if has_b1 else None, b2 if has_b2 else None,
+                  interpret)
+    b, n, s, h, d = q.shape
+    out = o.reshape(b, n, h, s, d).transpose(0, 1, 3, 2, 4)
+    return out, (q, k, v, b1, b2, out, lse)
+
+
+def _evo_bwd(has_b1, has_b2, interpret, res, do):
+    q, k, v, b1, b2, o, lse = res
+    dq, dk, dv, db1, db2 = _bwd_impl(
+        q, k, v, b1 if has_b1 else None, b2 if has_b2 else None, o, lse, do,
+        interpret)
+    b, n, s, h, d = q.shape
+    un = lambda x: x.reshape(b, n, h, s, d).transpose(0, 1, 3, 2, 4)  # noqa: E731
+    db1 = (db1.astype(b1.dtype) if has_b1 else jnp.zeros_like(b1))
+    db2 = (db2.astype(b2.dtype) if has_b2 else jnp.zeros_like(b2))
+    return un(dq), un(dk), un(dv), db1, db2
+
+
+_evo.defvjp(_evo_fwd, _evo_bwd)
 
 
 def evoformer_attention(q, k, v, bias1: Optional[jax.Array] = None,
-                        bias2: Optional[jax.Array] = None):
+                        bias2: Optional[jax.Array] = None,
+                        interpret: Optional[bool] = None):
     """q/k/v: [B, N, S, H, D]; bias1 broadcastable to [B, N, 1, 1, S];
     bias2 broadcastable to [B, 1, H, S, S].  Returns [B, N, S, H, D].
 
     reference evoformer_attn.py:DS4Sci_EvoformerAttention (inputs validated
-    the same way: 5-D tensors, biases optional)."""
+    the same way: 5-D tensors, biases optional).  Dispatches to the Pallas
+    blockwise kernel (module docstring) when shapes allow; einsum ground
+    truth otherwise."""
     if q.ndim != 5:
         raise ValueError(f"evoformer attention expects [B, N, S, H, D] "
                          f"tensors, got rank {q.ndim}")
-    scale = q.shape[-1] ** -0.5
-    # [B, N, H, S, S]
-    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    if bias1 is not None:
-        # [B, N, 1, 1, S] broadcasts over heads + query positions
-        logits = logits + jnp.asarray(bias1, jnp.float32)
-    if bias2 is not None:
-        # [B, 1, H, S, S] broadcasts over N
-        logits = logits + jnp.asarray(bias2, jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bnhqk,bnkhd->bnqhd", probs,
-                     v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    if not supported(q, k, v, bias1, bias2):
+        return _evoformer_xla(q, k, v, bias1, bias2)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, n, s, h, d = q.shape
+    has_b1, has_b2 = bias1 is not None, bias2 is not None
+    b1 = (jnp.broadcast_to(jnp.asarray(bias1), (b, n, 1, 1, s))
+          if has_b1 else jnp.zeros((1,), jnp.float32))
+    b2 = (jnp.broadcast_to(jnp.asarray(bias2), (b, 1, h, s, s))
+          if has_b2 else jnp.zeros((1,), jnp.float32))
+    return _evo(q, k, v, b1, b2, has_b1, has_b2, bool(interpret))

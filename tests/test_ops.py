@@ -236,6 +236,24 @@ class TestChunkedCrossEntropy:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-6, rtol=1e-5)
 
+    def test_fused_loss_only_is_dced(self, rng):
+        """Loss-only callers (eval_batch) of the FUSED path must not pay for
+        the in-forward gx/dW gradient GEMMs — XLA scan DCE strips the unused
+        carry/outputs.  Pin it with compiled cost analysis: fused loss-only
+        FLOPs == non-fused loss-only FLOPs (ADVICE r3 #4 — if this ever
+        breaks, route loss-only callers through fused=False instead)."""
+        B, T, H, V = 4, 128, 64, 1000
+        x = jnp.asarray(rng.standard_normal((B, T, H)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((H, V)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32)
+        mask = jnp.ones((B, T), jnp.float32)
+
+        def flops(fused):
+            f = jax.jit(lambda x_, w_: ops.lm_cross_entropy(
+                x_, w_, labels, mask, chunk_size=128, fused=fused))
+            return f.lower(x, w).compile().cost_analysis()["flops"]
+        assert flops(True) <= flops(False) * 1.01
+
     def test_fused_matches_remat_with_bias(self, rng):
         """The fused in-forward-gradient path must match the jax.checkpoint
         remat path (loss AND x/w/bias grads), including the unembed bias."""
@@ -853,3 +871,67 @@ class TestEvoformer:
         with pytest.raises(ValueError, match="B, N, S, H, D"):
             evoformer_attention(jnp.zeros((2, 3, 4)), jnp.zeros((2, 3, 4)),
                                 jnp.zeros((2, 3, 4)))
+
+    def test_pallas_kernel_matches_xla(self, rng):
+        """Blockwise kernel (round-3 verdict item 6) vs the einsum ground
+        truth — forward AND every gradient (dq/dk/dv/dbias1/dbias2)."""
+        from deepspeed_tpu.ops.evoformer import (_evoformer_xla,
+                                                 evoformer_attention,
+                                                 supported)
+        B, N, S, H, D = 2, 3, 32, 2, 8
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        bias1 = jnp.asarray(rng.standard_normal((B, N, 1, 1, S)), jnp.float32)
+        bias2 = jnp.asarray(rng.standard_normal((B, 1, H, S, S)), jnp.float32)
+        assert supported(q, k, v)                 # really the Pallas path
+
+        got = evoformer_attention(q, k, v, bias1, bias2)
+        want = _evoformer_xla(q, k, v, bias1, bias2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+        def loss(fn):
+            return lambda q_, k_, v_, b1, b2: jnp.sum(
+                fn(q_, k_, v_, b1, b2) * 0.01)
+        gp = jax.grad(loss(evoformer_attention), argnums=(0, 1, 2, 3, 4))(
+            q, k, v, bias1, bias2)
+        gx = jax.grad(loss(_evoformer_xla), argnums=(0, 1, 2, 3, 4))(
+            q, k, v, bias1, bias2)
+        for name, a, b in zip(("dq", "dk", "dv", "dbias1", "dbias2"), gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, err_msg=name)
+
+    def test_pallas_bias_subsets(self, rng):
+        """bias1-only, bias2-only, and no-bias variants all hit the kernel
+        and match the ground truth."""
+        from deepspeed_tpu.ops.evoformer import (_evoformer_xla,
+                                                 evoformer_attention)
+        B, N, S, H, D = 1, 2, 16, 2, 8
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        bias1 = jnp.asarray(rng.standard_normal((B, N, 1, 1, S)), jnp.float32)
+        bias2 = jnp.asarray(rng.standard_normal((B, 1, H, S, S)), jnp.float32)
+        for b1, b2 in ((bias1, None), (None, bias2), (None, None)):
+            got = evoformer_attention(q, k, v, b1, b2)
+            want = _evoformer_xla(q, k, v, b1, b2)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-5)
+
+    def test_pallas_fully_masked_row(self, rng):
+        """A row whose every key carries the -1e9 mask bias: softmax over
+        uniformly masked logits is uniform (standard softmax semantics, and
+        what the XLA path computes) — the kernel must agree and stay
+        NaN-free in forward and grads (the exp rescaling guard)."""
+        from deepspeed_tpu.ops.evoformer import (_evoformer_xla,
+                                                 evoformer_attention)
+        B, N, S, H, D = 1, 2, 16, 1, 8
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        bias1 = jnp.zeros((B, N, 1, 1, S)).at[:, 0].set(-1e9)  # row 0 all dead
+        out = evoformer_attention(q, k, v, bias1)
+        assert not np.any(np.isnan(np.asarray(out)))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_evoformer_xla(q, k, v, bias1)),
+                                   atol=2e-5)
+        g = jax.grad(lambda q_: jnp.sum(evoformer_attention(q_, k, v, bias1)))(q)
+        assert not np.any(np.isnan(np.asarray(g)))
