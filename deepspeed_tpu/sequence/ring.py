@@ -17,11 +17,27 @@ TPU-native shape: one ``shard_map`` over ``sp``; inside, a differentiable
   - ``ppermute``s the K/V block to the next neighbor (ICI ring — the same
     link pattern the hardware torus provides natively).
 
-Causality note: blocks strictly "ahead" of the local Q block contribute
-nothing but are still rotated through (the ring must complete); their scores
-are fully masked.  A compute-skipping schedule (zig-zag/striped sharding) is
-a later optimization — the wire cost is already optimal (each device sends
-exactly its KV bytes sp-1 times, neighbor-only).
+Causality note (contiguous schedule): blocks strictly "ahead" of the local Q
+block contribute nothing but are still rotated through (the ring must
+complete); their scores are fully masked — ~half the FLOPs are dead on
+causal attention.
+
+``schedule="zigzag"`` (round-3 verdict item 8) removes that waste: each
+device owns chunks (d, 2·sp−1−d) of the sequence (the zig-zag placement from
+zigzag ring attention / llama-3 context parallelism).  At every ring step
+exactly TWO of the four (q-chunk × kv-chunk) sub-blocks are causally live,
+and — because liveness depends only on (my, src), not on token positions —
+they are FULLY live: steps 1..sp−1 run two mask-free half-size attends
+(balanced across devices), and only step 0 pays within-chunk diagonal masks.
+FLOPs drop to ~(2·sp+1)/(4·sp) ≈ 55% of the contiguous schedule; the ring's
+own wire cost is unchanged (each device still sends its KV bytes sp−1 times,
+neighbor-only), but the convenience permutation in/out of zig-zag layout —
+applied inside the call so the public contract (contiguous [B, T, H, D],
+token-exact vs dense) is identical — adds ~4 tensor-sized cross-device
+reshuffles per call (q/k/v in, o out; again in backward), booked to the
+comms logger.  A training stack that keeps activations in zig-zag layout
+end-to-end (permute tokens + positions once at the embedding) amortizes
+that to zero; this entry point trades that for drop-in exactness.
 """
 
 from __future__ import annotations
@@ -87,13 +103,108 @@ def _ring_body(q, k0, v0, my, sp_size, axis, causal, scale):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _zigzag_body(q, k0, v0, my, sp_size, axis, scale):
+    """Causal ring over the zig-zag placement: the local block holds chunks
+    (a=my, b=2·sp−1−my) as rows [:c] / [c:].  Block-level liveness depends
+    only on (my, src), so steps 1..sp−1 run exactly two MASK-FREE half-size
+    attends; only step 0 (own chunks) pays diagonal masks.  ~½ the FLOPs of
+    the contiguous schedule at identical wire cost (module docstring)."""
+    B, T2, H, D = q.shape
+    c = T2 // 2
+    qf = q.astype(jnp.float32)
+    qa, qb = qf[:, :c], qf[:, c:]
+    perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+
+    def scores(qh, kc):                                   # [B, H, c, c]
+        return jnp.einsum("bqhd,bkhd->bhqk", qh,
+                          kc.astype(jnp.float32)) * scale
+
+    def fold(stats, h_idx, s_log, vc):
+        """Online-softmax fold of one sub-block into half ``h_idx``'s stats
+        (h_idx may be traced — stats are stacked [2, ...])."""
+        m, l, acc = stats
+        mh = lax.dynamic_index_in_dim(m, h_idx, 0, keepdims=False)
+        lh = lax.dynamic_index_in_dim(l, h_idx, 0, keepdims=False)
+        ah = lax.dynamic_index_in_dim(acc, h_idx, 0, keepdims=False)
+        m_new = jnp.maximum(mh, jnp.max(s_log, axis=-1))
+        p = jnp.exp(s_log - m_new[..., None])
+        alpha = jnp.exp(mh - m_new)
+        l_new = lh * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+        a_new = ah * alpha[..., None] + pv
+        return (lax.dynamic_update_index_in_dim(m, m_new, h_idx, 0),
+                lax.dynamic_update_index_in_dim(l, l_new, h_idx, 0),
+                lax.dynamic_update_index_in_dim(acc, a_new, h_idx, 0))
+
+    # step 0 — own chunks: qa×ka (diag), qb×ka (full: a < sp ≤ b), qb×kb (diag)
+    ka, kb = k0[:, :c], k0[:, c:]
+    va, vb = v0[:, :c], v0[:, c:]
+    tri = (jnp.arange(c)[:, None] >= jnp.arange(c)[None, :])[None, None]
+    m0 = jnp.full((2, B, H, c), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((2, B, H, c), jnp.float32)
+    acc0 = jnp.zeros((2, B, H, c, D), jnp.float32)
+    stats = (m0, l0, acc0)
+    stats = fold(stats, 0, jnp.where(tri, scores(qa, ka), _NEG), va)
+    stats = fold(stats, 1, scores(qb, ka), va)
+    stats = fold(stats, 1, jnp.where(tri, scores(qb, kb), _NEG), vb)
+
+    def step(carry, s):
+        stats, kprev, vprev = carry
+        # rotate FIRST: at step s the resident block must come from
+        # src = (my − s) mod sp (step 0 consumed the un-rotated own block)
+        kcur = lax.ppermute(kprev, axis, perm)
+        vcur = lax.ppermute(vprev, axis, perm)
+        src = (my - s) % sp_size
+        ka_, kb_ = kcur[:, :c], kcur[:, c:]
+        va_, vb_ = vcur[:, :c], vcur[:, c:]
+        # visiting early chunk a' = src: live for qb always; for qa iff
+        # src < my.  visiting late chunk b' = 2sp−1−src: live iff src > my
+        # (then b' < b), and only for qb.  Exactly two fully-live sub-blocks.
+        stats = fold(stats, 1, scores(qb, ka_), va_)
+        early = src < my
+        h2 = jnp.where(early, 0, 1).astype(jnp.int32)
+        q2 = jnp.where(early, qa, qb)
+        k2 = jnp.where(early, ka_, kb_)
+        v2 = jnp.where(early, va_, vb_)
+        stats = fold(stats, h2, scores(q2, k2), v2)
+        return (stats, kcur, vcur), None
+
+    (stats, _, _), _ = lax.scan(jax.checkpoint(step), (stats, k0, v0),
+                                jnp.arange(1, sp_size))
+    m, l, acc = stats
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l[..., None]                        # [2, B, H, c, D]
+    out = jnp.concatenate([out[0], out[1]], axis=2)  # [B, H, 2c, D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _zigzag_perm(t: int, sp: int):
+    """Global index permutation placing chunks (d, 2sp−1−d) on device d."""
+    import numpy as np
+    c = t // (2 * sp)
+    chunks = np.arange(t).reshape(2 * sp, c)
+    order = []
+    for d in range(sp):
+        order += [d, 2 * sp - 1 - d]
+    idx = chunks[order].reshape(-1)
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(t)
+    return jnp.asarray(idx), jnp.asarray(inv)
+
+
 def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
                    axis: str = "sp", batch_axes=("dp", "fsdp"),
-                   scale=None):
+                   scale=None, schedule: str = "zigzag"):
     """Global-view entry: q/k/v [B, T, H, D] with T sharded over ``axis``.
 
     Equivalent math to full softmax attention (tested token-exact vs the
-    dense path); peak per-device score memory is [B, H, T/sp, T/sp]."""
+    dense path); peak per-device score memory is [B, H, T/sp, T/sp]
+    (contiguous) or 3×[B, H, T/2sp, T/2sp] (zigzag).
+
+    ``schedule``: "zigzag" (default — causal FLOPs ≈ halved, module
+    docstring) or "contiguous".  Zig-zag needs T % (2·sp) == 0 and causal;
+    other cases fall back to the contiguous schedule.
+    """
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if sp == 1:
@@ -102,6 +213,9 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     if q.shape[1] % sp:
         raise ValueError(f"seq len {q.shape[1]} not divisible by "
                          f"{axis}={sp}")
+    if schedule not in ("zigzag", "contiguous"):
+        raise ValueError(f"schedule must be zigzag|contiguous, "
+                         f"got {schedule!r}")
     if k.shape[2] != q.shape[2]:
         # GQA: expand KV to the query head count before the ring (the rotated
         # blocks then carry nh heads instead of nkv — a grouped in-ring score
@@ -113,6 +227,24 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
                         (k.size + v.size) * k.dtype.itemsize // sp * (sp - 1),
                         axis)
     spec = P(batch_axes, axis, None, None)
+    zig = (schedule == "zigzag" and causal and q.shape[1] % (2 * sp) == 0)
+
+    if zig:
+        idx, inv = _zigzag_perm(q.shape[1], sp)
+        # the in/out zig-zag permutes reshard across sp — real wire traffic
+        # (≈4 tensor volumes per call), booked separately from the ring hops
+        comms_logger.record(
+            "ring_attention_zigzag_permute",
+            (q.size * 3 + q.size) * q.dtype.itemsize, axis)
+        qz, kz, vz = (jnp.take(x, idx, axis=1) for x in (q, k, v))
+
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def inner_z(q_, k_, v_):
+            my = lax.axis_index(axis)
+            return _zigzag_body(q_, k_, v_, my, sp, axis, scale)
+
+        return jnp.take(inner_z(qz, kz, vz), inv, axis=1)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)

@@ -60,3 +60,34 @@ def test_sp_gpt_trains(devices):
         idx = rng.integers(0, 8, size=(engine.train_batch_size,))
         losses.append(float(engine.train_batch({"input_ids": pool[idx]}).loss))
     assert losses[-1] < losses[0] * 0.8
+
+
+def test_layout_matrix(devices):
+    """Round-3 verdict item 8: scatter/gather layout generality (reference
+    DistributedAttention(scatter_idx, gather_idx)).  Seq-first [T, B, H, D]
+    and the default [B, T, H, D] must both match local attention."""
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    B, T, N, D = 4, 32, 8, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (B, T, N, D))
+    k = jax.random.normal(k2, (B, T, N, D))
+    v = jax.random.normal(k3, (B, T, N, D))
+    ref = causal_attend(q, k, v)
+
+    # seq-first layout: attn_fn sees [T, B, H/sp, D]; wrap causal_attend
+    def attn_tbhd(q_, k_, v_):
+        sw = lambda x: x.swapaxes(0, 1)  # noqa: E731
+        return sw(causal_attend(sw(q_), sw(k_), sw(v_)))
+
+    qt, kt, vt = (x.swapaxes(0, 1) for x in (q, k, v))
+    with mesh:
+        da = DistributedAttention(attn_tbhd, mesh, scatter_idx=2,
+                                  gather_idx=0)
+        out = jax.jit(da)(qt, kt, vt).swapaxes(0, 1)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-5, atol=2e-6)
+
+    import pytest
+    with pytest.raises(ValueError, match="distinct dims"):
+        ulysses_attention(causal_attend, mesh, q, k, v,
+                          scatter_idx=1, gather_idx=1)
