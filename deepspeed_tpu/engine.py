@@ -150,6 +150,7 @@ class DeepSpeedTPUEngine:
         # with an int8-wire all-to-all (_qgz_grads) instead of the
         # partitioner's implicit fp32 reduce-scatter.
         self._qgz_axis = None
+        self._qgz_partial_manual = False
         if config.zero_optimization.zero_quantized_gradients:
             model_axes = {a: mesh.shape[a] for a in ("tp", "sp", "ep", "pp")
                           if mesh.shape[a] > 1}
@@ -159,34 +160,60 @@ class DeepSpeedTPUEngine:
                     "zero_quantized_gradients requires zero stage >= 2 "
                     "(gradients must be partitioned for the quantized "
                     "reduce-scatter to have a scatter target)")
-            if self.zero_stage >= 3:
-                raise NotImplementedError(
-                    "zero_quantized_gradients at stage 3 is unsupported: "
-                    "params are fsdp-sharded, so the grad reduce is fused "
-                    "with the param gather by the partitioner; use stage 2 "
-                    "(the reference's qgZ likewise targets the cross-node "
-                    "data-parallel reduce)")
             if model_axes:
+                # ANY stage: the engine runs the loss with the model UNBOUND
+                # from the mesh under qgZ (see the bind site below) — sp/tp/
+                # ep features would silently no-op, so reject loudly
                 raise NotImplementedError(
                     f"zero_quantized_gradients composes with data-parallel "
                     f"meshes only (model-parallel axes {model_axes} would "
-                    f"need their collectives re-derived inside the manual "
-                    f"grad shard_map)")
-            if len(data_axes) > 1:
+                    f"need the model's mesh-bound collectives to coexist "
+                    f"with the manual grad shard_map)")
+            if self.zero_stage >= 3:
+                # stage 3: the fsdp grad reduce-scatter is fused with the
+                # param gather by the partitioner and rides intra-group ICI;
+                # qgZ compresses the CROSS-REPLICA dp reduce (MiCS/hpZ
+                # cross-group traffic — the reference qgZ's actual target,
+                # ZeRO++ hierarchical design).  shard_map runs manual over
+                # dp ONLY; fsdp stays auto under GSPMD.
+                if mesh.shape["dp"] > 1:
+                    self._qgz_axis = "dp"
+                    self._qgz_partial_manual = True
+                else:
+                    logger.warning(
+                        "zero_quantized_gradients at stage 3 with dp=1: the "
+                        "only gradient reduce is the intra-group fsdp "
+                        "reduce-scatter fused with the param gather — "
+                        "nothing to quantize; flag is inert on this mesh "
+                        "(add a dp axis / MiCS grouping for cross-group "
+                        "compression)")
+            elif len(data_axes) > 1:
                 raise NotImplementedError(
                     "zero_quantized_gradients over two data axes (dp AND "
-                    "fsdp both > 1) is unsupported; fold data parallelism "
-                    "into one axis")
-            if not data_axes:
+                    "fsdp both > 1) is unsupported at stage 2; fold data "
+                    "parallelism into one axis")
+            elif not data_axes:
                 logger.warning(
                     "zero_quantized_gradients set but the data-parallel "
                     "world is 1 — there is no gradient reduce to quantize; "
                     "flag is inert on this mesh")
             else:
                 self._qgz_axis = data_axes[0]
+            if self._qgz_axis:
                 log_dist(f"qgZ: int8 gradient reduce over mesh axis "
                          f"'{self._qgz_axis}' "
-                         f"({mesh.shape[self._qgz_axis]} ways)", ranks=[0])
+                         f"({mesh.shape[self._qgz_axis]} ways"
+                         + (", fsdp under GSPMD"
+                            if self._qgz_partial_manual else "")
+                         + ")", ranks=[0])
+            if self._qgz_partial_manual:
+                logger.warning(
+                    "qgZ at stage 3 runs the model unbound from the mesh: "
+                    "the anti-rematerialization sharding constraints "
+                    "(embedding gather / activation pinning) are left to "
+                    "GSPMD's own layout choices inside the manual grad "
+                    "shard_map — profile the embedding path before large "
+                    "runs")
 
         # low-precision mode casts PARAMS, but flax models own their COMPUTE
         # dtype — fp32 activations silently demote every matmul off the bf16
@@ -757,6 +784,10 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.ops.quantization import qpsum_local, qrs_local
         mesh, axis = self.mesh, self._qgz_axis
         size = mesh.shape[axis]
+        # stage 3 (partial-manual): only the dp axis is manual — fsdp (and
+        # any model axes) stay auto, so GSPMD still inserts the intra-group
+        # param gathers / grad reduce-scatters inside the body
+        axis_names = {axis} if self._qgz_partial_manual else None
 
         def scatter_dim(sh):
             for d, ax in enumerate(sh.spec):
@@ -791,9 +822,10 @@ class DeepSpeedTPUEngine:
             grads = jax.tree_util.tree_map(red, grads, dims)
             return grads, jax.lax.pmean(loss, axis)
 
+        kw = {"axis_names": axis_names} if axis_names else {}
         grads, loss = shard_map(
             local, mesh=mesh, in_specs=(pspecs, bspecs, P(), P(), P()),
-            out_specs=(gspecs, P()), check_vma=False)(
+            out_specs=(gspecs, P()), check_vma=False, **kw)(
                 state.params, batch, rng, state.loss_scale.scale, state.step)
         grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
         return grads, loss

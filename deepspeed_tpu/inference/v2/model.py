@@ -112,21 +112,22 @@ def _norm(p, x, cfg):
     return layer_norm(x, p["scale"], p["bias"], eps=cfg.norm_eps or LN_EPS)
 
 
-def _mlp(p, x, cfg):
-    h = x @ _w(p["wi"], x.dtype)
+def _mlp(p, x, cfg, mesh=None):
+    h = _wmm(x, p["wi"], x.dtype, mesh=mesh)
     if cfg.mlp_bias:
         h = h + p["bi"].astype(x.dtype)
     if cfg.gated_mlp:
-        h = mlp_activation(cfg.gate_act)(x @ _w(p["wg"], x.dtype)) * h
+        h = mlp_activation(cfg.gate_act)(_wmm(x, p["wg"], x.dtype,
+                                              mesh=mesh)) * h
     else:
         h = mlp_activation(cfg.activation)(h)
-    y = h @ _w(p["wo"], x.dtype)
+    y = _wmm(h, p["wo"], x.dtype, mesh=mesh)
     if cfg.mlp_bias:
         y = y + p["bo"].astype(x.dtype)
     return y
 
 
-def _block_residual(blk, x, h, attn_delta, cfg):
+def _block_residual(blk, x, h, attn_delta, cfg, mesh=None):
     """Close out one block given the normed input ``h`` and the attention
     branch output: sequential (x+attn, then MLP on a fresh norm) or falcon/phi
     parallel residual (attn and MLP both read the shared/paired input norms) —
@@ -134,9 +135,9 @@ def _block_residual(blk, x, h, attn_delta, cfg):
     loops."""
     if cfg.parallel_block:
         h_mlp = _norm(blk["Norm_1"], x, cfg) if cfg.parallel_norms == 2 else h
-        return x + attn_delta + _ffn(blk, h_mlp, cfg)
+        return x + attn_delta + _ffn(blk, h_mlp, cfg, mesh=mesh)
     x = x + attn_delta
-    return x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg)
+    return x + _ffn(blk, _norm(blk["Norm_1"], x, cfg), cfg, mesh=mesh)
 
 
 def _w(p, dtype):
@@ -151,6 +152,41 @@ def _w(p, dtype):
     return p.astype(dtype)
 
 
+
+def _wmm(x, p, dtype, mesh=None):
+    """``x @ W`` routing 2-D quantized stores through the W8A16 Pallas
+    kernel (ops/wq_matmul.py: int8 weights streamed, dequant per VMEM tile
+    — half the weight HBM traffic of bf16); everything else dequantizes at
+    the use site (_w).  Leading dims of x are flattened for the kernel.
+
+    With a tensor-parallel ``mesh`` the kernel is bypassed: GSPMD has no
+    partitioning rule for the Mosaic custom call, so routing a tp-sharded
+    store through it would replicate the full weight — the plain dequant
+    matmul stays properly partitioned instead."""
+    from deepspeed_tpu.ops.quantization import is_quantized_weight
+    if mesh is None and is_quantized_weight(p) and p["v"].ndim == 2:
+        from deepspeed_tpu.ops.wq_matmul import wq_matmul
+        lead = x.shape[:-1]
+        y = wq_matmul(x.reshape(-1, x.shape[-1]).astype(dtype), p)
+        return y.reshape(lead + (p["v"].shape[1],))
+    return x.astype(dtype) @ _w(p, dtype)
+
+
+def _logits_out(params, bb, x, cfg, dtype, mesh=None):
+    """Final unembed + optional bias — the ONE implementation shared by the
+    ragged prefill, paged decode, and speculative verify cores (tied tables
+    take the dequant path; untied lm_head rides the W8A16 kernel)."""
+    if cfg.tie_embeddings:
+        logits = (x.astype(dtype) @ _w(bb["wte"], dtype).T
+                  ).astype(jnp.float32)
+    else:
+        logits = _wmm(x, params["lm_head"], dtype,
+                      mesh=mesh).astype(jnp.float32)
+    if cfg.unembed_bias:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    return logits
+
+
 def _embed(wte, tokens, dtype):
     """Row-gather from a possibly int8-quantized table: gather codes AND the
     gathered rows' group scales — dequant cost scales with the tokens
@@ -163,7 +199,7 @@ def _embed(wte, tokens, dtype):
     return wte.astype(dtype)[tokens]
 
 
-def _ffn(blk, x, cfg):
+def _ffn(blk, x, cfg, mesh=None):
     """Dense MLP or MoE block body on FLAT tokens [N, H] — MoE routes through
     the dropless ragged grouped GEMM (moe/layer.py), which fits serving
     exactly: the ragged token set per step IS the ragged expert batch
@@ -178,7 +214,7 @@ def _ffn(blk, x, cfg):
         weg = _w(mp["wge"], x.dtype) if "wge" in mp else None
         return _expert_ffn_ragged(x, idx, w, _w(mp["wi"], x.dtype),
                                   _w(mp["wo"], x.dtype), weg)
-    return _mlp(blk["MLP_0"], x, cfg)
+    return _mlp(blk["MLP_0"], x, cfg, mesh=mesh)
 
 
 def _qkv(ap, h, cfg, eq):
@@ -321,7 +357,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
         attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")
-        x = _block_residual(blk, x, h, attn_delta, cfg)
+        x = _block_residual(blk, x, h, attn_delta, cfg, mesh=mesh)
 
     x = _norm(bb["final_norm"], x, cfg)
 
@@ -330,13 +366,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     last_flat = jnp.zeros((S,), jnp.int32).at[scat_slot].max(
         jnp.arange(N, dtype=jnp.int32), mode="drop")
     rows = x[last_flat]                                      # [S, H]
-    if cfg.tie_embeddings:
-        unembed = _w(bb["wte"], dtype).T
-    else:
-        unembed = _w(params["lm_head"], dtype)
-    logits = (rows @ unembed).astype(jnp.float32)            # [S, V]
-    if cfg.unembed_bias:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    logits = _logits_out(params, bb, rows, cfg, dtype, mesh=mesh)  # [S, V]
     return logits, _rebuild_cache(cache, flat_k_all, flat_v_all,
                                   flat_ks, flat_vs)
 
@@ -428,16 +458,10 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
                                 **kv_extra)
         o = o.reshape(S, nh, hd)
         attn_delta = _attn_out(ap, o, cfg, "skd,kdh->sh")
-        x = _block_residual(blk, x, h, attn_delta, cfg)
+        x = _block_residual(blk, x, h, attn_delta, cfg, mesh=mesh)
 
     x = _norm(bb["final_norm"], x, cfg)
-    if cfg.tie_embeddings:
-        unembed = _w(bb["wte"], dtype).T
-    else:
-        unembed = _w(params["lm_head"], dtype)
-    logits = (x @ unembed).astype(jnp.float32)                # [S, V]
-    if cfg.unembed_bias:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    logits = _logits_out(params, bb, x, cfg, dtype, mesh=mesh)     # [S, V]
     return logits, flat_k_all, flat_v_all, flat_ks, flat_vs
 
 
@@ -687,17 +711,11 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
         # FFN/MoE body is token-wise and (for MoE) expects FLAT tokens
         H = x.shape[-1]
         x = _block_residual(blk, x.reshape(S * G, H), h.reshape(S * G, H),
-                            attn_delta.reshape(S * G, H), cfg
+                            attn_delta.reshape(S * G, H), cfg, mesh=mesh
                             ).reshape(S, G, H)
 
     x = _norm(bb["final_norm"], x, cfg)
-    if cfg.tie_embeddings:
-        unembed = _w(bb["wte"], dtype).T
-    else:
-        unembed = _w(params["lm_head"], dtype)
-    logits = (x @ unembed).astype(jnp.float32)                 # [S, G, V]
-    if cfg.unembed_bias:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    logits = _logits_out(params, bb, x, cfg, dtype, mesh=mesh)  # [S, G, V]
     return logits, flat_k, flat_v, flat_ks, flat_vs
 
 

@@ -1,0 +1,131 @@
+"""W8A16 matmul — int8 weights streamed through VMEM, dequantized per tile.
+
+Reference parity: the FP6-LLM W6A16 quantized GEMM
+(``inference/v2/modules/implementations/linear/quantized_linear.py:205`` +
+``inference/v2/kernels/core_ops/cuda_linear/``) — the weight matrix stays
+quantized THROUGH the matmul; full-precision weight values exist only in
+on-chip memory, one tile at a time.
+
+TPU shape of the idea: decode is weight-bandwidth-bound, so the win is HBM
+traffic — the kernel reads int8 codes (1 byte/param) + per-group fp32
+scales (≈3% overhead at group 128) instead of bf16 (2 bytes/param),
+halving the weight stream.  Each grid step loads a [g, bn] int8 tile and
+its [1, bn] scale row, dequantizes in VMEM registers, and feeds the MXU:
+
+    y[M, N] = x[M, K] @ (codes[K, N] · scales[K/g, N])
+
+The K-tile size equals the quantization group ``g`` so the scale is a
+single broadcastable row per tile — no in-kernel gather/reshape.
+
+``wq_matmul`` falls back to dequantize-then-matmul (XLA) off-TPU shapes or
+for layouts the kernel doesn't cover (the store's dim-0 must be the
+contraction dim, g % 32 == 0, dims tile-aligned).  Serving-only: no VJP is
+defined (the store is inference-time state).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.quantization import (dequantize_weight,
+                                            is_quantized_weight)
+
+
+def _pick(total, prefer):
+    for b in (prefer, 512, 256, 128, 64, 32, 16, 8):
+        if b <= total and total % b == 0:
+            return b
+    return None
+
+
+_warned_shapes = set()
+
+
+def kernel_supported(x, store) -> bool:
+    """True when the Pallas path can run (M is NOT constrained — wq_matmul
+    pads the token dim to the tile).  Unsupported 2-D stores warn ONCE per
+    shape: a silent fallback would let an operator benchmark 'the W8A16
+    kernel' while measuring the dequant path (e.g. GPT-2's prime-ish vocab
+    50257 can never N-tile)."""
+    if not is_quantized_weight(store):
+        return False
+    v, s = store["v"], store["s"]
+    if v.ndim != 2 or x.ndim != 2 or x.shape[1] != v.shape[0]:
+        return False
+    k, n = v.shape
+    g = k // s.shape[0]
+    ok = (k % g == 0 and g % 32 == 0 and g >= 32
+          and _pick(n, 512) is not None)
+    if not ok and (k, n, g) not in _warned_shapes:
+        _warned_shapes.add((k, n, g))
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "wq_matmul: store [%d, %d] (group %d) cannot tile for the "
+            "W8A16 kernel (needs group %% 32 == 0 and an N divisor ≤ 512); "
+            "falling back to dequantize-then-matmul — the int8 HBM-traffic "
+            "saving does NOT engage for this weight", k, n, g)
+    return ok
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[...] = jnp.zeros(acc.shape, jnp.float32)
+
+    x = x_ref[...]                                   # [bm, g]
+    w = (w_ref[...].astype(jnp.float32)
+         * s_ref[...].astype(jnp.float32))           # [g, bn] · [1, bn]
+    acc[...] += jax.lax.dot(x.astype(jnp.float32), w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _done():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def wq_matmul(x, store, *, interpret: Optional[bool] = None):
+    """``x [M, K] @ dequant(store [K, N])`` with the weight kept int8 in HBM.
+
+    store: ``ops/quantization.quantize_weight`` dict (dim-0 = contraction
+    dim).  Returns [M, N] in ``x.dtype``.  Falls back to the XLA
+    dequantize-then-matmul for unsupported layouts.
+    """
+    if not kernel_supported(x, store):
+        return x @ dequantize_weight(store, x.dtype)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v, s = store["v"], store["s"]
+    k, n = v.shape
+    m0 = x.shape[0]
+    pad = (-m0) % 8                     # decode token counts tile to 8 rows
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    m = x.shape[0]
+    g = k // s.shape[0]
+    bm = _pick(m, 256)
+    bn = _pick(n, 512)
+    nk = k // g
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk),
+        grid=(m // bm, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, g), lambda im, jn, ik: (im, ik)),
+            pl.BlockSpec((g, bn), lambda im, jn, ik: (ik, jn)),
+            pl.BlockSpec((1, bn), lambda im, jn, ik: (ik, jn)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda im, jn, ik: (im, jn)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, v, s)
+    return out[:m0] if pad else out
