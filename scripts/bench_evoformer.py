@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Evoformer long-S memory/runtime proof (round-3 verdict item 6 "done" bar).
+
+Runs one forward+backward of evoformer attention at an AlphaFold-ish long-S
+shape (S=2048, N=32) through BOTH paths:
+
+- Pallas blockwise kernel (`evoformer_attention`): [bq, bk] logit tiles in
+  VMEM only — peak HBM stays O(inputs + bias2).
+- einsum ground truth (`_evoformer_xla`): materializes [B, N, H, S, S] fp32
+  logits (2 GB at this shape) twice over in fwd+bwd — expected to OOM a
+  16 GB chip once the bias2 cotangent joins.
+
+Prints one JSON line per path: {"path", "ok", "seconds", "peak_hbm_gb"}.
+Runs each path in a SUBPROCESS (an OOM'd compile poisons the process —
+docs/PERF_PLAYBOOK.md §axon).  CPU-safe smoke: EVO_SMOKE=1 shrinks shapes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(path_name: str) -> int:
+    import time
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.evoformer import (_evoformer_xla,
+                                             evoformer_attention)
+
+    smoke = bool(os.environ.get("EVO_SMOKE"))
+    B, N, S, H, D = (1, 4, 128, 2, 8) if smoke else (1, 32, 2048, 4, 32)
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    shape = (B, N, S, H, D)
+    q = jax.random.normal(ks[0], shape, jnp.bfloat16)
+    k = jax.random.normal(ks[1], shape, jnp.bfloat16)
+    v = jax.random.normal(ks[2], shape, jnp.bfloat16)
+    bias1 = jax.random.normal(ks[3], (B, N, 1, 1, S), jnp.float32)
+    bias2 = jax.random.normal(ks[4], (B, 1, H, S, S), jnp.float32)
+    fn = evoformer_attention if path_name == "pallas" else _evoformer_xla
+
+    def loss(q_, k_, v_, b2):
+        return jnp.sum(fn(q_, k_, v_, bias1, b2).astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))
+    out = {"path": path_name, "shape": list(shape)}
+    try:
+        r = g(q, k, v, bias2)                  # compile + run
+        # axon relay: sync by FETCHING a value (block_until_ready lies)
+        float(jax.device_get(r[0]).reshape(-1)[0])
+        t0 = time.perf_counter()
+        r = g(q, k, v, bias2)
+        float(jax.device_get(r[0]).reshape(-1)[0])
+        out["seconds"] = round(time.perf_counter() - t0, 3)
+        out["ok"] = True
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        if stats:
+            out["peak_hbm_gb"] = round(
+                stats.get("peak_bytes_in_use", 0) / 2**30, 2)
+    except Exception as e:  # noqa: BLE001 — OOM is the expected xla outcome
+        out["ok"] = False
+        out["error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] in ("pallas", "xla"):
+        return run_one(sys.argv[1])
+    here = os.path.abspath(__file__)
+    for path_name in ("pallas", "xla"):
+        p = subprocess.run([sys.executable, here, path_name],
+                           timeout=900, capture_output=True, text=True)
+        for line in p.stdout.splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+                break
+        else:
+            print(json.dumps({"path": path_name, "ok": False,
+                              "error": (p.stderr.strip().splitlines()
+                                        or ["no output"])[-1][:200]}),
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
