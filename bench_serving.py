@@ -239,7 +239,9 @@ def spec_leg(smoke=False):
     T = 256
     pool = rng.integers(0, tcfg.vocab_size, size=(pool_n, T)).astype(np.int32)
     tparams, tloss = train_memorized(tcfg, pool, train_steps)
-    dparams, dloss = train_memorized(dcfg, pool, train_steps)
+    # the draft is ~5x cheaper per step AND the leg lives or dies on its
+    # acceptance — train it 2x longer so the smaller model memorizes too
+    dparams, dloss = train_memorized(dcfg, pool, 2 * train_steps)
     out["spec_target_train_loss"] = round(tloss, 3)
     out["spec_draft_train_loss"] = round(dloss, 3)
 

@@ -205,12 +205,23 @@ class InferenceEngineV2:
 
             def pack(path, p):
                 name = getattr(path[-1], "key", str(path[-1]))
-                if (name != "wpe"          # positional gather stays direct
-                        and jnp.issubdtype(p.dtype, jnp.floating)
-                        and p.ndim >= 2 and p.size >= 8 * qc.group_size
-                        and weight_group_size(p.shape, qc.group_size)):
-                    return quantize_weight(p, bits=qc.bits,
-                                           group=qc.group_size)
+                # wpe: positional gather stays direct.  gate: the MoE router
+                # makes DISCRETE top-k decisions — int8 rounding near ties
+                # flips expert assignment, an error no per-weight scale can
+                # bound, for negligible savings (routers are conventionally
+                # excluded from weight quantization)
+                if (name in ("wpe", "gate")
+                        or not jnp.issubdtype(p.dtype, jnp.floating)
+                        or p.ndim < 2 or p.size < 8 * qc.group_size):
+                    return p
+                # group along the first non-trailing dim with a usable
+                # divisor: dim 0 for matrices; dim 1 rescues 3-D stacks
+                # whose leading dim is small (MoE [E, in, out] experts,
+                # attention wo [heads, hd, H])
+                for dim in range(p.ndim - 1):
+                    if weight_group_size((p.shape[dim],), qc.group_size):
+                        return quantize_weight(p, bits=qc.bits,
+                                               group=qc.group_size, dim=dim)
                 return p
             self.params = jax.tree_util.tree_map_with_path(pack, self.params)
 

@@ -191,9 +191,15 @@ def _embed(wte, tokens, dtype):
     """Row-gather from a possibly int8-quantized table: gather codes AND the
     gathered rows' group scales — dequant cost scales with the tokens
     actually read, never the vocab."""
-    from deepspeed_tpu.ops.quantization import is_quantized_weight
+    from deepspeed_tpu.ops.quantization import (_store_dim,
+                                                is_quantized_weight)
     if is_quantized_weight(wte):
         v, s = wte["v"], wte["s"]
+        if _store_dim(wte) != 0:
+            raise ValueError(
+                "embedding stores must group along dim 0 (vocab) — the "
+                f"row gather needs per-row scales; got codes {v.shape} "
+                f"vs scales {s.shape}")
         g = v.shape[0] // s.shape[0]
         return (v[tokens].astype(jnp.float32) * s[tokens // g]).astype(dtype)
     return wte.astype(dtype)[tokens]

@@ -249,39 +249,57 @@ def weight_group_size(shape, group: int, min_group: int = 16) -> int:
     return g if g >= min_group else 0
 
 
-def quantize_weight(w, *, bits: int = 8, group: int = 128):
+def quantize_weight(w, *, bits: int = 8, group: int = 128, dim: int = 0):
     """Shape-preserving group-wise symmetric weight quantization — the
     serving weight-storage format (reference
     inference/v2/modules/implementations/linear/quantized_linear.py:205 FP6
     W6A16 and inference/quantization/layers.py:114 matmul-time dequant; here
-    int8 codes + per-(dim0-group × channel) fp32 scales).
+    int8 codes + per-(group-along-``dim`` × channel) fp32 scales).
 
-    w [d0, *rest] → {"v": int8 [d0, *rest], "s": f32 [d0/g, *rest]}.
+    w → {"v": int8 same shape, "s": f32 with shape[dim] → shape[dim]/g}.
     Keeping the LEAF SHAPE (unlike the flat ``quantize_blockwise`` wire
     format) means the store shards exactly like the weight it replaces — the
     quant × tensor-parallel composition falls out — and consumers dequantize
     at their use site, so the full-precision tree never exists at rest.
+    ``dim`` defaults to 0 (the usual contraction dim); MoE expert stacks
+    [E, in, out] group along dim=1.
     """
     w = jnp.asarray(w)
-    g = weight_group_size(w.shape, group)
+    g = weight_group_size((w.shape[dim],), group)
     if g == 0:
-        raise ValueError(f"dim 0 of {w.shape} has no usable group ≤ {group}")
+        raise ValueError(f"dim {dim} of {w.shape} has no usable group "
+                         f"≤ {group}")
     qmax = float(2 ** (bits - 1) - 1)
-    d0 = w.shape[0]
-    wf = w.astype(jnp.float32).reshape((d0 // g, g) + w.shape[1:])
+    wm = jnp.moveaxis(w, dim, 0)
+    d0 = wm.shape[0]
+    wf = wm.astype(jnp.float32).reshape((d0 // g, g) + wm.shape[1:])
     absmax = jnp.max(jnp.abs(wf), axis=1)                  # [d0/g, *rest]
     s = absmax / qmax
     inv = jnp.where(s > 0, 1.0 / jnp.maximum(s, 1e-30), 0.0)
     q = jnp.clip(jnp.round(wf * inv[:, None]), -qmax, qmax)
-    return {"v": q.reshape(w.shape).astype(jnp.int8), "s": s}
+    return {"v": jnp.moveaxis(q.reshape(wm.shape).astype(jnp.int8), 0, dim),
+            "s": jnp.moveaxis(s, 0, dim)}
+
+
+def _store_dim(d) -> int:
+    """The grouped dim of a store: where codes and scales disagree."""
+    v, s = d["v"], d["s"]
+    for i, (a, b) in enumerate(zip(v.shape, s.shape)):
+        if a != b:
+            return i
+    return 0
 
 
 def dequantize_weight(d, dtype=jnp.bfloat16):
     """Inverse of ``quantize_weight`` (jit-safe; the per-consumer call)."""
     v, s = d["v"], d["s"]
-    g = v.shape[0] // s.shape[0]
-    wf = v.astype(jnp.float32).reshape((s.shape[0], g) + v.shape[1:])
-    return (wf * s[:, None]).reshape(v.shape).astype(dtype)
+    dim = _store_dim(d)
+    vm = jnp.moveaxis(v, dim, 0)
+    sm = jnp.moveaxis(s, dim, 0)
+    g = vm.shape[0] // sm.shape[0]
+    wf = vm.astype(jnp.float32).reshape((sm.shape[0], g) + vm.shape[1:])
+    return jnp.moveaxis((wf * sm[:, None]).reshape(vm.shape), 0,
+                        dim).astype(dtype)
 
 
 def is_quantized_weight(leaf) -> bool:
@@ -304,14 +322,15 @@ def store_shardings(store, shardings, mesh):
         spec = list(sh.spec)
         spec += [None] * (p["v"].ndim - len(spec))
         s_spec = list(spec)
-        ax = s_spec[0]
+        d = _store_dim(p)
+        ax = s_spec[d]
         if ax is not None:
             axes = (ax,) if isinstance(ax, str) else ax
             n = 1
             for a in axes:
                 n *= mesh.shape[a]
-            if p["s"].shape[0] % n:
-                s_spec[0] = None
+            if p["s"].shape[d] % n:
+                s_spec[d] = None
         return {"v": NamedSharding(mesh, P(*spec)),
                 "s": NamedSharding(mesh, P(*s_spec))}
     return jax.tree_util.tree_map(f, store, shardings,

@@ -58,6 +58,8 @@ def kernel_supported(x, store) -> bool:
     v, s = store["v"], store["s"]
     if v.ndim != 2 or x.ndim != 2 or x.shape[1] != v.shape[0]:
         return False
+    if s.shape[1:] != v.shape[1:]:
+        return False                   # kernel assumes dim-0 grouping
     k, n = v.shape
     g = k // s.shape[0]
     ok = (k % g == 0 and g % 32 == 0 and g >= 32

@@ -270,3 +270,18 @@ class TestWqMatmul:
         want = x @ dequantize_weight(store, jnp.float32)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-4)
+
+    def test_dim1_grouping_roundtrip(self, rng):
+        """MoE expert stacks [E, in, out] / attention wo [heads, hd, H]
+        group along dim 1; dequant infers the grouped dim from the
+        code/scale shape mismatch."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.quantization import (dequantize_weight,
+                                                    quantize_weight)
+        w = jnp.asarray(rng.standard_normal((4, 64, 32)), jnp.float32)
+        store = quantize_weight(w, group=32, dim=1)
+        assert store["v"].shape == (4, 64, 32)
+        assert store["s"].shape == (4, 2, 32)
+        back = dequantize_weight(store, jnp.float32)
+        err = np.abs(np.asarray(back) - np.asarray(w))
+        assert float(err.max()) < 0.05 * float(np.abs(np.asarray(w)).max())
