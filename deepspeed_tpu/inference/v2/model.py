@@ -174,11 +174,20 @@ def _wmm(x, p, dtype, mesh=None):
 
 def _logits_out(params, bb, x, cfg, dtype, mesh=None):
     """Final unembed + optional bias — the ONE implementation shared by the
-    ragged prefill, paged decode, and speculative verify cores (tied tables
-    take the dequant path; untied lm_head rides the W8A16 kernel)."""
+    ragged prefill, paged decode, and speculative verify cores.  Untied
+    lm_head rides the W8A16 kernel; tied tables ride its transposed variant
+    (same [V, H] dim-0-grouped store the embed gather needs)."""
+    from deepspeed_tpu.ops.quantization import is_quantized_weight
     if cfg.tie_embeddings:
-        logits = (x.astype(dtype) @ _w(bb["wte"], dtype).T
-                  ).astype(jnp.float32)
+        wte = bb["wte"]
+        if mesh is None and is_quantized_weight(wte):
+            from deepspeed_tpu.ops.wq_matmul import wq_matmul_t
+            lead = x.shape[:-1]
+            y = wq_matmul_t(x.reshape(-1, x.shape[-1]).astype(dtype), wte)
+            logits = y.reshape(lead + (y.shape[-1],)).astype(jnp.float32)
+        else:
+            logits = (x.astype(dtype) @ _w(wte, dtype).T
+                      ).astype(jnp.float32)
     else:
         logits = _wmm(x, params["lm_head"], dtype,
                       mesh=mesh).astype(jnp.float32)

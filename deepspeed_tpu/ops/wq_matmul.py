@@ -75,22 +75,93 @@ def kernel_supported(x, store) -> bool:
     return ok
 
 
-def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk):
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, contract):
+    """Shared body for both orientations: dequantize one weight tile
+    (codes · broadcast scale row) and accumulate the dot.  ``contract`` is
+    the weight-side contraction dim: 0 for ``x @ W`` ([g, bn] tiles), 1 for
+    ``x @ Wᵀ`` ([g, bk] tiles)."""
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
         acc[...] = jnp.zeros(acc.shape, jnp.float32)
 
-    x = x_ref[...]                                   # [bm, g]
+    x = x_ref[...]
     w = (w_ref[...].astype(jnp.float32)
-         * s_ref[...].astype(jnp.float32))           # [g, bn] · [1, bn]
-    acc[...] += jax.lax.dot(x.astype(jnp.float32), w,
-                            preferred_element_type=jnp.float32)
+         * s_ref[...].astype(jnp.float32))
+    acc[...] += jax.lax.dot_general(
+        x.astype(jnp.float32), w, (((1,), (contract,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _done():
         o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def kernel_t_supported(x, store) -> bool:
+    """Transposed variant (``x @ storeᵀ``, tied-embedding unembed): store is
+    [V, H] grouped along dim 0 (the embed gather's required layout), so the
+    scale varies along the CONTRACTION dim within each g-row output tile —
+    still a single broadcastable row per tile.  The output tile width is
+    structurally pinned to g, so g must be lane-aligned (128)."""
+    if not is_quantized_weight(store):
+        return False
+    v, s = store["v"], store["s"]
+    if v.ndim != 2 or x.ndim != 2 or x.shape[1] != v.shape[1]:
+        return False
+    if s.shape[1:] != v.shape[1:]:
+        return False                   # dim-0 grouping only
+    vocab, h = v.shape
+    g = vocab // s.shape[0]
+    ok = (vocab % g == 0 and g % 128 == 0 and _pick(h, 512) is not None)
+    if not ok and (vocab, h, g, "t") not in _warned_shapes:
+        _warned_shapes.add((vocab, h, g, "t"))
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "wq_matmul_t: tied store [%d, %d] (group %d) cannot tile for "
+            "the transposed W8A16 kernel (the output tile width IS the "
+            "group, so it needs group %% 128 == 0, plus an H divisor "
+            "≤ 512); falling back to dequantize-then-matmul", vocab, h, g)
+    return ok
+
+
+def wq_matmul_t(x, store, *, interpret: Optional[bool] = None):
+    """``x [M, H] @ dequant(store [V, H]).T`` → [M, V] with the table kept
+    int8 in HBM — the tied-embedding unembed (bloom/falcon-class models
+    whose vocab divides the group; GPT-2's 50257 cannot tile and falls
+    back).  One output tile per scale-group row keeps the dequant a single
+    broadcast multiply."""
+    if not kernel_t_supported(x, store):
+        return x @ dequantize_weight(store, x.dtype).T
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v, s = store["v"], store["s"]
+    vocab, h = v.shape
+    m0 = x.shape[0]
+    pad = (-m0) % 8
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    m = x.shape[0]
+    g = vocab // s.shape[0]
+    bm = _pick(m, 256)
+    bk = _pick(h, 512)
+    nk = h // bk
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk, contract=1),
+        grid=(m // bm, vocab // g, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda im, jv, ik: (im, ik)),
+            pl.BlockSpec((g, bk), lambda im, jv, ik: (jv, ik)),
+            pl.BlockSpec((1, bk), lambda im, jv, ik: (jv, ik)),
+        ],
+        out_specs=pl.BlockSpec((bm, g), lambda im, jv, ik: (im, jv)),
+        out_shape=jax.ShapeDtypeStruct((m, vocab), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, g), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, v, s)
+    return out[:m0] if pad else out
 
 
 def wq_matmul(x, store, *, interpret: Optional[bool] = None):
@@ -116,7 +187,7 @@ def wq_matmul(x, store, *, interpret: Optional[bool] = None):
     bn = _pick(n, 512)
     nk = k // g
     out = pl.pallas_call(
-        functools.partial(_kernel, nk=nk),
+        functools.partial(_kernel, nk=nk, contract=0),
         grid=(m // bm, n // bn, nk),
         in_specs=[
             pl.BlockSpec((bm, g), lambda im, jn, ik: (im, ik)),

@@ -611,3 +611,23 @@ class TestQuantizedWeights:
         agree = np.mean([np.mean(np.asarray(a) == np.asarray(b))
                          for a, b in zip(got, want)])
         assert agree > 0.5          # random weights: near-ties may flip
+
+    def test_tied_unembed_kernel_path(self, v2cfg, rng):
+        """Tied embeddings with a group-divisible vocab: the unembed rides
+        wq_matmul_t over the same [V, H] store the embed gather reads —
+        greedy generate must track the unquantized engine."""
+        import dataclasses
+        tcfg = GPTConfig.llama(num_layers=2, hidden=64, heads=4,
+                               vocab_size=128, max_seq_len=64)
+        tcfg = dataclasses.replace(tcfg, tie_embeddings=True)
+        base = InferenceEngineV2(tcfg, config=v2cfg, seed=0)
+        q = self.mk(tcfg, v2cfg, params=base.params)
+        from deepspeed_tpu.ops.quantization import is_quantized_weight
+        assert is_quantized_weight(q.params["backbone"]["wte"])
+        prompts = [rng.integers(0, 128, (11 + i,)).astype(np.int32)
+                   for i in range(3)]
+        got = q.generate(prompts, max_new_tokens=8)
+        want = base.generate(prompts, max_new_tokens=8)
+        agree = np.mean([np.mean(np.asarray(a) == np.asarray(b))
+                         for a, b in zip(got, want)])
+        assert agree > 0.5              # random weights: near-ties flip

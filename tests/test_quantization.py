@@ -285,3 +285,40 @@ class TestWqMatmul:
         back = dequantize_weight(store, jnp.float32)
         err = np.abs(np.asarray(back) - np.asarray(w))
         assert float(err.max()) < 0.05 * float(np.abs(np.asarray(w)).max())
+
+    def test_transposed_variant_matches(self, rng):
+        """Tied-unembed kernel (x @ store.T) vs the dequant ground truth."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.quantization import (dequantize_weight,
+                                                    quantize_weight)
+        from deepspeed_tpu.ops.wq_matmul import (kernel_t_supported,
+                                                 wq_matmul_t)
+        M, V, H = 5, 256, 128
+        x = jnp.asarray(rng.standard_normal((M, H)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((V, H)), jnp.float32)
+        store = quantize_weight(w, group=128)
+        assert kernel_t_supported(x, store)
+        got = wq_matmul_t(x, store)
+        assert got.shape == (M, V)
+        want = x @ dequantize_weight(store, jnp.float32).T
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_transposed_prime_vocab_falls_back(self, rng):
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.quantization import (dequantize_weight,
+                                                    quantize_weight)
+        from deepspeed_tpu.ops.wq_matmul import (kernel_t_supported,
+                                                 wq_matmul_t)
+        x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((256, 64)), jnp.float32)
+        store = quantize_weight(w, group=128)      # lane-aligned output tile
+        assert kernel_t_supported(x, store)
+        # now a store whose group collapses below 32: fallback path
+        w2 = jnp.asarray(rng.standard_normal((68, 64)), jnp.float32)
+        store2 = quantize_weight(w2, group=32, dim=1)
+        assert not kernel_t_supported(x, store2)
+        got = wq_matmul_t(x, store2)
+        want = x @ dequantize_weight(store2, jnp.float32).T
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
