@@ -126,7 +126,11 @@ class InferenceEngine:
                                                         store_shardings)
             self.params, self._materialize = make_param_store(
                 self.params, bits=self.config.quant.bits,
-                block_size=self.config.quant.group_size)
+                block_size=self.config.quant.group_size,
+                # int4 nibble packing (¼ the fp bytes) only when unsharded —
+                # the packed shape can't map to the weight's sharding
+                pack4=(self.config.quant.bits == 4
+                       and mesh.shape["tp"] == 1))
             self.store_shardings = store_shardings(
                 self.params, self.param_shardings, mesh)
             with self.mesh:

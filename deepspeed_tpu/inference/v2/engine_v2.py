@@ -80,7 +80,11 @@ class V2QuantConfig(DeepSpeedConfigModel):
     weights it replaces)."""
 
     enabled: bool = False
-    bits: int = 8               # int8 range; 4 narrows the grid (same bytes)
+    # 8: int8 codes (½ the bf16 bytes), shards like the weights, W8A16
+    # kernels.  4: nibble-PACKED codes (¼ the bf16 bytes) on single-shard
+    # engines — the ZeRO-Inference HBM-fit point; with tp>1 it degrades to
+    # int4-range codes at int8 bytes (packing breaks the sharding property)
+    bits: int = 8
     group_size: int = 128       # scale granularity along each weight's dim 0
 
 
@@ -201,7 +205,14 @@ class InferenceEngineV2:
         qc = self.config.quant
         if qc.enabled:
             from deepspeed_tpu.ops.quantization import (quantize_weight,
+                                                        quantize_weight4,
                                                         weight_group_size)
+            pack4 = qc.bits == 4 and self.mesh is None
+            if qc.bits == 4 and self.mesh is not None:
+                log_dist(
+                    "quant.bits=4 with tensor parallelism stores int4-range "
+                    "codes at int8 bytes (nibble packing would break the "
+                    "shard-like-the-weight property)", ranks=[0])
 
             def pack(path, p):
                 name = getattr(path[-1], "key", str(path[-1]))
@@ -220,6 +231,11 @@ class InferenceEngineV2:
                 # attention wo [heads, hd, H])
                 for dim in range(p.ndim - 1):
                     if weight_group_size((p.shape[dim],), qc.group_size):
+                        if pack4 and dim == 0 and p.shape[0] % 2 == 0:
+                            # nibble-packed: ¼ the bf16 bytes (single-shard
+                            # serving only — the packed shape can't shard
+                            # like the weight)
+                            return quantize_weight4(p, group=qc.group_size)
                         return quantize_weight(p, bits=qc.bits,
                                                group=qc.group_size, dim=dim)
                 return p

@@ -141,14 +141,19 @@ def _block_residual(blk, x, h, attn_delta, cfg, mesh=None):
 
 
 def _w(p, dtype):
-    """Weight accessor: dequantize a ``quantize_weight`` store leaf at its
-    USE SITE (reference quantized_linear.py:205 matmul-time dequant — the
+    """Weight accessor: dequantize a ``quantize_weight`` (int8) or
+    ``quantize_weight4`` (nibble-packed) store leaf at its USE SITE
+    (reference quantized_linear.py:205 matmul-time dequant — the
     full-precision tensor exists only transiently inside the layer that
     consumes it), or cast a plain array."""
     from deepspeed_tpu.ops.quantization import (dequantize_weight,
-                                                is_quantized_weight)
+                                                dequantize_weight4,
+                                                is_quantized_weight,
+                                                is_quantized_weight4)
     if is_quantized_weight(p):
         return dequantize_weight(p, dtype)
+    if is_quantized_weight4(p):
+        return dequantize_weight4(p, dtype)
     return p.astype(dtype)
 
 
@@ -201,7 +206,8 @@ def _embed(wte, tokens, dtype):
     gathered rows' group scales — dequant cost scales with the tokens
     actually read, never the vocab."""
     from deepspeed_tpu.ops.quantization import (_store_dim,
-                                                is_quantized_weight)
+                                                is_quantized_weight,
+                                                is_quantized_weight4)
     if is_quantized_weight(wte):
         v, s = wte["v"], wte["s"]
         if _store_dim(wte) != 0:
@@ -211,6 +217,15 @@ def _embed(wte, tokens, dtype):
                 f"vs scales {s.shape}")
         g = v.shape[0] // s.shape[0]
         return (v[tokens].astype(jnp.float32) * s[tokens // g]).astype(dtype)
+    if is_quantized_weight4(wte):
+        # nibble-packed rows: byte r//2 holds row r in nibble r%2.  tokens
+        # may be any rank (the speculative verify core gathers [S, G])
+        from deepspeed_tpu.ops.quantization import unpack_nibbles
+        p, s = wte["v4"], wte["s"]
+        lo, hi = unpack_nibbles(p[tokens // 2])
+        q = jnp.where((tokens % 2 == 0)[..., None], lo, hi)
+        g = 2 * p.shape[0] // s.shape[0]
+        return (q.astype(jnp.float32) * s[tokens // g]).astype(dtype)
     return wte.astype(dtype)[tokens]
 
 

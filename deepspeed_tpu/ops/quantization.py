@@ -42,6 +42,22 @@ def _pad_to_blocks(flat, block_size):
     return flat, n
 
 
+def pack_nibbles(q):
+    """Fold int8 values (range [-7, 7]) pairwise along dim 0 into bytes:
+    low nibble = even index, high = odd.  Shared by the blockwise wire
+    format and the packed weight store."""
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8)
+
+
+def unpack_nibbles(p):
+    """Inverse of ``pack_nibbles``: (lo, hi) sign-extended int8 halves."""
+    lo = (p << 4).astype(jnp.int8) >> 4
+    hi = p >> 4                                      # arithmetic shift
+    return lo, hi
+
+
 def quantize_blockwise(x, *, bits: int = 8,
                        block_size: int = 256) -> QuantizedBlocks:
     """Symmetric per-block quantization (reference quantize.cu semantics:
@@ -57,10 +73,9 @@ def quantize_blockwise(x, *, bits: int = 8,
     inv = jnp.where(scales > 0, 1.0 / jnp.maximum(scales, 1e-30), 0.0)
     q = jnp.clip(jnp.round(blocks * inv), -qmax, qmax).astype(jnp.int8)
     if bits == 4:
-        # pack pairs: low nibble = even index, high nibble = odd index
-        lo = q[:, 0::2] & 0x0F
-        hi = (q[:, 1::2] & 0x0F) << 4
-        q = (lo | hi).astype(jnp.int8)
+        # pack pairs along the block dim: transpose in/out of the shared
+        # dim-0 packer
+        q = pack_nibbles(q.T).T
     return QuantizedBlocks(values=q, scales=scales, shape=orig_shape,
                            dtype=orig_dtype, bits=bits, block_size=block_size)
 
@@ -68,8 +83,7 @@ def quantize_blockwise(x, *, bits: int = 8,
 def dequantize_blockwise(qb: QuantizedBlocks) -> jax.Array:
     q = qb.values
     if qb.bits == 4:
-        lo = (q << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
-        hi = q >> 4                                   # arithmetic shift: high
+        lo, hi = unpack_nibbles(q)
         q = jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
     x = q.astype(jnp.float32) * qb.scales
     n = 1
@@ -307,16 +321,55 @@ def is_quantized_weight(leaf) -> bool:
             and getattr(leaf["v"], "dtype", None) == jnp.int8)
 
 
+def quantize_weight4(w, *, group: int = 128):
+    """int4 NIBBLE-PACKED weight store: ¼ the bf16 bytes (vs the
+    shape-preserving int8 store's ½) — the ZeRO-Inference single-chip
+    HBM-fit format (reference inference/quantization int4 path,
+    csrc/quantization/quantize_int4.cu).
+
+    Packing folds dim-0 PAIRS into one byte (low nibble = even row, high =
+    odd row), so codes are [d0/2, *rest] — NOT the weight's shape.  That
+    breaks the shard-like-the-weight property, so this format is for
+    UNSHARDED (single-shard / mesh-free) serving only; sharded or
+    kernel-eligible paths use ``quantize_weight``.
+    Returns {"v4": int8 [d0/2, *rest], "s": f32 [d0/g, *rest]}."""
+    w = jnp.asarray(w)
+    if w.shape[0] % 2:
+        raise ValueError(f"dim 0 of {w.shape} is odd — nibble packing "
+                         f"folds row pairs")
+    q = quantize_weight(w, bits=4, group=group)      # shared scale math
+    return {"v4": pack_nibbles(q["v"]), "s": q["s"]}
+
+
+def is_quantized_weight4(leaf) -> bool:
+    return (isinstance(leaf, dict) and set(leaf) == {"v4", "s"}
+            and getattr(leaf["v4"], "dtype", None) == jnp.int8)
+
+
+def dequantize_weight4(d, dtype=jnp.bfloat16):
+    """Inverse of ``quantize_weight4`` (jit-safe; the per-consumer call)."""
+    p, s = d["v4"], d["s"]
+    lo, hi = unpack_nibbles(p)
+    d0 = 2 * p.shape[0]
+    q = jnp.stack([lo, hi], axis=1).reshape((d0,) + p.shape[1:])
+    return dequantize_weight({"v": q, "s": s}, dtype)
+
+
 def store_shardings(store, shardings, mesh):
     """NamedSharding tree for a ``quantize_weight`` param store: codes take
     the replaced weight's sharding verbatim (shape-preserving format); scales
-    take it too unless the dim-0 group count doesn't divide over the sharded
-    axis, in which case the small scale tensor just replicates.  This is what
-    makes quant × tensor-parallel compose (round-3 verdict item 4: the old
-    flat store dropped ``in_shardings`` and rejected tp>1)."""
+    take it too unless the grouped-dim group count doesn't divide over the
+    sharded axis, in which case the small scale tensor just replicates.
+    This is what makes quant × tensor-parallel compose (round-3 verdict item
+    4: the old flat store dropped ``in_shardings`` and rejected tp>1).
+    Nibble-packed (v4) leaves exist only on unsharded engines and
+    replicate."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def f(p, sh):
+        if is_quantized_weight4(p):
+            return {"v4": NamedSharding(mesh, P()),
+                    "s": NamedSharding(mesh, P())}
         if not is_quantized_weight(p):
             return sh
         spec = list(sh.spec)
@@ -333,11 +386,13 @@ def store_shardings(store, shardings, mesh):
                 s_spec[d] = None
         return {"v": NamedSharding(mesh, P(*spec)),
                 "s": NamedSharding(mesh, P(*s_spec))}
-    return jax.tree_util.tree_map(f, store, shardings,
-                                  is_leaf=is_quantized_weight)
+    return jax.tree_util.tree_map(
+        f, store, shardings,
+        is_leaf=lambda x: is_quantized_weight(x) or is_quantized_weight4(x))
 
 
-def make_param_store(params, *, bits: int = 8, block_size: int = 128):
+def make_param_store(params, *, bits: int = 8, block_size: int = 128,
+                     pack4: bool = False):
     """Pack a param tree into int-quantized storage + a jit-safe materializer
     — ZeRO-Inference weight storage (reference inference/quantization/
     __init__.py _init_group_wise_weight_quantization: weights live in HBM at
@@ -357,7 +412,11 @@ def make_param_store(params, *, bits: int = 8, block_size: int = 128):
         if (jnp.issubdtype(leaf.dtype, jnp.floating)
                 and leaf.size >= block_size
                 and weight_group_size(leaf.shape, block_size)):
-            stored.append(quantize_weight(leaf, bits=bits, group=block_size))
+            if pack4 and leaf.shape[0] % 2 == 0:
+                stored.append(quantize_weight4(leaf, group=block_size))
+            else:
+                stored.append(quantize_weight(leaf, bits=bits,
+                                              group=block_size))
             metas.append(leaf.dtype)
         else:
             stored.append(leaf)
@@ -365,11 +424,17 @@ def make_param_store(params, *, bits: int = 8, block_size: int = 128):
 
     def materialize(stored_tree):
         leaves_in = jax.tree_util.tree_leaves(
-            stored_tree, is_leaf=is_quantized_weight)
+            stored_tree,
+            is_leaf=lambda x: (is_quantized_weight(x)
+                               or is_quantized_weight4(x)))
         out = []
         for item, meta in zip(leaves_in, metas):
-            out.append(item if meta is None
-                       else dequantize_weight(item, meta))
+            if meta is None:
+                out.append(item)
+            elif is_quantized_weight4(item):
+                out.append(dequantize_weight4(item, meta))
+            else:
+                out.append(dequantize_weight(item, meta))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     # the store keeps the PARAM TREE structure (quantized leaves become
