@@ -138,13 +138,17 @@ def run_v1_bucketed(cfg, params, prompts, budgets):
         for i in range(0, len(prompts), SLOTS):
             chunk = prompts[i:i + SLOTS]
             steps = bucket(max(budgets[i:i + SLOTS]))
-            # pow2 bucket, clamped so prompt + decode fits the model window
-            L = min(bucket(max(len(p) for p in chunk)),
-                    cfg.max_seq_len - steps)
+            # pow2 bucket, clamped so prompt + decode fits the model window —
+            # but never below the longest prompt (pad_batch would compute a
+            # negative row offset and raise mid-bench); if the longest prompt
+            # crowds the window, the decode budget shrinks instead
+            longest = max(len(p) for p in chunk)
+            steps = min(steps, cfg.max_seq_len - longest)
+            L = max(min(bucket(longest), cfg.max_seq_len - steps), longest)
             batch, mask = pad_batch(chunk, length=L, rows=SLOTS)
             eng.generate(batch, max_new_tokens=steps,
                          attention_mask=mask, do_sample=False)
-            useful += sum(budgets[i:i + SLOTS])
+            useful += sum(min(b, steps) for b in budgets[i:i + SLOTS])
         return useful
 
     serve_all()                                    # compile the bucket set

@@ -796,9 +796,18 @@ class DeepSpeedTPUEngine:
             return -1
         dims = jax.tree_util.tree_map(scatter_dim, self.grad_shardings)
         pspecs = jax.tree_util.tree_map(lambda _: P(), state.params)
-        bspecs = jax.tree_util.tree_map(
-            lambda x: P(axis) if (getattr(x, "ndim", 0) >= 1
-                                  and x.shape[0] % size == 0) else P(), batch)
+        def bspec(x):
+            if getattr(x, "ndim", 0) < 1:
+                return P()                       # scalars replicate
+            if x.shape[0] % size:
+                raise ValueError(
+                    f"qgZ: batch leaf with shape {x.shape} has leading dim "
+                    f"not divisible by mesh axis {axis}={size} — silently "
+                    f"replicating it while other leaves split would pair "
+                    f"mismatched rows across leaves; pad the batch so every "
+                    f"leaf's leading dim divides the data-parallel size")
+            return P(axis)
+        bspecs = jax.tree_util.tree_map(bspec, batch)
         gspecs = jax.tree_util.tree_map(
             lambda d, g: (P(*[axis if i == d else None
                               for i in range(g.ndim)]) if d >= 0 else P()),

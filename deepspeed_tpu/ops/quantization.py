@@ -410,6 +410,10 @@ def make_param_store(params, *, bits: int = 8, block_size: int = 128,
     for leaf in leaves:
         leaf = jnp.asarray(leaf)
         if (jnp.issubdtype(leaf.dtype, jnp.floating)
+                and leaf.ndim >= 2        # matmul weights only: quantizing
+                # 1-D norm scales/biases costs accuracy for negligible bytes
+                # (matches the v2 pack() policy and the reference's
+                # linear-weights-only restriction)
                 and leaf.size >= block_size
                 and weight_group_size(leaf.shape, block_size)):
             if pack4 and leaf.shape[0] % 2 == 0:

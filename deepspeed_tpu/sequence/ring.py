@@ -235,7 +235,7 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
         # (≈4 tensor volumes per call), booked separately from the ring hops
         comms_logger.record(
             "ring_attention_zigzag_permute",
-            (q.size * 3 + q.size) * q.dtype.itemsize, axis)
+            (q.size + k.size + v.size + q.size) * q.dtype.itemsize, axis)
         qz, kz, vz = (jnp.take(x, idx, axis=1) for x in (q, k, v))
 
         @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
