@@ -59,7 +59,7 @@ def pad_batch(chunk, length=None, rows=None):
 
 
 def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
-           quant_weights=False):
+           quant_weights=False, quant_bits=8):
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
     eng = InferenceEngineV2(
@@ -71,7 +71,7 @@ def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
             "max_q_per_seq": 512,
             "kv_block_size": block_size,
             "kv_quant": kv_quant},
-         "quant": {"enabled": bool(quant_weights)},
+         "quant": {"enabled": bool(quant_weights), "bits": quant_bits},
          "generation": {"do_sample": False}},
         params=params)
     # warm every compiled path (prefill buckets, decode, burst sizes) by
@@ -323,6 +323,8 @@ def main():
     v1b_tps = run_v1_bucketed(cfg, params, prompts, budgets)
     int8_tps = run_v2(cfg, params, prompts, budgets, kv_quant="int8")
     wq_tps = run_v2(cfg, params, prompts, budgets, quant_weights=True)
+    w4_tps = run_v2(cfg, params, prompts, budgets, quant_weights=True,
+                    quant_bits=4)
     one_v2, one_v1 = run_oneshot(cfg, params, rng)
     extra = {"static_batch_tokens_per_sec": round(v1_tps, 1),
              "static_bucketed_tokens_per_sec": round(v1b_tps, 1),
@@ -330,6 +332,8 @@ def main():
              "ragged_int8_kv_tokens_per_sec": round(int8_tps, 1),
              "ragged_int8_weights_tokens_per_sec": round(wq_tps, 1),
              "wq_vs_bf16": round(wq_tps / v2_tps, 3),
+             "ragged_int4_weights_tokens_per_sec": round(w4_tps, 1),
+             "w4_vs_bf16": round(w4_tps / v2_tps, 3),
              "oneshot_equal_lengths_ragged": round(one_v2, 1),
              "oneshot_equal_lengths_static": round(one_v1, 1),
              "n_requests": len(prompts), "slots": SLOTS,

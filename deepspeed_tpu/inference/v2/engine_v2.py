@@ -207,12 +207,7 @@ class InferenceEngineV2:
             from deepspeed_tpu.ops.quantization import (quantize_weight,
                                                         quantize_weight4,
                                                         weight_group_size)
-            pack4 = qc.bits == 4 and self.mesh is None
-            if qc.bits == 4 and self.mesh is not None:
-                log_dist(
-                    "quant.bits=4 with tensor parallelism stores int4-range "
-                    "codes at int8 bytes (nibble packing would break the "
-                    "shard-like-the-weight property)", ranks=[0])
+            pack4 = qc.bits == 4
 
             def pack(path, p):
                 name = getattr(path[-1], "key", str(path[-1]))
@@ -225,16 +220,39 @@ class InferenceEngineV2:
                         or not jnp.issubdtype(p.dtype, jnp.floating)
                         or p.ndim < 2 or p.size < 8 * qc.group_size):
                     return p
-                # group along the first non-trailing dim with a usable
-                # divisor: dim 0 for matrices; dim 1 rescues 3-D stacks
-                # whose leading dim is small (MoE [E, in, out] experts,
-                # attention wo [heads, hd, H])
-                for dim in range(p.ndim - 1):
+                if name == "wte" and not weight_group_size(
+                        (p.shape[0],), qc.group_size):
+                    # odd vocabs (GPT-2's 50257) can't group along dim 0 —
+                    # pad the table to the group so it quantizes at all and
+                    # the tied transposed kernel can tile; padded rows are
+                    # zero (scale 0, codes 0) and tied logits slice back to
+                    # vocab_size (model._logits_out)
+                    gpad = -(-p.shape[0] // qc.group_size) * qc.group_size
+                    if pack4:
+                        gpad = -(-gpad // 2) * 2
+                    p = jnp.pad(p, ((0, gpad - p.shape[0]),)
+                                + ((0, 0),) * (p.ndim - 1))
+                # group along the kernel-preferred dim: attention wo
+                # [heads, hd, H] contracts dims (0, 1), and only dim-1
+                # grouping flattens to a uniform 2-D kernel view
+                # (ops/wq_matmul.store_as_2d) — for everything else, dim 0
+                # first; dim 1 rescues 3-D stacks whose leading dim is
+                # small (MoE [E, in, out] experts)
+                cand = ((1, 0) if (p.ndim == 3 and name == "wo")
+                        else range(p.ndim - 1))
+                for dim in cand:
                     if weight_group_size((p.shape[dim],), qc.group_size):
-                        if pack4 and dim == 0 and p.shape[0] % 2 == 0:
-                            # nibble-packed: ¼ the bf16 bytes (single-shard
-                            # serving only — the packed shape can't shard
-                            # like the weight)
+                        if (pack4 and dim == 0 and p.shape[0] % 2 == 0
+                                and not (name == "wte"
+                                         and model_cfg.tie_embeddings)):
+                            # (tied tables stay int8: the transposed unembed
+                            # kernel has no packed variant, and a per-step
+                            # full-table dequant would cost more HBM than
+                            # the packing saves)
+                            # nibble-packed: ¼ the bf16 bytes; shards like
+                            # the weight as long as shard boundaries keep
+                            # row pairs + scale groups intact
+                            # (quantization.store_shardings checks)
                             return quantize_weight4(p, group=qc.group_size)
                         return quantize_weight(p, bits=qc.bits,
                                                group=qc.group_size, dim=dim)

@@ -113,15 +113,18 @@ def _norm(p, x, cfg):
 
 
 def _mlp(p, x, cfg, mesh=None):
-    h = _wmm(x, p["wi"], x.dtype, mesh=mesh)
+    # TP layout (parallel/partition.py DEFAULT_RULES): wi/wg shard the mlp
+    # dim (column-parallel), wo shards the contraction (row-parallel) —
+    # wspec keeps the quantized kernel engaged per shard
+    h = _wmm(x, p["wi"], x.dtype, mesh=mesh, wspec="col")
     if cfg.mlp_bias:
         h = h + p["bi"].astype(x.dtype)
     if cfg.gated_mlp:
         h = mlp_activation(cfg.gate_act)(_wmm(x, p["wg"], x.dtype,
-                                              mesh=mesh)) * h
+                                              mesh=mesh, wspec="col")) * h
     else:
         h = mlp_activation(cfg.activation)(h)
-    y = _wmm(h, p["wo"], x.dtype, mesh=mesh)
+    y = _wmm(h, p["wo"], x.dtype, mesh=mesh, wspec="row")
     if cfg.mlp_bias:
         y = y + p["bo"].astype(x.dtype)
     return y
@@ -158,22 +161,29 @@ def _w(p, dtype):
 
 
 
-def _wmm(x, p, dtype, mesh=None):
-    """``x @ W`` routing 2-D quantized stores through the W8A16 Pallas
-    kernel (ops/wq_matmul.py: int8 weights streamed, dequant per VMEM tile
-    — half the weight HBM traffic of bf16); everything else dequantizes at
-    the use site (_w).  Leading dims of x are flattened for the kernel.
+def _wmm(x, p, dtype, mesh=None, wspec=None):
+    """``x @ W`` routing 2-D quantized stores through the quantized-weight
+    Pallas kernels (ops/wq_matmul.py: int8 → half the bf16 weight HBM
+    traffic; nibble-packed int4 → a quarter); everything else dequantizes
+    at the use site (_w).  Leading dims of x are flattened for the kernel.
 
-    With a tensor-parallel ``mesh`` the kernel is bypassed: GSPMD has no
-    partitioning rule for the Mosaic custom call, so routing a tp-sharded
-    store through it would replicate the full weight — the plain dequant
-    matmul stays properly partitioned instead."""
-    from deepspeed_tpu.ops.quantization import is_quantized_weight
-    if mesh is None and is_quantized_weight(p) and p["v"].ndim == 2:
-        from deepspeed_tpu.ops.wq_matmul import wq_matmul
+    ``wspec`` names the store's tensor-parallel layout ("col" = output dim
+    sharded, "row" = contraction dim sharded) so a tp mesh keeps the
+    kernel engaged per shard via a manual shard_map (wq_matmul_tp) —
+    GSPMD cannot partition the Mosaic custom call itself.  wspec=None
+    under a mesh stays on the partitioned dequant-matmul path."""
+    from deepspeed_tpu.ops.quantization import quantized_codes
+    from deepspeed_tpu.ops import wq_matmul as wqm
+    vv = quantized_codes(p) if isinstance(p, dict) else None
+    if vv is not None and vv.ndim == 2 and (mesh is None
+                                            or wspec is not None):
         lead = x.shape[:-1]
-        y = wq_matmul(x.reshape(-1, x.shape[-1]).astype(dtype), p)
-        return y.reshape(lead + (p["v"].shape[1],))
+        x2 = x.reshape(-1, x.shape[-1]).astype(dtype)
+        if mesh is None:
+            y = wqm.wq_any(x2, p)
+        else:
+            y = wqm.wq_matmul_tp(x2, p, mesh, wspec)
+        return y.reshape(lead + (vv.shape[1],))
     return x.astype(dtype) @ _w(p, dtype)
 
 
@@ -185,17 +195,24 @@ def _logits_out(params, bb, x, cfg, dtype, mesh=None):
     from deepspeed_tpu.ops.quantization import is_quantized_weight
     if cfg.tie_embeddings:
         wte = bb["wte"]
-        if mesh is None and is_quantized_weight(wte):
-            from deepspeed_tpu.ops.wq_matmul import wq_matmul_t
+        if is_quantized_weight(wte):
+            from deepspeed_tpu.ops.wq_matmul import wq_matmul_t, wq_matmul_tp
             lead = x.shape[:-1]
-            y = wq_matmul_t(x.reshape(-1, x.shape[-1]).astype(dtype), wte)
+            x2 = x.reshape(-1, x.shape[-1]).astype(dtype)
+            y = (wq_matmul_tp(x2, wte, mesh, "tcol") if mesh is not None
+                 else wq_matmul_t(x2, wte))
             logits = y.reshape(lead + (y.shape[-1],)).astype(jnp.float32)
         else:
             logits = (x.astype(dtype) @ _w(wte, dtype).T
                       ).astype(jnp.float32)
+        if logits.shape[-1] != cfg.vocab_size:
+            # vocab-padded store (engine packer pads odd vocabs like GPT-2's
+            # 50257 to the quantization group so the table can quantize and
+            # the transposed kernel can tile); padded rows are zero weight
+            logits = logits[..., :cfg.vocab_size]
     else:
         logits = _wmm(x, params["lm_head"], dtype,
-                      mesh=mesh).astype(jnp.float32)
+                      mesh=mesh, wspec="col").astype(jnp.float32)
     if cfg.unembed_bias:
         logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits
@@ -247,12 +264,36 @@ def _ffn(blk, x, cfg, mesh=None):
     return _mlp(blk["MLP_0"], x, cfg, mesh=mesh)
 
 
-def _qkv(ap, h, cfg, eq):
-    """q/k/v projections with optional biases (qwen2/gpt2 checkpoints)."""
+def _proj3(x, p, dtype, mesh, wspec):
+    """``x [..., H] @ W [H, k, d] → [..., k, d]`` keeping a quantized store
+    on the kernel path: a dim-0-grouped 3-D store flattens to a free 2-D
+    view (wq_matmul.store_as_2d) so QKV projections ride the same
+    int8/int4 stream as the MLP (round-4 verdict item 3: a large fraction
+    of decode weight traffic was still bf16).  Non-quantized weights take
+    the plain einsum."""
+    from deepspeed_tpu.ops import wq_matmul as wqm
+    from deepspeed_tpu.ops.quantization import quantized_codes
+    vv = quantized_codes(p) if isinstance(p, dict) else None
+    if vv is not None and vv.ndim == 3:
+        v2d = wqm.store_as_2d(p)
+        # dim-0 grouping only: codes' trailing dims are the output dims
+        if v2d is not None and p["s"].shape[1:] == vv.shape[1:]:
+            y = _wmm(x, v2d, dtype, mesh=mesh, wspec=wspec)
+            return y.reshape(y.shape[:-1] + vv.shape[1:])
+    lead = x.shape[:-1]
+    w = _w(p, dtype)
+    y = x.astype(dtype).reshape(-1, x.shape[-1]) @ w.reshape(w.shape[0], -1)
+    return y.reshape(lead + w.shape[1:])
+
+
+def _qkv(ap, h, cfg, mesh=None):
+    """q/k/v projections with optional biases (qwen2/gpt2 checkpoints).
+    TP layout: the heads dim shards (column-parallel), so quantized stores
+    route via wspec="col"."""
     dtype = h.dtype
-    q = jnp.einsum(eq, h, _w(ap["wq"], dtype))
-    k = jnp.einsum(eq, h, _w(ap["wk"], dtype))
-    v = jnp.einsum(eq, h, _w(ap["wv"], dtype))
+    q = _proj3(h, ap["wq"], dtype, mesh, "col")
+    k = _proj3(h, ap["wk"], dtype, mesh, "col")
+    v = _proj3(h, ap["wv"], dtype, mesh, "col")
     if cfg.qkv_bias:
         q = q + ap["bq"].astype(dtype)
         k = k + ap["bk"].astype(dtype)
@@ -260,10 +301,33 @@ def _qkv(ap, h, cfg, eq):
     return q, k, v
 
 
-def _attn_out(ap, o, cfg, eq):
-    y = jnp.einsum(eq, o, _w(ap["wo"], o.dtype))
+def _attn_out(ap, o, cfg, mesh=None):
+    """Attention output projection ``o [..., k, d] @ wo [k, d, H]``.  The
+    heads dim shards under TP (row-parallel: contraction sharded), so a
+    dim-1-grouped quantized store flattens to a 2-D kernel view and rides
+    wq_matmul_tp(mode="row")."""
+    from deepspeed_tpu.ops import wq_matmul as wqm
+    from deepspeed_tpu.ops.quantization import quantized_codes
+    dtype = o.dtype
+    p = ap["wo"]
+    lead = o.shape[:-2]
+    o2 = o.reshape(lead + (o.shape[-2] * o.shape[-1],))
+    vv = quantized_codes(p) if isinstance(p, dict) else None
+    if vv is not None:
+        v2d = wqm.store_as_2d(p) if vv.ndim == 3 else None
+        # only the dim-1-grouped flatten is a valid [k·d, H] contraction
+        # view; dim-0-grouped wo stores (small-head models whose hd can't
+        # group) dequantize at the use site instead
+        if (v2d is not None
+                and quantized_codes(v2d).shape[0] == o2.shape[-1]):
+            y = _wmm(o2, v2d, dtype, mesh=mesh, wspec="row")
+        else:
+            y = o2 @ _w(p, dtype).reshape(-1, vv.shape[-1])
+    else:
+        w = _w(p, dtype)
+        y = o2 @ w.reshape(-1, w.shape[-1])
     if cfg.attn_out_bias:
-        y = y + ap["bo"].astype(o.dtype)
+        y = y + ap["bo"].astype(dtype)
     return y
 
 
@@ -324,7 +388,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
         blk = bb[f"block_{li}"]
         ap, np_ = blk["Attention_0"], blk["Norm_0"]
         h = _norm(np_, x, cfg)
-        q, k, v = _qkv(ap, h, cfg, "nh,hkd->nkd")
+        q, k, v = _qkv(ap, h, cfg, mesh=mesh)
         if cfg.use_rope:
             # rope() takes [B, T, n, d] + positions [B, T]
             q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim,
@@ -386,7 +450,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
                 S, Q, cfg.num_heads, hd)
         o = o_dense[jnp.clip(token_slot, 0), dense_idx]      # [N, nh, hd]
         o = jnp.where(valid[:, None, None], o, 0)
-        attn_delta = _attn_out(ap, o, cfg, "nkd,kdh->nh")
+        attn_delta = _attn_out(ap, o, cfg, mesh=mesh)
         x = _block_residual(blk, x, h, attn_delta, cfg, mesh=mesh)
 
     x = _norm(bb["final_norm"], x, cfg)
@@ -440,7 +504,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
         blk = bb[f"block_{li}"]
         ap = blk["Attention_0"]
         h = _norm(blk["Norm_0"], x, cfg)
-        q, k, v = _qkv(ap, h, cfg, "sh,hkd->skd")
+        q, k, v = _qkv(ap, h, cfg, mesh=mesh)
         if cfg.use_rope:
             q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd,
                         base=cfg.rope_theta, rope_pct=cfg.rope_pct,
@@ -487,7 +551,7 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
                                 scale=cfg.attn_scale, mesh=mesh, kv_major=km,
                                 **kv_extra)
         o = o.reshape(S, nh, hd)
-        attn_delta = _attn_out(ap, o, cfg, "skd,kdh->sh")
+        attn_delta = _attn_out(ap, o, cfg, mesh=mesh)
         x = _block_residual(blk, x, h, attn_delta, cfg, mesh=mesh)
 
     x = _norm(bb["final_norm"], x, cfg)
@@ -687,7 +751,7 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
         blk = bb[f"block_{li}"]
         ap = blk["Attention_0"]
         h = _norm(blk["Norm_0"], x, cfg)
-        q, k, v = _qkv(ap, h, cfg, "sgh,hkd->sgkd")
+        q, k, v = _qkv(ap, h, cfg, mesh=mesh)
         if cfg.use_rope:
             q, k = rope(q, k, positions, hd, base=cfg.rope_theta,
                         rope_pct=cfg.rope_pct, scaling=cfg.rope_scaling,
@@ -737,7 +801,7 @@ def _verify_core(params, flat_k, flat_v, flat_ks, flat_vs, tokens, active,
         # kernel combine; zero them like ragged_forward does so no future
         # cross-row op (capacity MoE, aux stats) can see NaNs from dead rows
         o = jnp.where(active[:, None, None, None], o, 0)
-        attn_delta = _attn_out(ap, o, cfg, "sgkd,kdh->sgh")
+        attn_delta = _attn_out(ap, o, cfg, mesh=mesh)
         # FFN/MoE body is token-wise and (for MoE) expects FLAT tokens
         H = x.shape[-1]
         x = _block_residual(blk, x.reshape(S * G, H), h.reshape(S * G, H),

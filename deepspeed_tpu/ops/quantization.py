@@ -346,6 +346,17 @@ def is_quantized_weight4(leaf) -> bool:
             and getattr(leaf["v4"], "dtype", None) == jnp.int8)
 
 
+def quantized_codes(leaf):
+    """The codes array of a quantized store leaf (int8 ``v`` or packed
+    ``v4``), or None when ``leaf`` is not a store — the one place consumers
+    ask "is this quantized, and what shape is it"."""
+    if is_quantized_weight(leaf):
+        return leaf["v"]
+    if is_quantized_weight4(leaf):
+        return leaf["v4"]
+    return None
+
+
 def dequantize_weight4(d, dtype=jnp.bfloat16):
     """Inverse of ``quantize_weight4`` (jit-safe; the per-consumer call)."""
     p, s = d["v4"], d["s"]
@@ -362,14 +373,36 @@ def store_shardings(store, shardings, mesh):
     sharded axis, in which case the small scale tensor just replicates.
     This is what makes quant × tensor-parallel compose (round-3 verdict item
     4: the old flat store dropped ``in_shardings`` and rejected tp>1).
-    Nibble-packed (v4) leaves exist only on unsharded engines and
-    replicate."""
+
+    Nibble-packed (v4) leaves shard like the weight too — "pack after
+    shard": byte row r holds global rows 2r/2r+1, so a dim-0 shard of the
+    packed codes IS the packed shard of the weight as long as the shard
+    boundary never splits a row pair or a scale group (checked per dim;
+    fall back to replicating the leaf when it would)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def axis_size(ax):
+        axes = (ax,) if isinstance(ax, str) else ax
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return n
 
     def f(p, sh):
         if is_quantized_weight4(p):
-            return {"v4": NamedSharding(mesh, P()),
-                    "s": NamedSharding(mesh, P())}
+            spec = list(sh.spec)
+            spec += [None] * (p["v4"].ndim - len(spec))
+            s_spec = list(spec)
+            for d, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                n = axis_size(ax)
+                if p["v4"].shape[d] % n:
+                    spec[d] = None          # would split a nibble pair
+                if p["s"].shape[d] % n:
+                    s_spec[d] = None        # would split a scale group
+            return {"v4": NamedSharding(mesh, P(*spec)),
+                    "s": NamedSharding(mesh, P(*s_spec))}
         if not is_quantized_weight(p):
             return sh
         spec = list(sh.spec)
@@ -377,13 +410,13 @@ def store_shardings(store, shardings, mesh):
         s_spec = list(spec)
         d = _store_dim(p)
         ax = s_spec[d]
-        if ax is not None:
-            axes = (ax,) if isinstance(ax, str) else ax
-            n = 1
-            for a in axes:
-                n *= mesh.shape[a]
-            if p["s"].shape[d] % n:
-                s_spec[d] = None
+        if ax is not None and p["s"].shape[d] % axis_size(ax):
+            s_spec[d] = None
+        # vocab-padded stores: codes may be longer than the weight was —
+        # re-check the padded dim still divides
+        for dd, a in enumerate(spec):
+            if a is not None and p["v"].shape[dd] % axis_size(a):
+                spec[dd] = None
         return {"v": NamedSharding(mesh, P(*spec)),
                 "s": NamedSharding(mesh, P(*s_spec))}
     return jax.tree_util.tree_map(

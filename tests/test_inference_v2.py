@@ -631,3 +631,120 @@ class TestQuantizedWeights:
         agree = np.mean([np.mean(np.asarray(a) == np.asarray(b))
                          for a, b in zip(got, want)])
         assert agree > 0.5              # random weights: near-ties flip
+
+
+class TestKernelReach:
+    """Round-4 verdict items 2/3/7: the quantized-weight kernels must engage
+    on attention projections, under tensor parallelism, on packed int4
+    stores, and on real (non-tiling) vocabs — asserted via the kernels'
+    trace counters, not just output correctness (a silent dequant fallback
+    produces the same numbers while reading 2× the HBM)."""
+
+    KCFG = GPTConfig.llama(num_layers=2, hidden=128, heads=4,
+                           vocab_size=128, max_seq_len=64)
+
+    def _counts(self):
+        from deepspeed_tpu.ops import wq_matmul as wqm
+        return dict(wqm.trace_counts)
+
+    def test_kernel_engages_everywhere_single_shard(self, v2cfg, rng):
+        """hidden=128/hd=32/group 32: QKV (dim-0 3-D view), attn-out
+        (dim-1 3-D view), MLP, and untied lm_head all ride the W8 kernel."""
+        base = InferenceEngineV2(self.KCFG, config=v2cfg, seed=0)
+        before = self._counts()
+        q = InferenceEngineV2(
+            self.KCFG, config=dict(v2cfg, quant={"enabled": True,
+                                                 "group_size": 32}),
+            params=base.params, seed=0)
+        prompts = [rng.integers(0, 128, (11,)).astype(np.int32)]
+        got = q.generate(prompts, max_new_tokens=8)
+        after = self._counts()
+        # per compiled program: 3 qkv + 1 attn-out per layer (2 layers),
+        # 3 mlp (gated) per layer, 1 unembed — several programs compile
+        # (prefill buckets + decode burst), so just require a healthy count
+        assert after["w8"] - before["w8"] >= 10, (before, after)
+        want = base.generate(prompts, max_new_tokens=8)
+        agree = np.mean(np.asarray(got[0]) == np.asarray(want[0]))
+        assert agree > 0.5
+
+    def test_kernel_engages_under_tp2(self, v2cfg, rng):
+        """The round-4 bypass ran tp>1 on the dequant path; the shard_map
+        wrapper must keep the kernel engaged AND reproduce tp=1 tokens."""
+        base = InferenceEngineV2(self.KCFG, config=v2cfg, seed=0)
+        qc = {"enabled": True, "group_size": 32}
+        q1 = InferenceEngineV2(self.KCFG, config=dict(v2cfg, quant=qc),
+                               params=base.params, seed=0)
+        prompts = [rng.integers(0, 128, (12 + 3 * i,)).astype(np.int32)
+                   for i in range(3)]
+        got1 = q1.generate(prompts, max_new_tokens=10)
+        before = self._counts()
+        q2 = InferenceEngineV2(
+            self.KCFG, config=dict(v2cfg, quant=qc,
+                                   tensor_parallel={"tp_size": 2}),
+            params=base.params, seed=0)
+        got2 = q2.generate(prompts, max_new_tokens=10)
+        after = self._counts()
+        assert after["w8"] - before["w8"] >= 10, (before, after)
+        for a, b in zip(got1, got2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_w4_kernel_engages(self, v2cfg, rng):
+        """bits=4 now serves through the packed W4A16 kernel (group 64)."""
+        base = InferenceEngineV2(self.KCFG, config=v2cfg, seed=0)
+        before = self._counts()
+        q = InferenceEngineV2(
+            self.KCFG, config=dict(v2cfg, quant={"enabled": True, "bits": 4,
+                                                 "group_size": 64}),
+            params=base.params, seed=0)
+        prompts = [rng.integers(0, 128, (11,)).astype(np.int32)]
+        outs = q.generate(prompts, max_new_tokens=8)
+        after = self._counts()
+        assert after["w4"] - before["w4"] >= 4, (before, after)
+        assert len(outs[0]) == 8
+
+    def test_w4_tp2_matches_tp1(self, v2cfg, rng):
+        """Nibble packing no longer forces single-shard: pack-after-shard
+        keeps pairs/groups intact over tp=2 and tokens must match tp=1."""
+        base = InferenceEngineV2(self.KCFG, config=v2cfg, seed=0)
+        qc = {"enabled": True, "bits": 4, "group_size": 64}
+        prompts = [rng.integers(0, 128, (12,)).astype(np.int32)]
+        q1 = InferenceEngineV2(self.KCFG, config=dict(v2cfg, quant=qc),
+                               params=base.params, seed=0)
+        got1 = q1.generate(prompts, max_new_tokens=8)
+        q2 = InferenceEngineV2(
+            self.KCFG, config=dict(v2cfg, quant=qc,
+                                   tensor_parallel={"tp_size": 2}),
+            params=base.params, seed=0)
+        got2 = q2.generate(prompts, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(got1[0]),
+                                      np.asarray(got2[0]))
+
+    def test_tied_odd_vocab_pads_and_serves(self, v2cfg, rng):
+        """GPT-2-class odd vocabs (here 250) pad to the quantization group
+        at store creation so the table quantizes and the transposed kernel
+        tiles; logits slice back to vocab_size (round-4 verdict item 7)."""
+        import dataclasses
+        tcfg = GPTConfig.llama(num_layers=2, hidden=128, heads=4,
+                               vocab_size=250, max_seq_len=64)
+        tcfg = dataclasses.replace(tcfg, tie_embeddings=True)
+        base = InferenceEngineV2(tcfg, config=v2cfg, seed=0)
+        before = self._counts()
+        q = InferenceEngineV2(
+            tcfg, config=dict(v2cfg, quant={"enabled": True,
+                                            "group_size": 128}),
+            params=base.params, seed=0)
+        from deepspeed_tpu.ops.quantization import is_quantized_weight
+        wte = q.params["backbone"]["wte"]
+        assert is_quantized_weight(wte)
+        assert wte["v"].shape[0] == 256          # padded to the group
+        prompts = [rng.integers(0, 250, (11 + i,)).astype(np.int32)
+                   for i in range(3)]
+        got = q.generate(prompts, max_new_tokens=8)
+        after = self._counts()
+        assert after["w8t"] - before["w8t"] >= 1, (before, after)
+        want = base.generate(prompts, max_new_tokens=8)
+        agree = np.mean([np.mean(np.asarray(a) == np.asarray(b))
+                         for a, b in zip(got, want)])
+        assert agree > 0.5
+        for o in got:                            # padded ids never emitted
+            assert np.all(np.asarray(o) < 250)
