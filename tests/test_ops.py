@@ -935,3 +935,68 @@ class TestEvoformer:
                                    atol=2e-5)
         g = jax.grad(lambda q_: jnp.sum(evoformer_attention(q_, k, v, bias1)))(q)
         assert not np.any(np.isnan(np.asarray(g)))
+
+
+class TestEvoformerPadding:
+    """Odd-S MSA stacks (round-4 verdict item 6): S that doesn't block-tile
+    pads to the grid instead of silently materializing the O(S²) einsum;
+    the residual einsum fallbacks warn once."""
+
+    def test_odd_s_pads_onto_kernel_and_matches(self, rng):
+        from deepspeed_tpu.ops.evoformer import (_evoformer_xla,
+                                                 evoformer_attention,
+                                                 supported)
+        B, N, S, H, D = 1, 2, 21, 2, 8            # 21 never tiles
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        bias1 = jnp.asarray(rng.standard_normal((B, N, 1, 1, S)), jnp.float32)
+        bias2 = jnp.asarray(rng.standard_normal((B, 1, H, S, S)), jnp.float32)
+        assert not supported(q, k, v)
+        got = evoformer_attention(q, k, v, bias1, bias2)
+        want = _evoformer_xla(q, k, v, bias1, bias2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+        # gradients flow through the pad/slice to the ORIGINAL bias shapes
+        def loss(fn):
+            return lambda q_, b1, b2: jnp.sum(fn(q_, k, v, b1, b2) * 0.01)
+        gp = jax.grad(loss(evoformer_attention), argnums=(0, 1, 2))(
+            q, bias1, bias2)
+        gx = jax.grad(loss(_evoformer_xla), argnums=(0, 1, 2))(
+            q, bias1, bias2)
+        for name, a, b in zip(("dq", "dbias1", "dbias2"), gp, gx):
+            assert a.shape == b.shape, name
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, err_msg=name)
+
+    def test_odd_s_no_bias(self, rng):
+        """Padding with NO caller bias must still mask the padded keys
+        (a synthetic bias1 carries the -1e9 tail)."""
+        from deepspeed_tpu.ops.evoformer import (_evoformer_xla,
+                                                 evoformer_attention)
+        B, N, S, H, D = 1, 1, 13, 1, 8
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        got = evoformer_attention(q, k, v)
+        want = _evoformer_xla(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_residual_fallback_warns_once(self, rng):
+        """d % 8 != 0 cannot pad onto the kernel — einsum with ONE warning
+        (wq_matmul's warn-once policy; the project logger doesn't
+        propagate, so assert via the dedup set the warning keys off)."""
+        from deepspeed_tpu.ops import evoformer as evo
+        B, N, S, H, D = 1, 1, 16, 1, 7
+        q, k, v = (jnp.asarray(rng.standard_normal((B, N, S, H, D)),
+                               jnp.float32) for _ in range(3))
+        evo._warned_fallback.clear()
+        out1 = evo.evoformer_attention(q, k, v)
+        assert len(evo._warned_fallback) == 1
+        out2 = evo.evoformer_attention(q, k, v)
+        assert len(evo._warned_fallback) == 1      # deduped, not re-warned
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+        # and the odd-S path must NOT be in the fallback set (it pads)
+        q8, k8, v8 = (jnp.asarray(rng.standard_normal((1, 1, 13, 1, 8)),
+                                  jnp.float32) for _ in range(3))
+        evo.evoformer_attention(q8, k8, v8)
+        assert len(evo._warned_fallback) == 1
