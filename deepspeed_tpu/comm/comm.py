@@ -186,8 +186,12 @@ def hlo_collective_bytes(hlo_text: str) -> Dict[str, Dict[str, int]]:
         if "-done(" in line:
             continue                       # count the async pair once
         if shape_s.startswith("("):
-            nbytes = sum(_shape_bytes(s)
-                         for s in shape_s.strip("()").split(","))
+            # tuple shapes: split on whole shape tokens, NOT on every comma
+            # (dims contain commas — 's8[2,28]' would otherwise parse as
+            # 's8[2' + '28]' = 0 bytes, silently zeroing e.g. the qgZ
+            # all-to-all payload)
+            nbytes = sum(_shape_bytes(s) for s in
+                         re.findall(r"[a-z0-9]+\[[0-9,]*\]", shape_s))
         else:
             nbytes = _shape_bytes(shape_s)
         rec = out.setdefault(kind, {"count": 0, "bytes": 0})

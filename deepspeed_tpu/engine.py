@@ -150,70 +150,70 @@ class DeepSpeedTPUEngine:
         # with an int8-wire all-to-all (_qgz_grads) instead of the
         # partitioner's implicit fp32 reduce-scatter.
         self._qgz_axis = None
-        self._qgz_partial_manual = False
         if config.zero_optimization.zero_quantized_gradients:
-            model_axes = {a: mesh.shape[a] for a in ("tp", "sp", "ep", "pp")
-                          if mesh.shape[a] > 1}
+            nested_axes = {a: mesh.shape[a] for a in ("sp", "ep", "pp")
+                           if mesh.shape[a] > 1}
             data_axes = [a for a in ("dp", "fsdp") if mesh.shape[a] > 1]
             if self.zero_stage < 2:
                 raise ValueError(
                     "zero_quantized_gradients requires zero stage >= 2 "
                     "(gradients must be partitioned for the quantized "
                     "reduce-scatter to have a scatter target)")
-            if model_axes:
-                # ANY stage: the engine runs the loss with the model UNBOUND
-                # from the mesh under qgZ (see the bind site below) — sp/tp/
-                # ep features would silently no-op, so reject loudly
+            if nested_axes:
+                # sp/ep/pp express their collectives with their OWN
+                # shard_map (ring/Ulysses/MoE route/pipeline) — shardy
+                # cannot nest a manual_computation inside the manual-dp
+                # grad region ('operates on axis already bound by a
+                # parent'), so these compose only via the auto path
                 raise NotImplementedError(
-                    f"zero_quantized_gradients composes with data-parallel "
-                    f"meshes only (model-parallel axes {model_axes} would "
-                    f"need the model's mesh-bound collectives to coexist "
-                    f"with the manual grad shard_map)")
-            if self.zero_stage >= 3:
-                # stage 3: the fsdp grad reduce-scatter is fused with the
-                # param gather by the partitioner and rides intra-group ICI;
-                # qgZ compresses the CROSS-REPLICA dp reduce (MiCS/hpZ
-                # cross-group traffic — the reference qgZ's actual target,
-                # ZeRO++ hierarchical design).  shard_map runs manual over
-                # dp ONLY; fsdp stays auto under GSPMD.
-                if mesh.shape["dp"] > 1:
-                    self._qgz_axis = "dp"
-                    self._qgz_partial_manual = True
-                else:
-                    logger.warning(
-                        "zero_quantized_gradients at stage 3 with dp=1: the "
-                        "only gradient reduce is the intra-group fsdp "
-                        "reduce-scatter fused with the param gather — "
-                        "nothing to quantize; flag is inert on this mesh "
-                        "(add a dp axis / MiCS grouping for cross-group "
-                        "compression)")
-            elif len(data_axes) > 1:
-                raise NotImplementedError(
-                    "zero_quantized_gradients over two data axes (dp AND "
-                    "fsdp both > 1) is unsupported at stage 2; fold data "
-                    "parallelism into one axis")
+                    f"zero_quantized_gradients with mesh axes {nested_axes}"
+                    f": sequence/expert/pipeline parallelism run their own "
+                    f"shard_map collectives, which cannot nest inside the "
+                    f"manual data-axis gradient shard_map; tp composes "
+                    f"(pure GSPMD), sp/ep/pp do not yet")
+            # qgZ quantizes the CROSS-REPLICA dp reduce; everything else
+            # (fsdp param-gather-fused reduce-scatter, tp activation
+            # collectives) stays under GSPMD inside the partial-manual
+            # body.  At stage >= 3 (and stage 2 with dp x fsdp) the fsdp
+            # reduce rides intra-group ICI — the reference qgZ's
+            # hierarchical design targets exactly the cross-group hop.
+            if mesh.shape["dp"] > 1:
+                self._qgz_axis = "dp"
+            elif mesh.shape["fsdp"] > 1 and self.zero_stage < 3:
+                self._qgz_axis = "fsdp"
             elif not data_axes:
                 logger.warning(
                     "zero_quantized_gradients set but the data-parallel "
                     "world is 1 — there is no gradient reduce to quantize; "
                     "flag is inert on this mesh")
             else:
-                self._qgz_axis = data_axes[0]
+                logger.warning(
+                    "zero_quantized_gradients at stage 3 with dp=1: the "
+                    "only gradient reduce is the intra-group fsdp "
+                    "reduce-scatter fused with the param gather — "
+                    "nothing to quantize; flag is inert on this mesh "
+                    "(add a dp axis / MiCS grouping for cross-group "
+                    "compression)")
             if self._qgz_axis:
+                auto = [a for a in ("fsdp", "tp")
+                        if mesh.shape[a] > 1 and a != self._qgz_axis]
+                if len(auto) > 1:
+                    # two auto axes under one manual axis trips a fatal
+                    # CHECK in XLA's SPMD partitioner
+                    # (spmd_partitioner_util.cc replica-group mismatch) —
+                    # refuse rather than crash the process; one auto axis
+                    # (dp x fsdp, dp x tp) composes fine
+                    raise NotImplementedError(
+                        f"zero_quantized_gradients over '{self._qgz_axis}' "
+                        f"with BOTH {auto[0]} > 1 and {auto[1]} > 1: XLA's "
+                        f"partitioner cannot yet mix two auto axes under "
+                        f"the manual gradient region (fatal partitioner "
+                        f"check); drop one axis or disable qgZ")
                 log_dist(f"qgZ: int8 gradient reduce over mesh axis "
                          f"'{self._qgz_axis}' "
                          f"({mesh.shape[self._qgz_axis]} ways"
-                         + (", fsdp under GSPMD"
-                            if self._qgz_partial_manual else "")
-                         + ")", ranks=[0])
-            if self._qgz_partial_manual:
-                logger.warning(
-                    "qgZ at stage 3 runs the model unbound from the mesh: "
-                    "the anti-rematerialization sharding constraints "
-                    "(embedding gather / activation pinning) are left to "
-                    "GSPMD's own layout choices inside the manual grad "
-                    "shard_map — profile the embedding path before large "
-                    "runs")
+                         + (f", {'/'.join(auto)} under GSPMD" if auto
+                            else "") + ")", ranks=[0])
 
         # low-precision mode casts PARAMS, but flax models own their COMPUTE
         # dtype — fp32 activations silently demote every matmul off the bf16
@@ -232,12 +232,14 @@ class DeepSpeedTPUEngine:
                     f"in the model config for full throughput.", ranks=[0])
 
         # ---- model functions ----
-        # bind the engine's mesh into mesh-aware models (MoE ep route, Ulysses).
-        # Under qgZ the loss runs inside a MANUAL shard_map over the data axis,
-        # where the model's GSPMD sharding constraints don't apply — leave the
-        # model unbound (the gate above already excludes mesh-axis models).
+        # bind the engine's mesh into mesh-aware models (MoE ep route,
+        # Ulysses).  The model stays BOUND under qgZ too (round-4 verdict:
+        # unbinding left the embedding path to GSPMD's layout whims inside
+        # the manual grad shard_map): constraints naming auto axes
+        # (fsdp/tp) apply inside the partial-manual body, and constraints
+        # naming the manual data axis are dropped by the partitioner.
         if (hasattr(model, "clone") and hasattr(model, "mesh")
-                and model.mesh is None and self._qgz_axis is None):
+                and model.mesh is None):
             model = model.clone(mesh=self.mesh)
         # random-LTD: push the configured layer ids into the model config so
         # ds_config is the single source of truth (reference: the data_routing
@@ -784,10 +786,9 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.ops.quantization import qpsum_local, qrs_local
         mesh, axis = self.mesh, self._qgz_axis
         size = mesh.shape[axis]
-        # stage 3 (partial-manual): only the dp axis is manual — fsdp (and
-        # any model axes) stay auto, so GSPMD still inserts the intra-group
-        # param gathers / grad reduce-scatters inside the body
-        axis_names = {axis} if self._qgz_partial_manual else None
+        # partial-manual: ONLY the data axis is manual — fsdp/tp stay
+        # auto, so GSPMD still inserts the intra-group param gathers /
+        # grad reduce-scatters / tp activation collectives inside the body
 
         def scatter_dim(sh):
             for d, ax in enumerate(sh.spec):
@@ -813,6 +814,17 @@ class DeepSpeedTPUEngine:
                               for i in range(g.ndim)]) if d >= 0 else P()),
             dims, state.params)
 
+        # in-body binding (round-4 verdict item 4): re-anchor each reduced
+        # grad to the AUTO part of its target sharding inside the manual
+        # region, so GSPMD lays out the fsdp/tp dims there instead of
+        # deferring every layout choice to the exit constraint
+        from jax.sharding import NamedSharding
+        from deepspeed_tpu.parallel.mesh import auto_axes_spec
+        auto_shardings = jax.tree_util.tree_map(
+            lambda sh: NamedSharding(mesh, auto_axes_spec(sh.spec,
+                                                          manual={axis})),
+            self.grad_shardings)
+
         def local(params, mb, rng, scale, step):
             # decorrelate dropout masks across data shards (the global-batch
             # path gets this for free from position-dependent masking)
@@ -829,12 +841,12 @@ class DeepSpeedTPUEngine:
                     return qpsum_local(g, axis, size, 0) / size
                 return jax.lax.psum(g, axis) / size
             grads = jax.tree_util.tree_map(red, grads, dims)
+            grads = jax.lax.with_sharding_constraint(grads, auto_shardings)
             return grads, jax.lax.pmean(loss, axis)
 
-        kw = {"axis_names": axis_names} if axis_names else {}
         grads, loss = shard_map(
             local, mesh=mesh, in_specs=(pspecs, bspecs, P(), P(), P()),
-            out_specs=(gspecs, P()), check_vma=False, **kw)(
+            out_specs=(gspecs, P()), check_vma=False, axis_names={axis})(
                 state.params, batch, rng, state.loss_scale.scale, state.step)
         grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
         return grads, loss

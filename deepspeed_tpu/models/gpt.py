@@ -159,12 +159,13 @@ def _gather_table(table, mesh, vocab_axis="tp"):
     if mesh is None:
         return table
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.parallel.mesh import auto_axes_spec
     spec0 = None
     if (vocab_axis and mesh.shape.get(vocab_axis, 1) > 1
             and table.shape[0] % mesh.shape[vocab_axis] == 0):
         spec0 = vocab_axis
     return jax.lax.with_sharding_constraint(
-        table, NamedSharding(mesh, P(spec0, None)))
+        table, NamedSharding(mesh, auto_axes_spec(P(spec0, None))))
 
 
 def _pin_activations(x, mesh, seq_parallel: bool):
@@ -178,7 +179,13 @@ def _pin_activations(x, mesh, seq_parallel: bool):
     if mesh is None:
         return x
     from jax.sharding import NamedSharding, PartitionSpec as P
-    baxes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    from deepspeed_tpu.parallel.mesh import manual_axes_now
+    # axes already applied by an enclosing manual shard_map (qgZ grad
+    # region) drop out: in-body shapes are LOCAL over them, and naming
+    # them in a constraint is illegal — size and pin over the rest
+    manual = manual_axes_now()
+    baxes = tuple(a for a in ("dp", "fsdp")
+                  if mesh.shape.get(a, 1) > 1 and a not in manual)
     bsize = 1
     for a in baxes:
         bsize *= mesh.shape[a]
@@ -186,7 +193,8 @@ def _pin_activations(x, mesh, seq_parallel: bool):
     if baxes and x.shape[0] % bsize == 0:
         spec[0] = baxes if len(baxes) > 1 else baxes[0]
     sp = mesh.shape.get("sp", 1)
-    if seq_parallel and sp > 1 and x.ndim > 1 and x.shape[1] % sp == 0:
+    if (seq_parallel and sp > 1 and "sp" not in manual and x.ndim > 1
+            and x.shape[1] % sp == 0):
         spec[1] = "sp"
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 

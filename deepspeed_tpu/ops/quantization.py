@@ -132,13 +132,26 @@ def quantized_all_gather(x, mesh, axis: str, *, bits: int = 8,
                      out_specs=P(), check_vma=False)(x)
 
 
+def _wire_block(n: int, block_size: int) -> int:
+    """Effective wire block for an ``n``-element slice: the configured size,
+    halved (min 8) while the slice wouldn't even half-fill it.  Blockwise
+    padding is pure wire waste — a 4-element bias slice padded to a 256
+    block ships 64x its data; real models carry many such small leaves
+    (biases, norms) next to the big matrices."""
+    b = block_size
+    while b > 8 and n <= b // 2:
+        b //= 2
+    return b
+
+
 def qag_local(xs, axis: str, size: int, gather_dim: int = 0, *,
               bits: int = 8, block_size: int = 256):
     """Per-device body of a quantized all-gather (inside ``shard_map`` over
     ``axis``): int values + fp32 block scales on the wire, per-member dequant,
     concat along ``gather_dim``.  Shared by ``quantized_all_gather`` and
     ``qpsum_local``."""
-    qb = quantize_blockwise(xs, bits=bits, block_size=block_size)
+    qb = quantize_blockwise(xs, bits=bits,
+                            block_size=_wire_block(xs.size, block_size))
     vg = jax.lax.all_gather(qb.values, axis)             # int8 on the wire
     sg = jax.lax.all_gather(qb.scales, axis)
     parts = [
@@ -161,6 +174,7 @@ def qrs_local(xs, axis: str, size: int, scatter_dim: int = 0, *,
     Returns this device's reduced slice (shape[scatter_dim] / size).
     """
     parts = jnp.split(xs, size, axis=scatter_dim)
+    block_size = _wire_block(parts[0].size, block_size)
     qbs = [quantize_blockwise(p, bits=bits, block_size=block_size)
            for p in parts]
     v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),

@@ -114,3 +114,37 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape[name]
+
+
+def manual_axes_now() -> frozenset:
+    """Mesh axes that are MANUAL in the current trace context (inside a
+    (partial-)manual ``shard_map`` region), else empty.  The engine's qgZ
+    gradient path runs the WHOLE model inside a manual-over-dp region
+    (engine._qgz_grads); model code that builds sharding constraints or
+    sizes shards from the mesh must treat those axes as already-applied."""
+    am = jax.sharding.get_abstract_mesh()
+    if am.empty:
+        return frozenset()
+    from jax.sharding import AxisType
+    return frozenset(n for n, t in zip(am.axis_names, am.axis_types)
+                     if t == AxisType.Manual)
+
+
+def auto_axes_spec(spec: P, manual=None) -> P:
+    """Strip manual axes from a PartitionSpec —
+    ``with_sharding_constraint`` inside a manual region may only name auto
+    axes (a spec mixing a manual axis into a tuple, like the batch pin's
+    ``('dp', 'fsdp')``, raises at trace time).  ``manual`` defaults to the
+    current trace context's manual axes (identity outside any region);
+    pass a set explicitly when building specs AHEAD of entering the
+    region (engine._qgz_grads)."""
+    if manual is None:
+        manual = manual_axes_now()
+    if not manual:
+        return spec
+    out = []
+    for ax in spec:
+        axes = tuple(a for a in (ax if isinstance(ax, tuple) else (ax,))
+                     if a is not None and a not in manual)
+        out.append(axes[0] if len(axes) == 1 else (axes or None))
+    return P(*out)

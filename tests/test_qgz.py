@@ -148,7 +148,7 @@ class TestQgzStage3:
     def test_loss_curve_parity(self, devices):
         base = _build(qgz=False, stage=3, mesh_kw=self.MESH, seed=3)
         qgz = _build(qgz=True, stage=3, mesh_kw=self.MESH, seed=3)
-        assert qgz._qgz_axis == "dp" and qgz._qgz_partial_manual
+        assert qgz._qgz_axis == "dp"
         gbs = base.train_batch_size
         lb = [float(base.train_batch(b).loss) for b in _data(20, gbs, seed=9)]
         lq = [float(qgz.train_batch(b).loss) for b in _data(20, gbs, seed=9)]
@@ -201,9 +201,12 @@ class TestQgzGates:
         with pytest.raises(ValueError, match="stage >= 2"):
             _build(qgz=True, stage=1)
 
-    def test_model_parallel_rejected(self, devices):
-        with pytest.raises(NotImplementedError, match="data-parallel"):
-            _build(qgz=True, mesh_kw={"dp": 4, "fsdp": 1, "tp": 2})
+    def test_nested_shard_map_axes_rejected(self, devices):
+        """sp/ep express their collectives with their own shard_map, which
+        shardy cannot nest inside the manual-dp grad region — loud gate
+        (tp composes and is covered in TestQgzComposition)."""
+        with pytest.raises(NotImplementedError, match="sp"):
+            _build(qgz=True, mesh_kw={"dp": 2, "fsdp": 1, "sp": 4})
 
     def test_world1_inert(self, devices):
         """dp world 1: the flag degrades to a logged warning + the normal
@@ -213,3 +216,96 @@ class TestQgzGates:
         losses = [float(engine.train_batch(b).loss)
                   for b in _data(10, engine.train_batch_size, seed=9)]
         assert losses[-1] < losses[0]
+
+
+class TestQgzComposition:
+    """Round-4 verdict item 4: widen qgZ's envelope.  tp runs under GSPMD
+    INSIDE the manual-dp gradient shard_map (pure-constraint parallelism
+    needs no nested manual region), stage 2 composes dp x fsdp (fsdp auto,
+    dp quantized), and the model stays mesh-BOUND under qgZ (embedding /
+    activation constraints on auto axes apply in-body)."""
+
+    def _hlo(self, engine):
+        batch = next(_data(1, engine.train_batch_size, seed=5))
+        batch = engine._reshape_gas(batch)
+        batch = engine._shard_batch(batch, leading_gas=True)
+        with engine.mesh:
+            return jax.jit(engine._train_batch_fn).lower(
+                engine.state, batch).compile().as_text()
+
+    def test_tp2_loss_parity_and_s8_wire(self, devices):
+        mesh_kw = {"dp": 4, "tp": 2}
+        base = _build(qgz=False, mesh_kw=mesh_kw, seed=3)
+        qgz = _build(qgz=True, mesh_kw=mesh_kw, seed=3)
+        assert qgz._qgz_axis == "dp"
+        assert qgz.model.mesh is not None         # stays mesh-bound
+        gbs = base.train_batch_size
+        lb = [float(base.train_batch(b).loss) for b in _data(20, gbs, seed=9)]
+        lq = [float(qgz.train_batch(b).loss) for b in _data(20, gbs, seed=9)]
+        assert lq[-1] < lq[0] * 0.8, "qgZ x tp engine failed to learn"
+        assert abs(lq[-1] - lb[-1]) / max(lb[-1], 1e-6) < 0.10, (lb, lq)
+        txt = self._hlo(qgz)
+        assert any("s8[" in ln for ln in txt.splitlines()
+                   if "all-to-all" in ln), "no s8 all-to-all under tp"
+
+    def test_stage2_dp_x_fsdp_parity_and_s8_bulk(self, devices):
+        """Both data axes > 1 at stage 2 (previously rejected): dp goes
+        int8-manual, the fsdp reduce stays under GSPMD.  The honest wire
+        claim here is PER-AXIS, not total: the fsdp (intra-group ICI)
+        reduce is intentionally fp32, and the quantized path's own
+        gather/scale legs add ops — what must hold is that the cross-group
+        dp reduce moves s8 covering the gradient volume (1 byte/param
+        through the all-to-all)."""
+        import re
+        mesh_kw = {"dp": 2, "fsdp": 4}
+        base = _build(qgz=False, stage=2, mesh_kw=mesh_kw, seed=3)
+        qgz = _build(qgz=True, stage=2, mesh_kw=mesh_kw, seed=3)
+        assert qgz._qgz_axis == "dp"
+        gbs = base.train_batch_size
+        lb = [float(base.train_batch(b).loss) for b in _data(20, gbs, seed=9)]
+        lq = [float(qgz.train_batch(b).loss) for b in _data(20, gbs, seed=9)]
+        assert lq[-1] < lq[0] * 0.8
+        assert abs(lq[-1] - lb[-1]) / max(lb[-1], 1e-6) < 0.10, (lb, lq)
+        txt = self._hlo(qgz)
+        s8_bytes = 0
+        pat = re.compile(r"=\s*s8\[([0-9,]*)\]\S*\s+"
+                         r"(?:all-to-all|all-gather)(?:-start)?\(")
+        for ln in txt.splitlines():
+            m = pat.search(ln)
+            if m:
+                n = 1
+                for d in m.group(1).split(","):
+                    if d:
+                        n *= int(d)
+                s8_bytes += n
+        assert s8_bytes >= 0.5 * qgz.num_parameters, (
+            s8_bytes, qgz.num_parameters)
+
+    def test_sp_still_rejected_loudly(self, devices):
+        """sp's ring/Ulysses collectives are their own shard_map — shardy
+        cannot nest manual regions, so the gate must stay LOUD (silent
+        no-op sequence parallelism would be far worse)."""
+        import dataclasses
+        with pytest.raises(NotImplementedError, match="sp"):
+            cfg = {
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 2,
+                                      "zero_quantized_gradients": True},
+                "mesh": {"dp": 2, "sp": 4},
+                "steps_per_print": 0,
+            }
+            mcfg = dataclasses.replace(
+                GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ),
+                sequence_parallel=True)
+            deepspeed_tpu.initialize(
+                model=GPT(mcfg), config=cfg,
+                example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+
+
+def test_fsdp_x_tp_gated(devices):
+    """qgZ + fsdp>1 + tp>1 trips a fatal CHECK inside XLA's SPMD
+    partitioner — the engine must refuse the config instead of letting the
+    process die mid-compile."""
+    with pytest.raises(NotImplementedError, match="fsdp"):
+        _build(qgz=True, stage=3, mesh_kw={"dp": 2, "fsdp": 2, "tp": 2})
