@@ -62,6 +62,12 @@ def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
            quant_weights=False, quant_bits=8):
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
+    quant = {"enabled": bool(quant_weights), "bits": quant_bits}
+    if quant_bits == 4:
+        # the W4A16 Mosaic kernel's de-interleaved activation tile needs
+        # group % 256 (ops/wq_matmul.kernel4_supported); 128 would silently
+        # measure the dequant fallback
+        quant["group_size"] = 256
     eng = InferenceEngineV2(
         cfg,
         {"state_manager": {
@@ -71,7 +77,7 @@ def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
             "max_q_per_seq": 512,
             "kv_block_size": block_size,
             "kv_quant": kv_quant},
-         "quant": {"enabled": bool(quant_weights), "bits": quant_bits},
+         "quant": quant,
          "generation": {"do_sample": False}},
         params=params)
     # warm every compiled path (prefill buckets, decode, burst sizes) by
@@ -158,12 +164,15 @@ def run_v1_bucketed(cfg, params, prompts, budgets):
     return useful / dt
 
 
-def train_memorized(cfg, pool, steps, lr=3e-3, micro=8):
+def train_memorized(cfg, pool, steps, lr=3e-3, micro=8, stop_loss=None):
     """Train GPT(cfg) to memorize ``pool`` ([N, T] int32) and return the
     params in serving-tree form — the substrate for the speculative leg:
     a draft and a target that BOTH memorized the pool produce correlated
     continuations, giving realistic (high) acceptance without needing real
-    checkpoints in-image."""
+    checkpoints in-image.  ``steps`` is a CAP; ``stop_loss`` ends training
+    once the pool is actually memorized (round 5: a fixed 250 steps left
+    the full-size pair at loss ~3 — nothing memorized, acceptance collapsed
+    to the free token, and the leg measured pure overhead)."""
     import deepspeed_tpu
     from deepspeed_tpu.models import GPT
 
@@ -179,9 +188,11 @@ def train_memorized(cfg, pool, steps, lr=3e-3, micro=8):
     rng = np.random.default_rng(7)
     gbs = engine.train_batch_size              # micro × dp_world
     loss = None
-    for _ in range(steps):
+    for i in range(steps):
         idx = rng.integers(0, len(pool), size=(gbs,))
         loss = float(engine.train_batch({"input_ids": pool[idx]}).loss)
+        if stop_loss is not None and i >= 20 and loss < stop_loss:
+            break
     import jax
     params = jax.device_get(engine.state.params)
     del engine
@@ -239,13 +250,18 @@ def spec_leg(smoke=False):
         dcfg = GPTConfig.llama(num_layers=4, hidden=512, heads=8,
                                num_kv_heads=4, vocab_size=32000,
                                max_seq_len=2048)
-        pool_n, train_steps, nreq = 24, 250, 2 * SLOTS
+        # a pool small enough that BOTH models can actually memorize it in
+        # bounded steps — acceptance comes from shared memorization, and an
+        # un-memorized pool measures only spec overhead
+        pool_n, train_steps, nreq = 8, 2500, 2 * SLOTS
     T = 256
     pool = rng.integers(0, tcfg.vocab_size, size=(pool_n, T)).astype(np.int32)
-    tparams, tloss = train_memorized(tcfg, pool, train_steps)
+    tparams, tloss = train_memorized(tcfg, pool, train_steps,
+                                     stop_loss=None if smoke else 0.25)
     # the draft is ~5x cheaper per step AND the leg lives or dies on its
-    # acceptance — train it 2x longer so the smaller model memorizes too
-    dparams, dloss = train_memorized(dcfg, pool, 2 * train_steps)
+    # acceptance — give it 2x the cap so the smaller model memorizes too
+    dparams, dloss = train_memorized(dcfg, pool, 2 * train_steps,
+                                     stop_loss=None if smoke else 0.25)
     out["spec_target_train_loss"] = round(tloss, 3)
     out["spec_draft_train_loss"] = round(dloss, 3)
 
@@ -318,22 +334,41 @@ def main():
 
     nreq = (2 if smoke else 4) * SLOTS
     prompts, budgets = make_workload(rng, cfg, nreq=nreq)
-    v2_tps = run_v2(cfg, params, prompts, budgets)
-    v1_tps = run_v1(cfg, params, prompts, budgets)
-    v1b_tps = run_v1_bucketed(cfg, params, prompts, budgets)
-    int8_tps = run_v2(cfg, params, prompts, budgets, kv_quant="int8")
-    wq_tps = run_v2(cfg, params, prompts, budgets, quant_weights=True)
-    w4_tps = run_v2(cfg, params, prompts, budgets, quant_weights=True,
-                    quant_bits=4)
-    one_v2, one_v1 = run_oneshot(cfg, params, rng)
+
+    errors = {}
+
+    def leg(name, fn):
+        """One leg crashing must not kill the bench (round 5: the first
+        on-chip run died wholesale inside the unguarded wq leg — a Mosaic
+        compile error — and the sweep recorded 'no JSON' instead of the
+        five legs that had already finished)."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            errors[name] = f"{type(e).__name__}: {str(e)[:160]}"
+            return 0.0
+
+    ratio = lambda a, b: round(a / b, 3) if b else 0.0  # noqa: E731
+    v2_tps = leg("ragged", lambda: run_v2(cfg, params, prompts, budgets))
+    v1_tps = leg("static", lambda: run_v1(cfg, params, prompts, budgets))
+    v1b_tps = leg("static_bucketed",
+                  lambda: run_v1_bucketed(cfg, params, prompts, budgets))
+    int8_tps = leg("int8_kv", lambda: run_v2(cfg, params, prompts, budgets,
+                                             kv_quant="int8"))
+    wq_tps = leg("wq", lambda: run_v2(cfg, params, prompts, budgets,
+                                      quant_weights=True))
+    w4_tps = leg("w4", lambda: run_v2(cfg, params, prompts, budgets,
+                                      quant_weights=True, quant_bits=4))
+    one_v2, one_v1 = leg("oneshot", lambda: run_oneshot(cfg, params, rng)) \
+        or (0.0, 0.0)
     extra = {"static_batch_tokens_per_sec": round(v1_tps, 1),
              "static_bucketed_tokens_per_sec": round(v1b_tps, 1),
-             "ragged_vs_static_bucketed": round(v2_tps / v1b_tps, 3),
+             "ragged_vs_static_bucketed": ratio(v2_tps, v1b_tps),
              "ragged_int8_kv_tokens_per_sec": round(int8_tps, 1),
              "ragged_int8_weights_tokens_per_sec": round(wq_tps, 1),
-             "wq_vs_bf16": round(wq_tps / v2_tps, 3),
+             "wq_vs_bf16": ratio(wq_tps, v2_tps),
              "ragged_int4_weights_tokens_per_sec": round(w4_tps, 1),
-             "w4_vs_bf16": round(w4_tps / v2_tps, 3),
+             "w4_vs_bf16": ratio(w4_tps, v2_tps),
              "oneshot_equal_lengths_ragged": round(one_v2, 1),
              "oneshot_equal_lengths_static": round(one_v1, 1),
              "n_requests": len(prompts), "slots": SLOTS,
@@ -343,12 +378,14 @@ def main():
         extra.update(spec_leg(smoke=smoke))
     except Exception as e:  # noqa: BLE001 — the leg must not kill the bench
         extra["spec_error"] = str(e)[:200]
+    if errors:
+        extra["leg_errors"] = errors
 
     print(json.dumps({
         "metric": "fastgen_ragged_serving_effective_tokens_per_sec",
         "value": round(v2_tps, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(v2_tps / v1_tps, 3),
+        "vs_baseline": ratio(v2_tps, v1_tps),
         "extra": extra,
     }))
 

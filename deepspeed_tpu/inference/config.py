@@ -42,7 +42,10 @@ class QuantizationConfig(DeepSpeedConfigModel):
 
     enabled: bool = False
     bits: int = 8
-    group_size: int = 128
+    group_size: int = 128   # scale granularity; NOTE: the W4A16 TPU kernel
+    #                         needs group % 256 (W8A16: % 128) — coarser
+    #                         groups engage the Pallas path, finer ones fall
+    #                         back to dequant-matmul with a warning
 
 
 class GenerationConfig(DeepSpeedConfigModel):

@@ -1,4 +1,4 @@
 from deepspeed_tpu.models.gpt import (GPT, GPTBackbone, GPTChunkedLoss,
-                                      GPTConfig)
+                                      GPTConfig, GPTLogits)
 
-__all__ = ["GPT", "GPTBackbone", "GPTChunkedLoss", "GPTConfig"]
+__all__ = ["GPT", "GPTBackbone", "GPTChunkedLoss", "GPTConfig", "GPTLogits"]

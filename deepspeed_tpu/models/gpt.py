@@ -60,6 +60,13 @@ class GPTConfig:
     # parallelism (mesh passed separately to the GPT module attribute)
     sequence_parallel: bool = False     # attention over the sp axis
     sp_impl: str = "ulysses"            # "ulysses" (a2a head swap) | "ring"
+    # ring layout: "drop_in" permutes in/out of zig-zag placement inside every
+    # attention call (~4 tensor volumes of sp wire per call, contiguous
+    # activations everywhere); "native" permutes token ids + positions +
+    # labels ONCE per step at the loss wrapper and keeps activations in
+    # zig-zag layout through the whole stack — the ring hops become the only
+    # sp-axis traffic (sequence/ring.py layout= docstring)
+    sp_ring_layout: str = "drop_in"     # "drop_in" | "native"
     # kernel selection (reference: replace_with_kernel_inject / DS_BUILD flags);
     # None = registry auto (pallas flash on TPU, XLA elsewhere)
     attn_impl: Optional[str] = None
@@ -484,7 +491,10 @@ class Attention(nn.Module):
             from deepspeed_tpu import ops
             if c.sp_impl == "ring":
                 from deepspeed_tpu.sequence import ring_attention
-                out = ring_attention(self.mesh, q, k, v)
+                out = ring_attention(
+                    self.mesh, q, k, v,
+                    layout=("zigzag" if c.sp_ring_layout == "native"
+                            else "contiguous"))
             elif c.sp_impl != "ulysses":
                 raise ValueError(f"unknown sp_impl {c.sp_impl!r}; expected "
                                  f"'ulysses' or 'ring'")
@@ -759,9 +769,37 @@ class GPT(nn.Module):
         ltd = batch.get("random_ltd_idx")       # [B, n_ltd, keep] host layout
         if ltd is not None:
             ltd = jnp.moveaxis(jnp.asarray(ltd), 1, 0)   # → [n_ltd, B, keep]
+        positions = labels = mask = None
+        if c.sp_ring_layout not in ("drop_in", "native"):
+            raise ValueError(f"sp_ring_layout must be drop_in|native, got "
+                             f"{c.sp_ring_layout!r}")
+        sp = (self.mesh.shape["sp"]
+              if c.sequence_parallel and self.mesh is not None else 1)
+        if c.sequence_parallel and c.sp_ring_layout == "native" and sp > 1:
+            # layout-native zig-zag ring (sequence/ring.py layout=): shift
+            # labels in contiguous order, then permute ids + labels + mask +
+            # positions ONCE — token ids are ~H·dtype_bytes/4 cheaper to
+            # reshuffle than activations, every position-wise op is layout-
+            # blind, the masked-mean LM loss is permutation-invariant, and
+            # the ring hops become the only per-layer sp traffic
+            if c.sp_impl != "ring":
+                raise ValueError("sp_ring_layout='native' requires "
+                                 "sp_impl='ring' (ulysses is layout-free)")
+            if ltd is not None:
+                raise ValueError("random-LTD + sp_ring_layout='native' is "
+                                 "not wired (the gathered subsequence breaks "
+                                 "the zig-zag placement)")
+            from deepspeed_tpu.sequence import zigzag_order
+            idx, _ = zigzag_order(input_ids.shape[1], sp)  # raises on T%2sp
+            labels, mask = shift_labels(batch, input_ids)
+            input_ids = jnp.take(input_ids, idx, axis=1)
+            labels = jnp.take(labels, idx, axis=1)
+            mask = jnp.take(mask, idx, axis=1)
+            positions = jnp.broadcast_to(idx, input_ids.shape)
         x, emb, moe_aux = GPTBackbone(c, self.mesh,
                                       name="backbone")(input_ids,
                                                        deterministic,
+                                                       positions=positions,
                                                        ltd_idx=ltd,
                                                        pld_theta=batch.get(
                                                            "pld_theta"))
@@ -772,7 +810,8 @@ class GPT(nn.Module):
                                  _part(_kernel_init(), ("embed", "vocab")),
                                  (c.hidden_size, c.vocab_size),
                                  c.param_dtype).astype(x.dtype)
-        labels, mask = shift_labels(batch, input_ids)
+        if labels is None:
+            labels, mask = shift_labels(batch, input_ids)
         lm_bias = (self.param("lm_head_bias",
                               _part(nn.initializers.zeros, ("vocab",)),
                               (c.vocab_size,), c.param_dtype)
@@ -800,6 +839,12 @@ class GPTLogits(nn.Module):
                  use_cache: bool = False, start_index=0, kv_positions=None,
                  deterministic: bool = True):
         c = self.cfg
+        if (c.sequence_parallel and c.sp_ring_layout == "native"
+                and self.mesh is not None and self.mesh.shape["sp"] > 1):
+            raise ValueError(
+                "sp_ring_layout='native' is a training-layout config (the "
+                "loss wrapper permutes the batch into zig-zag placement); "
+                "the logits view expects contiguous rows — use 'drop_in'")
         x, emb, _ = GPTBackbone(c, self.mesh, name="backbone")(
             input_ids, deterministic, positions=positions,
             use_cache=use_cache, kv_mask=kv_mask, start_index=start_index,

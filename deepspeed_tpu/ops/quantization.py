@@ -58,6 +58,22 @@ def unpack_nibbles(p):
     return lo, hi
 
 
+def unpack_nibbles_f32(p):
+    """Shift-free ``unpack_nibbles`` returning float32 — the in-kernel
+    variant: Mosaic cannot legalize shifts on int8 vectors
+    (``arith.shli : vector<..xi8>``, found on first chip contact round 5),
+    so the byte is widened to f32 (exact for [-128, 127]) and the nibbles
+    split with floor/multiply VPU arithmetic (all quantities are small
+    integers, exact in f32)."""
+    b = p.astype(jnp.float32)
+    ub = jnp.where(b < 0, b + 256.0, b)              # unsigned byte view
+    hi4 = jnp.floor(ub * 0.0625)                     # ub // 16
+    lo4 = ub - hi4 * 16.0
+    lo = lo4 - jnp.where(lo4 >= 8.0, 16.0, 0.0)      # sign-extend 4-bit
+    hi = hi4 - jnp.where(hi4 >= 8.0, 16.0, 0.0)
+    return lo, hi
+
+
 def quantize_blockwise(x, *, bits: int = 8,
                        block_size: int = 256) -> QuantizedBlocks:
     """Symmetric per-block quantization (reference quantize.cu semantics:

@@ -54,7 +54,17 @@ from deepspeed_tpu.ops.quantization import (dequantize_weight,
                                             dequantize_weight4,
                                             is_quantized_weight,
                                             is_quantized_weight4,
-                                            unpack_nibbles)
+                                            unpack_nibbles_f32)
+
+
+def _on_tpu(interpret: Optional[bool]) -> bool:
+    """True when the kernel will hit the real Mosaic lowering (which
+    enforces (8, 128)-aligned-or-full block tiles) rather than interpret
+    mode (which accepts anything — the round-4 kernels were interpret-clean
+    and still failed on first chip contact)."""
+    if interpret is not None:
+        return not interpret
+    return jax.default_backend() == "tpu"
 
 
 def _pick(total, prefer):
@@ -64,15 +74,33 @@ def _pick(total, prefer):
     return None
 
 
+def _lane_ok(block, dim) -> bool:
+    """Mosaic lane rule for a block's LAST dim: divisible by 128 or equal to
+    the full array dim."""
+    return block % 128 == 0 or block == dim
+
+
+def _sublane(dtype) -> int:
+    """Min sublane multiple for a dtype's native tile: fp32 (8, 128),
+    bf16/f16 (16, 128), int8/fp8 (32, 128).  M pads to this so the x/out
+    block's second-minor dim is always tile-legal (a block equal to the
+    full dim is also legal, which the padded M satisfies when m == bm)."""
+    return {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+
+
 def _pick_n(total, prefer=512):
-    """Column-dim block size: an exact divisor when one exists, else the
-    preferred tile with an out-of-bounds trailing block (Mosaic masks the
-    partial write; the N dim is never contracted, so the padding lanes'
-    garbage stays in columns the caller's out_shape doesn't include)."""
-    b = _pick(total, prefer)
-    if b is not None:
-        return b
-    return prefer if total >= prefer else -(-total // 128) * 128
+    """Column-dim block size: a 128-aligned exact divisor when one exists
+    (Mosaic's lane rule — the last block dim must be %128 or the full dim),
+    else the full dim when small, else the preferred tile rounded to 128
+    with an out-of-bounds trailing block (Mosaic masks the partial write;
+    the N dim is never contracted, so the padding lanes' garbage stays in
+    columns the caller's out_shape doesn't include)."""
+    for b in (prefer, 512, 384, 256, 128):
+        if b <= total and total % b == 0 and b % 128 == 0:
+            return b
+    if total <= prefer:
+        return total                    # block == full dim: always legal
+    return -(-prefer // 128) * 128
 
 
 _warned_shapes = set()
@@ -83,11 +111,15 @@ _warned_shapes = set()
 trace_counts = {"w8": 0, "w8t": 0, "w4": 0}
 
 
-def kernel_supported(x, store) -> bool:
+def kernel_supported(x, store, interpret: Optional[bool] = None) -> bool:
     """True when the Pallas path can run (M and N are NOT constrained —
     both pad to the tile).  Unsupported 2-D stores warn ONCE per shape: a
     silent fallback would let an operator benchmark 'the W8A16 kernel'
-    while measuring the dequant path."""
+    while measuring the dequant path.
+
+    On the real Mosaic lowering the activation tile is [bm, g], whose lane
+    dim is the GROUP — so g must be %128 (or the whole K): found on first
+    chip contact, round 5."""
     if not is_quantized_weight(store):
         return False
     v, s = store["v"], store["s"]
@@ -98,21 +130,26 @@ def kernel_supported(x, store) -> bool:
     k, n = v.shape
     g = k // s.shape[0]
     ok = k % g == 0 and g % 32 == 0 and g >= 32
+    why = "group % 32 == 0"
+    if ok and _on_tpu(interpret) and not _lane_ok(g, k):
+        ok, why = False, "group % 128 == 0 on TPU (x tile lane dim)"
     if not ok and (k, n, g) not in _warned_shapes:
         _warned_shapes.add((k, n, g))
         from deepspeed_tpu.utils.logging import logger
         logger.warning(
             "wq_matmul: store [%d, %d] (group %d) cannot tile for the "
-            "W8A16 kernel (needs group %% 32 == 0); falling back to "
+            "W8A16 kernel (needs %s); falling back to "
             "dequantize-then-matmul — the int8 HBM-traffic saving does "
-            "NOT engage for this weight", k, n, g)
+            "NOT engage for this weight", k, n, g, why)
     return ok
 
 
-def kernel4_supported(x, store) -> bool:
+def kernel4_supported(x, store, interpret: Optional[bool] = None) -> bool:
     """W4A16 eligibility: nibble-packed ``quantize_weight4`` store, dim-0
     contraction, g % 64 == 0 (the kernel reads [g/2, bn] byte tiles, so
-    the packed sublane dim must stay int8-tileable)."""
+    the packed sublane dim must stay int8-tileable).  On the real Mosaic
+    lowering the de-interleaved activation tile is [bm, g/2] — its lane
+    dim g/2 must be %128 (or the whole K/2), i.e. g % 256 == 0."""
     if not is_quantized_weight4(store):
         return False
     p, s = store["v4"], store["s"]
@@ -123,13 +160,17 @@ def kernel4_supported(x, store) -> bool:
     k = 2 * p.shape[0]
     g = k // s.shape[0]
     ok = k % g == 0 and g % 64 == 0
+    why = "group % 64 == 0"
+    if ok and _on_tpu(interpret) and not _lane_ok(g // 2, k // 2):
+        ok, why = False, ("group % 256 == 0 on TPU (de-interleaved x tile "
+                          "lane dim is group/2)")
     if not ok and (k, p.shape[1], g, "w4") not in _warned_shapes:
         _warned_shapes.add((k, p.shape[1], g, "w4"))
         from deepspeed_tpu.utils.logging import logger
         logger.warning(
             "wq_matmul4: packed store [%d, %d] (group %d) cannot tile for "
-            "the W4A16 kernel (needs group %% 64 == 0); falling back to "
-            "dequantize-then-matmul", k, p.shape[1], g)
+            "the W4A16 kernel (needs %s); falling back to "
+            "dequantize-then-matmul", k, p.shape[1], g, why)
     return ok
 
 
@@ -137,18 +178,28 @@ def _kernel(x_ref, w_ref, s_ref, o_ref, acc, *, nk, contract):
     """Shared body for both W8 orientations: dequantize one weight tile
     (codes · broadcast scale row) and accumulate the dot.  ``contract`` is
     the weight-side contraction dim: 0 for ``x @ W`` ([g, bn] tiles), 1 for
-    ``x @ Wᵀ`` ([g, bk] tiles)."""
+    ``x @ Wᵀ`` ([g, bk] tiles).  The scale arrives as a [1, 1, bn] block of
+    the 3-D [K/g, 1, N] view (a flat [1, bn] block would have sublane dim 1
+    — illegal under Mosaic's (8, 128) tiling unless the array is one row);
+    ``s_ref[0]`` recovers the broadcastable row."""
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
         acc[...] = jnp.zeros(acc.shape, jnp.float32)
 
+    # dequantize with an f32 product, cast ONCE into the ACTIVATION dtype,
+    # and let the MXU accumulate in f32: bf16 activations then ride the
+    # MXU's native bf16 multipliers (an all-f32 dot here measured the whole
+    # kernel BELOW the bf16 baseline on chip — fp32 matmul throughput is a
+    # fraction of bf16's), and the f32-product-then-cast exactly matches
+    # ``dequantize_weight``'s rounding, so the kernel agrees with the
+    # fallback path element-for-element.
     x = x_ref[...]
     w = (w_ref[...].astype(jnp.float32)
-         * s_ref[...].astype(jnp.float32))
+         * s_ref[0].astype(jnp.float32)).astype(x.dtype)
     acc[...] += jax.lax.dot_general(
-        x.astype(jnp.float32), w, (((1,), (contract,)), ((), ())),
+        x, w, (((1,), (contract,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
@@ -169,22 +220,24 @@ def _kernel4(xe_ref, xo_ref, p_ref, s_ref, o_ref, acc, *, nk):
     def _init():
         acc[...] = jnp.zeros(acc.shape, jnp.float32)
 
-    lo, hi = unpack_nibbles(p_ref[...])
-    s = s_ref[...].astype(jnp.float32)
+    lo, hi = unpack_nibbles_f32(p_ref[...])   # shift-free: Mosaic has no
+    s = s_ref[0].astype(jnp.float32)    # int8 vector shifts ([1,1,bn]→row)
+    # dequant in f32 (exact nibble × scale), then cast to the activation
+    # dtype so bf16 rides the MXU's native multipliers (same finding as
+    # ``_kernel``: all-f32 dots ran the kernel below the bf16 baseline)
+    xdt = xe_ref.dtype
     dot = functools.partial(jax.lax.dot_general,
                             dimension_numbers=(((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    acc[...] += dot(xe_ref[...].astype(jnp.float32),
-                    lo.astype(jnp.float32) * s)
-    acc[...] += dot(xo_ref[...].astype(jnp.float32),
-                    hi.astype(jnp.float32) * s)
+    acc[...] += dot(xe_ref[...], (lo * s).astype(xdt))
+    acc[...] += dot(xo_ref[...], (hi * s).astype(xdt))
 
     @pl.when(ik == nk - 1)
     def _done():
         o_ref[...] = acc[...].astype(o_ref.dtype)
 
 
-def kernel_t_supported(x, store) -> bool:
+def kernel_t_supported(x, store, interpret: Optional[bool] = None) -> bool:
     """Transposed variant (``x @ storeᵀ``, tied-embedding unembed): store is
     [V, H] grouped along dim 0 (the embed gather's required layout), so the
     scale varies along the CONTRACTION dim within each g-row output tile —
@@ -201,15 +254,19 @@ def kernel_t_supported(x, store) -> bool:
         return False                   # dim-0 grouping only
     vocab, h = v.shape
     g = vocab // s.shape[0]
-    ok = (vocab % g == 0 and g % 128 == 0 and _pick(h, 512) is not None)
+    bk = _pick(h, 512)
+    ok = (vocab % g == 0 and g % 128 == 0 and bk is not None)
+    why = "group % 128 == 0, plus an H divisor <= 512"
+    if ok and _on_tpu(interpret) and not _lane_ok(bk, h):
+        ok, why = False, "an H block divisor that is % 128 on TPU"
     if not ok and (vocab, h, g, "t") not in _warned_shapes:
         _warned_shapes.add((vocab, h, g, "t"))
         from deepspeed_tpu.utils.logging import logger
         logger.warning(
             "wq_matmul_t: tied store [%d, %d] (group %d) cannot tile for "
             "the transposed W8A16 kernel (the output tile width IS the "
-            "group, so it needs group %% 128 == 0, plus an H divisor "
-            "≤ 512); falling back to dequantize-then-matmul", vocab, h, g)
+            "group, so it needs %s); falling back to "
+            "dequantize-then-matmul", vocab, h, g, why)
     return ok
 
 
@@ -219,7 +276,7 @@ def wq_matmul_t(x, store, *, interpret: Optional[bool] = None):
     scale-group row keeps the dequant a single broadcast multiply.  Vocabs
     that don't group-tile are padded at STORE CREATION (engine packer), not
     here — padding the table per call would re-stream the whole weight."""
-    if not kernel_t_supported(x, store):
+    if not kernel_t_supported(x, store, interpret):
         return x @ dequantize_weight(store, x.dtype).T
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -227,7 +284,7 @@ def wq_matmul_t(x, store, *, interpret: Optional[bool] = None):
     v, s = store["v"], store["s"]
     vocab, h = v.shape
     m0 = x.shape[0]
-    pad = (-m0) % 8
+    pad = (-m0) % _sublane(x.dtype)
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
     m = x.shape[0]
@@ -241,7 +298,7 @@ def wq_matmul_t(x, store, *, interpret: Optional[bool] = None):
         in_specs=[
             pl.BlockSpec((bm, bk), lambda im, jv, ik: (im, ik)),
             pl.BlockSpec((g, bk), lambda im, jv, ik: (jv, ik)),
-            pl.BlockSpec((1, bk), lambda im, jv, ik: (jv, ik)),
+            pl.BlockSpec((1, 1, bk), lambda im, jv, ik: (jv, 0, ik)),
         ],
         out_specs=pl.BlockSpec((bm, g), lambda im, jv, ik: (im, jv)),
         out_shape=jax.ShapeDtypeStruct((m, vocab), x.dtype),
@@ -249,7 +306,7 @@ def wq_matmul_t(x, store, *, interpret: Optional[bool] = None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(x, v, s)
+    )(x, v, s[:, None, :])
     return out[:m0] if pad else out
 
 
@@ -260,7 +317,7 @@ def wq_matmul(x, store, *, interpret: Optional[bool] = None):
     dim).  Returns [M, N] in ``x.dtype``.  Falls back to the XLA
     dequantize-then-matmul for unsupported layouts.
     """
-    if not kernel_supported(x, store):
+    if not kernel_supported(x, store, interpret):
         return x @ dequantize_weight(store, x.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -268,7 +325,7 @@ def wq_matmul(x, store, *, interpret: Optional[bool] = None):
     v, s = store["v"], store["s"]
     k, n = v.shape
     m0 = x.shape[0]
-    pad = (-m0) % 8                     # decode token counts tile to 8 rows
+    pad = (-m0) % _sublane(x.dtype)     # decode token counts tile to rows
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
     m = x.shape[0]
@@ -282,7 +339,7 @@ def wq_matmul(x, store, *, interpret: Optional[bool] = None):
         in_specs=[
             pl.BlockSpec((bm, g), lambda im, jn, ik: (im, ik)),
             pl.BlockSpec((g, bn), lambda im, jn, ik: (ik, jn)),
-            pl.BlockSpec((1, bn), lambda im, jn, ik: (ik, jn)),
+            pl.BlockSpec((1, 1, bn), lambda im, jn, ik: (ik, 0, jn)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda im, jn, ik: (im, jn)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
@@ -290,7 +347,7 @@ def wq_matmul(x, store, *, interpret: Optional[bool] = None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(x, v, s)
+    )(x, v, s[:, None, :])
     return out[:m0] if pad else out
 
 
@@ -304,7 +361,7 @@ def wq_matmul4(x, store, *, interpret: Optional[bool] = None):
     activation is de-interleaved ONCE outside the kernel (xe = even K
     columns, xo = odd) so each byte tile's two nibble planes contract
     against clean contiguous tiles — no in-kernel row interleave."""
-    if not kernel4_supported(x, store):
+    if not kernel4_supported(x, store, interpret):
         return x @ dequantize_weight4(store, x.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -313,7 +370,7 @@ def wq_matmul4(x, store, *, interpret: Optional[bool] = None):
     kh, n = p.shape                     # kh = K/2
     k = 2 * kh
     m0 = x.shape[0]
-    pad = (-m0) % 8
+    pad = (-m0) % _sublane(x.dtype)
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
     m = x.shape[0]
@@ -331,7 +388,7 @@ def wq_matmul4(x, store, *, interpret: Optional[bool] = None):
             pl.BlockSpec((bm, gh), lambda im, jn, ik: (im, ik)),
             pl.BlockSpec((bm, gh), lambda im, jn, ik: (im, ik)),
             pl.BlockSpec((gh, bn), lambda im, jn, ik: (ik, jn)),
-            pl.BlockSpec((1, bn), lambda im, jn, ik: (ik, jn)),
+            pl.BlockSpec((1, 1, bn), lambda im, jn, ik: (ik, 0, jn)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda im, jn, ik: (im, jn)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
@@ -339,7 +396,7 @@ def wq_matmul4(x, store, *, interpret: Optional[bool] = None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(xe, xo, p, s)
+    )(xe, xo, p, s[:, None, :])
     return out[:m0] if pad else out
 
 

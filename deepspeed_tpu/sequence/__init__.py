@@ -1,5 +1,6 @@
-from deepspeed_tpu.sequence.ring import ring_attention
+from deepspeed_tpu.sequence.ring import ring_attention, zigzag_order
 from deepspeed_tpu.sequence.ulysses import (DistributedAttention,
                                             ulysses_attention)
 
-__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention"]
+__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention",
+           "zigzag_order"]

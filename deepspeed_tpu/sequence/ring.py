@@ -46,19 +46,54 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.comm.comm import comms_logger
 
-_NEG = jnp.float32(-1e30)
+# numpy, NOT jnp: a module-level jnp scalar is a committed device array that
+# every trace captures as a jaxpr const — under the engine's donated jit it
+# becomes a lifted executable parameter and the second call fails with a
+# supplied-vs-expected buffer mismatch (round 5, with the iota-perm note on
+# ``_zigzag_perm``)
+_NEG = np.float32(-1e30)
+
+
+def _gqa_scores(qf, kc, scale):
+    """q [B, Tq, H, D] × k [B, Tk, Hkv, D] → logits [B, H, Tq, Tk].
+
+    Hkv < H (GQA): the group expansion happens INSIDE the einsum (q reshaped
+    to [.., Hkv, g, D] against un-expanded KV), so the ring rotates Hkv-sized
+    blocks — wire bytes drop by g = H/Hkv vs pre-expanding KV."""
+    B, Tq, H, D = qf.shape
+    hkv = kc.shape[2]
+    if hkv == H:
+        return jnp.einsum("bqhd,bkhd->bhqk", qf,
+                          kc.astype(jnp.float32)) * scale
+    s = jnp.einsum("bqngd,bknd->bngqk",
+                   qf.reshape(B, Tq, hkv, H // hkv, D),
+                   kc.astype(jnp.float32)) * scale
+    return s.reshape(B, H, Tq, kc.shape[1])
+
+
+def _gqa_pv(p, vc):
+    """probs [B, H, Tq, Tk] × v [B, Tk, Hkv, D] → [B, H, Tq, D] (grouped)."""
+    B, H, Tq, Tk = p.shape
+    hkv = vc.shape[2]
+    if hkv == H:
+        return jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+    o = jnp.einsum("bngqk,bknd->bngqd", p.reshape(B, hkv, H // hkv, Tq, Tk),
+                   vc.astype(jnp.float32))
+    return o.reshape(B, H, Tq, vc.shape[3])
 
 
 def _ring_body(q, k0, v0, my, sp_size, axis, causal, scale):
     """Local blockwise-softmax accumulation over sp ring steps.
 
-    q [B, Tl, H, D]; k0/v0 the locally-held KV block.  Returns [B, Tl, H, D].
+    q [B, Tl, H, D]; k0/v0 the locally-held KV block (possibly fewer, GQA,
+    heads).  Returns [B, Tl, H, D].
     """
     B, Tl, H, D = q.shape
     qpos = my * Tl + jnp.arange(Tl)                     # global positions
@@ -69,8 +104,7 @@ def _ring_body(q, k0, v0, my, sp_size, axis, causal, scale):
     def accumulate(m, l, acc, kcur, vcur, s):
         src = (my - s) % sp_size                        # owner of kcur
         kpos = src * Tl + jnp.arange(Tl)
-        s_log = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                           kcur.astype(jnp.float32)) * scale
+        s_log = _gqa_scores(qf, kcur, scale)
         if causal:
             mask = kpos[None, :] <= qpos[:, None]       # [Tq, Tk] global
             s_log = jnp.where(mask[None, None], s_log, _NEG)
@@ -78,7 +112,7 @@ def _ring_body(q, k0, v0, my, sp_size, axis, causal, scale):
         p = jnp.exp(s_log - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, vcur.astype(jnp.float32))
+        pv = _gqa_pv(p, vcur)
         return m_new, l_new, acc * alpha[..., None] + pv
 
     def step(carry, s):
@@ -116,8 +150,7 @@ def _zigzag_body(q, k0, v0, my, sp_size, axis, scale):
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
 
     def scores(qh, kc):                                   # [B, H, c, c]
-        return jnp.einsum("bqhd,bkhd->bhqk", qh,
-                          kc.astype(jnp.float32)) * scale
+        return _gqa_scores(qh, kc, scale)
 
     def fold(stats, h_idx, s_log, vc):
         """Online-softmax fold of one sub-block into half ``h_idx``'s stats
@@ -130,7 +163,7 @@ def _zigzag_body(q, k0, v0, my, sp_size, axis, scale):
         p = jnp.exp(s_log - m_new[..., None])
         alpha = jnp.exp(mh - m_new)
         l_new = lh * alpha + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+        pv = _gqa_pv(p, vc)
         a_new = ah * alpha[..., None] + pv
         return (lax.dynamic_update_index_in_dim(m, m_new, h_idx, 0),
                 lax.dynamic_update_index_in_dim(l, l_new, h_idx, 0),
@@ -179,22 +212,48 @@ def _zigzag_body(q, k0, v0, my, sp_size, axis, scale):
 
 
 def _zigzag_perm(t: int, sp: int):
-    """Global index permutation placing chunks (d, 2sp−1−d) on device d."""
-    import numpy as np
+    """Global index permutation placing chunks (d, 2sp−1−d) on device d.
+
+    Computed in closed form from ``iota`` arithmetic rather than as a
+    materialized index table: a constant array here becomes an XLA
+    executable parameter under the engine's donated jit (constant hoisting),
+    and the fast-path second call then fails with a supplied-vs-expected
+    buffer-count mismatch — found driving the engine 30 steps, round 5.
+    Iota-derived indices leave nothing to hoist (and nothing to ship from
+    the host)."""
     c = t // (2 * sp)
-    chunks = np.arange(t).reshape(2 * sp, c)
-    order = []
-    for d in range(sp):
-        order += [d, 2 * sp - 1 - d]
-    idx = chunks[order].reshape(-1)
-    inv = np.empty_like(idx)
-    inv[idx] = np.arange(t)
-    return jnp.asarray(idx), jnp.asarray(inv)
+    r = jnp.arange(t)
+    # forward: row r lives on device d = r // (2c); within-device half
+    # h selects chunk d (h=0) or chunk 2sp−1−d (h=1)
+    d = r // (2 * c)
+    w = r % (2 * c)
+    chunk = jnp.where(w < c, d, 2 * sp - 1 - d)
+    idx = chunk * c + w % c
+    # inverse: original position i sits in chunk i//c; early chunks map to
+    # (device=chunk, half 0), late ones to (device=2sp−1−chunk, half 1)
+    ch_i = r // c
+    early = ch_i < sp
+    dev = jnp.where(early, ch_i, 2 * sp - 1 - ch_i)
+    inv = dev * 2 * c + jnp.where(early, 0, c) + r % c
+    return idx, inv
+
+
+def zigzag_order(t: int, sp: int):
+    """(idx, inv) for the zig-zag placement: ``x[:, idx]`` lays a contiguous
+    sequence out so shard d of the sp axis holds chunks (d, 2·sp−1−d);
+    ``z[:, inv]`` undoes it.  Row r of the zig-zag array holds the token
+    whose global position is ``idx[r]`` — so ``positions = idx`` is the
+    position vector of the permuted sequence (what RoPE / learned position
+    embeddings must see)."""
+    if t % (2 * sp):
+        raise ValueError(f"seq len {t} not divisible by 2*sp={2 * sp}")
+    return _zigzag_perm(t, sp)
 
 
 def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
                    axis: str = "sp", batch_axes=("dp", "fsdp"),
-                   scale=None, schedule: str = "zigzag"):
+                   scale=None, schedule: str = "zigzag",
+                   layout: str = "contiguous"):
     """Global-view entry: q/k/v [B, T, H, D] with T sharded over ``axis``.
 
     Equivalent math to full softmax attention (tested token-exact vs the
@@ -204,11 +263,29 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     ``schedule``: "zigzag" (default — causal FLOPs ≈ halved, module
     docstring) or "contiguous".  Zig-zag needs T % (2·sp) == 0 and causal;
     other cases fall back to the contiguous schedule.
+
+    ``layout``: "contiguous" (default — rows are tokens in order; the
+    zig-zag schedule permutes in/out internally, ~4 tensor volumes of wire
+    per call) or "zigzag" (rows are ALREADY in zig-zag placement — row r
+    holds token ``idx[r]`` of ``zigzag_order(T, sp)`` — so the schedule runs
+    with ZERO permute traffic and the output stays in zig-zag layout).  The
+    layout-native path is how a training stack amortizes the permutes to
+    one token-id shuffle per step: permute ids + positions + labels once at
+    the batch (models/gpt.py ``sp_ring_layout='native'``), keep activations
+    zig-zag end-to-end — every non-attention op is position-wise and the LM
+    loss is permutation-invariant.  Requires causal and T % (2·sp) == 0
+    (raises otherwise: the caller re-laid the data out, silence would
+    compute garbage).
     """
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"layout must be contiguous|zigzag, got {layout!r}")
     if sp == 1:
         from deepspeed_tpu import ops
+        if layout == "zigzag":
+            raise ValueError("layout='zigzag' is meaningless at sp=1 — the "
+                             "caller permuted for a ring that doesn't exist")
         return ops.causal_attention(q, k, v, causal=causal, impl="xla")
     if q.shape[1] % sp:
         raise ValueError(f"seq len {q.shape[1]} not divisible by "
@@ -216,20 +293,37 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     if schedule not in ("zigzag", "contiguous"):
         raise ValueError(f"schedule must be zigzag|contiguous, "
                          f"got {schedule!r}")
-    if k.shape[2] != q.shape[2]:
-        # GQA: expand KV to the query head count before the ring (the rotated
-        # blocks then carry nh heads instead of nkv — a grouped in-ring score
-        # kernel that keeps the bandwidth benefit is a later optimization)
-        g = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, g, axis=2)
-        v = jnp.repeat(v, g, axis=2)
+    if layout == "zigzag" and (not causal or q.shape[1] % (2 * sp)):
+        raise ValueError("layout='zigzag' requires causal attention and "
+                         f"seq len divisible by 2*{axis}={2 * sp} "
+                         f"(got causal={causal}, T={q.shape[1]})")
+    if layout == "zigzag" and schedule == "contiguous":
+        raise ValueError("layout='zigzag' forces the zigzag schedule; "
+                         "schedule='contiguous' would be silently ignored")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"query heads {q.shape[2]} not divisible by kv "
+                         f"heads {k.shape[2]}")
+    # GQA: KV stays at nkv heads through the ring — the group expansion
+    # happens inside the per-step einsum (_gqa_scores/_gqa_pv), so each hop
+    # moves nkv/nh of the bytes a pre-expanded ring would
     comms_logger.record("ring_attention_ppermute",
                         (k.size + v.size) * k.dtype.itemsize // sp * (sp - 1),
                         axis)
     spec = P(batch_axes, axis, None, None)
-    zig = (schedule == "zigzag" and causal and q.shape[1] % (2 * sp) == 0)
+    zig = (layout == "zigzag"
+           or (schedule == "zigzag" and causal and q.shape[1] % (2 * sp) == 0))
 
     if zig:
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def inner_z(q_, k_, v_):
+            my = lax.axis_index(axis)
+            return _zigzag_body(q_, k_, v_, my, sp, axis, scale)
+
+        if layout == "zigzag":
+            # data already zig-zag placed: the ring hops are the ONLY wire
+            return inner_z(q, k, v)
+
         idx, inv = _zigzag_perm(q.shape[1], sp)
         # the in/out zig-zag permutes reshard across sp — real wire traffic
         # (≈4 tensor volumes per call), booked separately from the ring hops
@@ -237,13 +331,6 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
             "ring_attention_zigzag_permute",
             (q.size + k.size + v.size + q.size) * q.dtype.itemsize, axis)
         qz, kz, vz = (jnp.take(x, idx, axis=1) for x in (q, k, v))
-
-        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                 out_specs=spec, check_vma=False)
-        def inner_z(q_, k_, v_):
-            my = lax.axis_index(axis)
-            return _zigzag_body(q_, k_, v_, my, sp, axis, scale)
-
         return jnp.take(inner_z(qz, kz, vz), inv, axis=1)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),

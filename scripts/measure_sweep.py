@@ -21,7 +21,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-OUT = os.path.join(REPO, "BENCH_MEASURED_r04.json")
+OUT = os.path.join(REPO, "BENCH_MEASURED_r05.json")
 
 
 def record(results):

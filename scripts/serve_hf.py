@@ -94,6 +94,11 @@ def demo(out_path="docs/SERVE_HF_ARTIFACT.md", steps=300):
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = dataclasses.replace(cfg, num_layers=4, dtype=jnp.bfloat16)
+        # bf16 + the full-width model memorizes slower than the CPU
+        # plumbing config — a fixed 300 steps left loss at 1.05 and the
+        # exact-match check failing (round-5 sweep); cap high and stop on
+        # the loss target instead
+        steps = max(steps, 2500)
     else:
         cfg = dataclasses.replace(cfg, num_layers=2, num_heads=4, head_dim=32,
                                   hidden_size=128)
@@ -111,9 +116,11 @@ def demo(out_path="docs/SERVE_HF_ARTIFACT.md", steps=300):
     rng = np.random.default_rng(0)
     gbs = engine.train_batch_size
     loss = None
-    for _ in range(steps):
+    for i in range(steps):
         idx = rng.integers(0, n, size=(gbs,))
         loss = float(engine.train_batch({"input_ids": pool[idx]}).loss)
+        if loss < 0.02 and i >= 20:     # memorized — the demo's premise
+            break
 
     path = tempfile.mkdtemp(prefix="ds_tpu_hf_")
     params = jax.device_get(engine.state.params)

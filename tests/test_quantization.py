@@ -233,8 +233,12 @@ class TestWqMatmul:
         store = quantize_weight(w, group=64)
         got = wq_matmul(x, store)
         assert got.dtype == jnp.bfloat16
-        want = (x.astype(jnp.float32)
-                @ dequantize_weight(store, jnp.float32)).astype(jnp.bfloat16)
+        # ground truth is the BF16 dequant matmul — the dense-serving math
+        # the kernel replaces (round 5: the kernel dots in the activation
+        # dtype so bf16 rides the MXU's native multipliers; an f32 ground
+        # truth would hold the kernel to a tighter bar than the bf16
+        # baseline it displaces)
+        want = x @ dequantize_weight(store, jnp.bfloat16)
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=2e-2, atol=2e-2)
@@ -444,11 +448,41 @@ class TestW4Kernel:
             jnp.asarray(rng.standard_normal((K, N)), jnp.float32), group=64)
         got = wq_matmul4(x, store)
         assert got.shape == (M, N) and got.dtype == jnp.bfloat16
-        want = (x.astype(jnp.float32)
-                @ dequantize_weight4(store, jnp.float32)).astype(jnp.bfloat16)
+        # bf16 dequant matmul ground truth — see TestWqMatmul
+        # ``test_bf16_activations`` for why not f32
+        want = x @ dequantize_weight4(store, jnp.bfloat16)
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=2e-2, atol=2e-2)
+
+    def test_tpu_lane_gates(self, rng):
+        """The Mosaic lane rule (found on first chip contact, round 5): with
+        ``interpret=False`` the support predicates must reject groups whose
+        activation-tile lane dim isn't %128 — pure predicate logic, so it
+        runs on the CPU suite even though the kernels themselves can't."""
+        from deepspeed_tpu.ops.quantization import (quantize_weight,
+                                                    quantize_weight4)
+        from deepspeed_tpu.ops.wq_matmul import (kernel4_supported,
+                                                 kernel_supported)
+        x = jnp.asarray(rng.standard_normal((8, 512)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((512, 256)), jnp.float32)
+        # W8: g=64 tiles in interpret mode but NOT under Mosaic (x tile
+        # lane dim = g); g=128 passes both; g == K is the full-dim escape
+        assert kernel_supported(x, quantize_weight(w, group=64),
+                                interpret=True)
+        assert not kernel_supported(x, quantize_weight(w, group=64),
+                                    interpret=False)
+        assert kernel_supported(x, quantize_weight(w, group=128),
+                                interpret=False)
+        assert kernel_supported(x, quantize_weight(w, group=512),
+                                interpret=False)
+        # W4: the de-interleaved x tile's lane dim is g/2 → g must be %256
+        assert kernel4_supported(x, quantize_weight4(w, group=128),
+                                 interpret=True)
+        assert not kernel4_supported(x, quantize_weight4(w, group=128),
+                                     interpret=False)
+        assert kernel4_supported(x, quantize_weight4(w, group=256),
+                                 interpret=False)
 
     def test_small_group_falls_back(self, rng):
         """g % 64 != 0 cannot tile the packed sublane dim — dequant path."""
