@@ -1,18 +1,23 @@
 #!/usr/bin/env python
 """Evoformer long-S memory/runtime proof (round-3 verdict item 6 "done" bar).
 
-Runs one forward+backward of evoformer attention at an AlphaFold-ish long-S
-shape (S=2048, N=32) through BOTH paths:
+Runs one forward+backward of evoformer attention at AlphaFold-ish long-S
+shapes (N=32, S in {2048, 4096}) through BOTH paths:
 
 - Pallas blockwise kernel (`evoformer_attention`): [bq, bk] logit tiles in
   VMEM only — peak HBM stays O(inputs + bias2).
 - einsum ground truth (`_evoformer_xla`): materializes [B, N, H, S, S] fp32
-  logits (2 GB at this shape) twice over in fwd+bwd — expected to OOM a
-  16 GB chip once the bias2 cotangent joins.
+  logits twice over in fwd+bwd.
 
-Prints one JSON line per path: {"path", "ok", "seconds", "peak_hbm_gb"}.
-Runs each path in a SUBPROCESS (an OOM'd compile poisons the process —
-docs/PERF_PLAYBOOK.md §axon).  CPU-safe smoke: EVO_SMOKE=1 shrinks shapes.
+Round-5 measured outcome: at S=2048 BOTH paths fit a 16 GB chip (2 GB
+logits; kernel 0.776 s vs einsum 0.796 s) — the memory contrast lives at
+S=4096, where the einsum path's ~8.6 GB logits (before backward copies)
+fail the remote compile while the kernel runs in 1.385 s.
+
+Prints one JSON line per (S, path): {"path", "S", "shape", "ok",
+"seconds"}.  Runs each path in a SUBPROCESS (an OOM'd compile poisons the
+process — docs/PERF_PLAYBOOK.md §axon); a hung/slow leg records a timeout
+line instead of killing the later legs.  CPU-safe smoke: EVO_SMOKE=1.
 """
 
 import json
@@ -36,7 +41,8 @@ def run_one(path_name: str) -> int:
                                              evoformer_attention)
 
     smoke = bool(os.environ.get("EVO_SMOKE"))
-    B, N, S, H, D = (1, 4, 128, 2, 8) if smoke else (1, 32, 2048, 4, 32)
+    S = int(os.environ.get("EVO_S", 2048))
+    B, N, S, H, D = (1, 4, 128, 2, 8) if smoke else (1, 32, S, 4, 32)
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 5)
     shape = (B, N, S, H, D)
@@ -51,7 +57,7 @@ def run_one(path_name: str) -> int:
         return jnp.sum(fn(q_, k_, v_, bias1, b2).astype(jnp.float32))
 
     g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))
-    out = {"path": path_name, "shape": list(shape)}
+    out = {"path": path_name, "S": S, "shape": list(shape)}
     try:
         r = g(q, k, v, bias2)                  # compile + run
         # axon relay: sync by FETCHING a value (block_until_ready lies)
@@ -76,18 +82,33 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] in ("pallas", "xla"):
         return run_one(sys.argv[1])
     here = os.path.abspath(__file__)
-    for path_name in ("pallas", "xla"):
-        p = subprocess.run([sys.executable, here, path_name],
-                           timeout=900, capture_output=True, text=True)
-        for line in p.stdout.splitlines():
-            if line.startswith("{"):
-                print(line, flush=True)
-                break
-        else:
-            print(json.dumps({"path": path_name, "ok": False,
-                              "error": (p.stderr.strip().splitlines()
-                                        or ["no output"])[-1][:200]}),
-                  flush=True)
+    # S=2048 (round-3 bar: both paths' runtime) proved BOTH paths fit a
+    # 16 GB chip — the memory contrast needs S=4096, where the einsum
+    # path's [B, N, H, S, S] fp32 logits (~8.6 GB before the backward's
+    # copies) cannot fit but the kernel's VMEM tiles don't care
+    sizes = (2048,) if os.environ.get("EVO_SMOKE") else (2048, 4096)
+    for s in sizes:
+        for path_name in ("pallas", "xla"):
+            env = dict(os.environ, EVO_S=str(s))
+            try:
+                p = subprocess.run([sys.executable, here, path_name],
+                                   timeout=900, capture_output=True,
+                                   text=True, env=env)
+            except subprocess.TimeoutExpired:
+                # the relay HANGS rather than erroring — record and keep
+                # going so later (S, path) legs still run
+                print(json.dumps({"path": path_name, "S": s, "ok": False,
+                                  "error": "timeout 900s"}), flush=True)
+                continue
+            for line in p.stdout.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    break
+            else:
+                print(json.dumps({"path": path_name, "S": s, "ok": False,
+                                  "error": (p.stderr.strip().splitlines()
+                                            or ["no output"])[-1][:200]}),
+                      flush=True)
     return 0
 
 

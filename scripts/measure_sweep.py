@@ -110,9 +110,10 @@ print({'h2d_gib_s': round(up, 2), 'd2h_gib_s': round(down, 2)})
     results["bench_serving"] = last_json(out) or f"no JSON (rc={rc})"
     record(results)
 
-    # 5. evoformer long-S memory proof (two subprocesses internally)
+    # 5. evoformer long-S memory proof (four subprocesses internally:
+    # S in {2048, 4096} x both paths, each under its own 900 s timeout)
     rc, out, _ = run([sys.executable,
-                      os.path.join("scripts", "bench_evoformer.py")], 1800)
+                      os.path.join("scripts", "bench_evoformer.py")], 3900)
     results["evoformer"] = [json.loads(x) for x in out.splitlines()
                             if x.startswith("{")] or f"rc={rc}"
     record(results)
