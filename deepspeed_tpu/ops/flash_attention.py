@@ -44,6 +44,17 @@ def _block_sizes(t: int, prefer: int = DEFAULT_BLOCK_Q):
     return None
 
 
+def _block_pair(t: int):
+    """(bq, bk) for seq len ``t``.  512×512 through T ≤ 2048; at T ≥ 4096
+    a wider K block streams K/V in fewer, larger tiles and measured ~18%
+    faster fwd+bwd on v5e (on-chip sweep, round 5, B4·H12·D64·T4096:
+    512/512 334 ms, 512/1024 282 ms, 1024/512 287 ms, 1024/1024 294 ms)."""
+    bq = _block_sizes(t)
+    if t >= 4096 and bq == 512 and t % 1024 == 0:
+        return bq, 1024
+    return bq, bq
+
+
 def supported(q, k, v, *, causal=True, scale=None, window=None,
               alibi_slopes=None, **_):
     """Shape predicate for the pallas path (registry.OpSpec.supported)."""
@@ -145,7 +156,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, window,
 def _fwd(q, k, v, slopes, causal, scale, window, has_alibi, interpret):
     b, n, t, d = q.shape
     group = n // k.shape[1]   # GQA: kv head = q head // group (no expansion)
-    bq = bk = _block_sizes(t)
+    bq, bk = _block_pair(t)
     grid = (b, n, t // bq, t // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, window=window,
@@ -291,7 +302,7 @@ def _bwd_impl(q, k, v, o, lse, do, slopes, causal, scale, window, has_alibi,
     b, n, t, d = q.shape
     nkv = k.shape[1]
     group = n // nkv
-    bq = bk = _block_sizes(t)
+    bq, bk = _block_pair(t)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)[:, :, None, :]                   # [b, n, 1, t]
     qkv_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0))

@@ -42,6 +42,29 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    atol=2e-5, rtol=1e-4)
 
+    def test_rectangular_blocks(self, qkv, monkeypatch):
+        """bq != bk (the T>=4096 on-chip fast pair, round 5) must stay
+        exact through fwd AND both backward kernels — exercised at small T
+        by pinning a rectangular pair."""
+        import importlib
+        # import_module, NOT `from deepspeed_tpu.ops import flash_attention`:
+        # the package re-exports a FUNCTION of that name which shadows the
+        # submodule on attribute access
+        fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa, "_block_pair", lambda t: (8, 16))
+        q, k, v = qkv
+        ref = ops.causal_attention(q, k, v, impl="xla")
+        out = ops.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   atol=2e-5, rtol=1e-4)
+        gr = jax.grad(lambda *a: jnp.sum(
+            ops.causal_attention(*a, impl="xla") ** 2), argnums=(0, 1, 2))
+        gf = jax.grad(lambda *a: jnp.sum(
+            ops.flash_attention(*a, interpret=True) ** 2), argnums=(0, 1, 2))
+        for a, b in zip(gr(q, k, v), gf(q, k, v)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3)
+
     def test_gqa_backward_matches_xla(self, qkv):
         """dk/dv of the fused (q-head-in-group, q-block) kernel grid must sum
         contributions over the whole GQA group."""
