@@ -44,12 +44,34 @@ def _block_sizes(t: int, prefer: int = DEFAULT_BLOCK_Q):
     return None
 
 
-def _block_pair(t: int):
-    """(bq, bk) for seq len ``t``.  512×512 through T ≤ 2048; at T ≥ 4096
-    a wider K block streams K/V in fewer, larger tiles and measured ~18%
-    faster fwd+bwd on v5e (on-chip sweep, round 5, B4·H12·D64·T4096:
-    512/512 334 ms, 512/1024 282 ms, 1024/512 287 ms, 1024/1024 294 ms)."""
+def _block_pair(t: int, d: int = 64, window=None):
+    """(bq, bk) — set by the round-5 on-chip v5e sweep (B4·H12·D64,
+    fwd+bwd, dispatch-amortized):
+
+    - T=1024: whole-sequence (1024, 1024) tile, 1.25× vs 512² (per-tile
+      overheads dominate at short T; the causal-skip waste of an unsplit
+      K is cheaper than the extra grid steps).
+    - T=2048: single K tile (512, 2048), ~1.04×.
+    - T ≥ 4096: (512, 1024), 1.19× at 4096 and 1.18× at 8192 — wider K
+      streams K/V in fewer tiles; 2048-wide K loses the causal skipping
+      and fell back to ~1.0×, and (1024, 2048) over-fills VMEM and fails
+      to compile.
+    - other/smaller T (tests, odd shapes): square `_block_sizes` as before.
+
+    Two gates keep the wide pairs inside their measured envelope:
+    sliding-window attention stays on square tiles (dead-tile skipping is
+    the T·window FLOP scaling — one whole-sequence K tile can never be
+    skipped), and head_dim > 128 stays square (the d-scaled q/k/v/acc
+    tiles stack on the D-independent 4 MB fp32 score tile; the sweep only
+    validated VMEM fit up to d=128, and an over-full tile is a hard
+    compile error, not a fallback)."""
     bq = _block_sizes(t)
+    if window is not None or d > 128:
+        return bq, bq
+    if t == 1024:
+        return 1024, 1024
+    if t == 2048:
+        return 512, 2048
     if t >= 4096 and bq == 512 and t % 1024 == 0:
         return bq, 1024
     return bq, bq
@@ -156,7 +178,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, window,
 def _fwd(q, k, v, slopes, causal, scale, window, has_alibi, interpret):
     b, n, t, d = q.shape
     group = n // k.shape[1]   # GQA: kv head = q head // group (no expansion)
-    bq, bk = _block_pair(t)
+    bq, bk = _block_pair(t, d, window)
     grid = (b, n, t // bq, t // bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, window=window,
@@ -302,7 +324,7 @@ def _bwd_impl(q, k, v, o, lse, do, slopes, causal, scale, window, has_alibi,
     b, n, t, d = q.shape
     nkv = k.shape[1]
     group = n // nkv
-    bq, bk = _block_pair(t)
+    bq, bk = _block_pair(t, d, window)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)[:, :, None, :]                   # [b, n, 1, t]
     qkv_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h, iq, ik: (b_, h, iq, 0))

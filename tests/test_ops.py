@@ -42,6 +42,28 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    atol=2e-5, rtol=1e-4)
 
+    def test_block_pair_table(self):
+        """Pin the on-chip-tuned (bq, bk) table (round-5 v5e sweep) so a
+        refactor can't silently regress the measured fast pairs."""
+        import importlib
+        fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+        assert fa._block_pair(1024) == (1024, 1024)
+        assert fa._block_pair(2048) == (512, 2048)
+        assert fa._block_pair(4096) == (512, 1024)
+        assert fa._block_pair(8192) == (512, 1024)
+        assert fa._block_pair(512) == (512, 512)
+        assert fa._block_pair(64) == (64, 64)
+        # non-1024-multiple long T keeps the safe square fallback
+        assert fa._block_pair(4608) == (512, 512)
+        # sliding window keeps square tiles (whole-seq K defeats the
+        # dead-tile skip that gives T*window scaling)
+        assert fa._block_pair(1024, window=128) == (512, 512)
+        assert fa._block_pair(4096, window=256) == (512, 512)
+        # head_dim > 128 keeps square tiles (VMEM envelope only validated
+        # to d=128; an over-full tile is a compile error, not a fallback)
+        assert fa._block_pair(1024, d=256) == (512, 512)
+        assert fa._block_pair(1024, d=128) == (1024, 1024)
+
     def test_rectangular_blocks(self, qkv, monkeypatch):
         """bq != bk (the T>=4096 on-chip fast pair, round 5) must stay
         exact through fwd AND both backward kernels — exercised at small T
@@ -51,7 +73,8 @@ class TestFlashAttention:
         # the package re-exports a FUNCTION of that name which shadows the
         # submodule on attribute access
         fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
-        monkeypatch.setattr(fa, "_block_pair", lambda t: (8, 16))
+        monkeypatch.setattr(fa, "_block_pair",
+                            lambda t, d=64, window=None: (8, 16))
         q, k, v = qkv
         ref = ops.causal_attention(q, k, v, impl="xla")
         out = ops.flash_attention(q, k, v, interpret=True)
