@@ -56,8 +56,11 @@ def _evoformer_xla(q, k, v, bias1=None, bias2=None):
     return out.astype(q.dtype)
 
 
-def _block_sizes(s: int, prefer: int = 256):
-    for b in (prefer, 256, 128, 64, 32, 16, 8):
+def _block_sizes(s: int, prefer: int = 512):
+    """512-tiles measured ~5% faster fwd+bwd than 256 at S=2048 on v5e
+    (round-5 on-chip sweep: 225 ms vs 236 ms; 1024 over-fills VMEM and
+    fails to compile); shorter S falls back through the divisor ladder."""
+    for b in (prefer, 512, 256, 128, 64, 32, 16, 8):
         if b <= s and s % b == 0:
             return b
     return None
