@@ -206,12 +206,16 @@ class TestNativeLayout:
         with pytest.raises(ValueError, match="sp=1"):
             ring_attention(mesh1, q, k, v, layout="zigzag")
 
-    def test_gpt_native_loss_and_grads_match_local(self, mesh, rng):
+    @pytest.mark.parametrize("nkv", [None, 2])
+    def test_gpt_native_loss_and_grads_match_local(self, mesh, rng, nkv):
         """Native-layout GPT reproduces the single-device loss AND grads —
-        the once-per-step permutation is numerically invisible."""
+        the once-per-step permutation is numerically invisible.  nkv=2
+        composes GQA (the ring rotates un-expanded KV) with the native
+        layout through the model-level backward."""
         import dataclasses
         from deepspeed_tpu.models import GPT, GPTConfig
-        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=32)
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=32,
+                             num_kv_heads=nkv)
         batch = {"input_ids": rng.integers(0, 64, (4, 32)).astype(np.int32)}
         plain = GPT(cfg)
         var = plain.init(jax.random.PRNGKey(0), batch, deterministic=True)
