@@ -67,6 +67,11 @@ class GPTConfig:
     # zig-zag layout through the whole stack — the ring hops become the only
     # sp-axis traffic (sequence/ring.py layout= docstring)
     sp_ring_layout: str = "drop_in"     # "drop_in" | "native"
+    # ring inner attend: "einsum" materializes [c, c] logits per sub-attend;
+    # "flash" runs the Pallas flash kernel with logsumexp merging and a
+    # ring-level custom_vjp — O(inputs) attention memory for long context
+    # (sequence/ring.py inner= docstring; needs T/(2·sp) >= 8, d % 8 == 0)
+    sp_ring_inner: str = "einsum"       # "einsum" | "flash"
     # kernel selection (reference: replace_with_kernel_inject / DS_BUILD flags);
     # None = registry auto (pallas flash on TPU, XLA elsewhere)
     attn_impl: Optional[str] = None
@@ -494,7 +499,8 @@ class Attention(nn.Module):
                 out = ring_attention(
                     self.mesh, q, k, v,
                     layout=("zigzag" if c.sp_ring_layout == "native"
-                            else "contiguous"))
+                            else "contiguous"),
+                    inner=c.sp_ring_inner)
             elif c.sp_impl != "ulysses":
                 raise ValueError(f"unknown sp_impl {c.sp_impl!r}; expected "
                                  f"'ulysses' or 'ring'")

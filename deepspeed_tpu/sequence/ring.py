@@ -211,6 +211,182 @@ def _zigzag_body(q, k0, v0, my, sp_size, axis, scale):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _lse_merge(o1, l1, o2, l2):
+    """Exact combine of two softmax-attention partials over disjoint key
+    sets: o_i normalized outputs [B, H, Tq, D] (f32), l_i logsumexp rows
+    [B, H, Tq].  The flash-decoding / ring-flash merge identity."""
+    l = jnp.logaddexp(l1, l2)
+    return (o1 * jnp.exp(l1 - l)[..., None]
+            + o2 * jnp.exp(l2 - l)[..., None]), l
+
+
+def _zigzag_body_flash(q, k0, v0, my, sp_size, axis, scale, interpret):
+    """``_zigzag_body`` with the Pallas flash kernel as the inner attend —
+    the [c, c] logit matrices never materialize (VMEM [bq, bk] tiles
+    only), so per-device attention memory is O(inputs + outputs): the
+    einsum body's peak 3×[B, H, c, c] score buffers are the last
+    long-context memory wall this removes.
+
+    Every zig-zag sub-attend is block-level causal=True (own diagonal) or
+    causal=False (fully live) — liveness depends only on (my, src), never
+    on token positions — so the stock flash kernels apply unmodified.
+    Forward merges per-block (o, lse) with the exact logsumexp combine;
+    backward is a ring-level custom_vjp in the ring-flash-attention
+    style: replay the KV rotation and run the flash backward kernels per
+    live sub-block with the GLOBAL lse (p = exp(s − lse_global) is then
+    the true global softmax prob, so per-block dq/dk/dv sum exactly),
+    accumulating dk/dv on a buffer that rotates WITH k/v and goes home in
+    one reverse hop.  ``my`` enters only through a float liveness mask so
+    the custom_vjp's inputs are all float (clean zero cotangents).
+    Layouts inside are kernel-major [B, H, T, D].
+    """
+    # importlib: the ops package re-exports a flash_attention FUNCTION that
+    # shadows the submodule on attribute access
+    import importlib
+    FA = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+
+    B, T2, H, D = q.shape
+    c = T2 // 2
+    perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+    homeperm = [(i, (i - (sp_size - 1)) % sp_size) for i in range(sp_size)]
+    # early[s−1] == 1.0 ⟺ ring step s's visiting block comes from an
+    # EARLIER device (the where-routed sub-attend targets the qa half)
+    steps = jnp.arange(1, sp_size)
+    early_f = (((my - steps) % sp_size) < my).astype(jnp.float32)
+
+    def kl(x):                         # [B, T, H, D] → kernel-major
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    def sub_fwd(qh, kc, vc, causal):
+        o, lse = FA._fwd(qh, kc, vc, None, causal, scale, None, False,
+                         interpret)
+        # lse rides the kernels' [B, H, 1, T] stat layout — flatten for
+        # the merges, re-expand in sub_bwd
+        return o.astype(jnp.float32), lse[:, :, 0]  # [B,H,c,D], [B,H,c]
+
+    def sub_bwd(qh, kc, vc, og, lg, do, causal):
+        dq, dk, dv = FA._bwd_impl(qh, kc, vc, og.astype(qh.dtype),
+                                  lg[:, :, None, :], do.astype(qh.dtype),
+                                  None, causal, scale, None, False,
+                                  interpret)
+        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                dv.astype(jnp.float32))
+
+    def fwd_scan(qx, kx, vx, ef):
+        qa, qb = qx[:, :, :c], qx[:, :, c:]
+        ka, kb = kx[:, :, :c], kx[:, :, c:]
+        va, vb = vx[:, :, :c], vx[:, :, c:]
+        # step 0 — own chunks: qa×ka diag, qb×ka full, qb×kb diag
+        oa, la = sub_fwd(qa, ka, va, True)
+        ob1, lb1 = sub_fwd(qb, ka, va, False)
+        ob2, lb2 = sub_fwd(qb, kb, vb, True)
+        ob, lb = _lse_merge(ob1, lb1, ob2, lb2)
+
+        def step(carry, e):
+            oa, la, ob, lb, kc, vc = carry
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            ka_, kb_ = kc[:, :, :c], kc[:, :, c:]
+            va_, vb_ = vc[:, :, :c], vc[:, :, c:]
+            o1, l1 = sub_fwd(qb, ka_, va_, False)  # qb × early chunk: live
+            ob, lb = _lse_merge(ob, lb, o1, l1)
+            early = e > 0.5
+            q2 = jnp.where(early, qa, qb)
+            k2 = jnp.where(early, ka_, kb_)
+            v2 = jnp.where(early, va_, vb_)
+            o2, l2 = sub_fwd(q2, k2, v2, False)
+            oa_m, la_m = _lse_merge(oa, la, o2, l2)
+            ob_m, lb_m = _lse_merge(ob, lb, o2, l2)
+            oa = jnp.where(early, oa_m, oa)
+            la = jnp.where(early, la_m, la)
+            ob = jnp.where(early, ob, ob_m)
+            lb = jnp.where(early, lb, lb_m)
+            return (oa, la, ob, lb, kc, vc), None
+
+        (oa, la, ob, lb, _, _), _ = lax.scan(
+            step, (oa, la, ob, lb, kx, vx), ef)
+        return oa, la, ob, lb
+
+    def bwd_scan(qx, kx, vx, ef, oa, la, ob, lb, doa, dob):
+        qa, qb = qx[:, :, :c], qx[:, :, c:]
+
+        def live_sub1(kc, vc, dkc, dvc, dqb):
+            """qb × visiting early chunk — live at EVERY ring step."""
+            dq1, dk1, dv1 = sub_bwd(qb, kc[:, :, :c], vc[:, :, :c],
+                                    ob, lb, dob, False)
+            return (dkc.at[:, :, :c].add(dk1), dvc.at[:, :, :c].add(dv1),
+                    dqb + dq1)
+
+        # step 0 (resident block, run OUTSIDE the scan — its diagonal
+        # sub-attends are the only causal ones, kept trace-static)
+        zkv = jnp.zeros(kx.shape, jnp.float32)
+        dqa = jnp.zeros((B, H, c, D), jnp.float32)
+        dqb = jnp.zeros((B, H, c, D), jnp.float32)
+        dkc, dvc, dqb = live_sub1(kx, vx, zkv, jnp.zeros_like(zkv), dqb)
+        dq2, dk2, dv2 = sub_bwd(qa, kx[:, :, :c], vx[:, :, :c],
+                                oa, la, doa, True)
+        dqa = dqa + dq2
+        dkc = dkc.at[:, :, :c].add(dk2)
+        dvc = dvc.at[:, :, :c].add(dv2)
+        dq3, dk3, dv3 = sub_bwd(qb, kx[:, :, c:], vx[:, :, c:],
+                                ob, lb, dob, True)
+        dqb = dqb + dq3
+        dkc = dkc.at[:, :, c:].add(dk3)
+        dvc = dvc.at[:, :, c:].add(dv3)
+
+        def step(carry, e):
+            kc, vc, dkc, dvc, dqa, dqb = carry
+            rot = lambda x: lax.ppermute(x, axis, perm)  # noqa: E731
+            kc, vc, dkc, dvc = rot(kc), rot(vc), rot(dkc), rot(dvc)
+            dkc, dvc, dqb = live_sub1(kc, vc, dkc, dvc, dqb)
+            early = e > 0.5
+            ka_, kb_ = kc[:, :, :c], kc[:, :, c:]
+            va_, vb_ = vc[:, :, :c], vc[:, :, c:]
+            q2 = jnp.where(early, qa, qb)
+            k2 = jnp.where(early, ka_, kb_)
+            v2 = jnp.where(early, va_, vb_)
+            og2 = jnp.where(early, oa, ob)
+            lg2 = jnp.where(early, la, lb)
+            do2 = jnp.where(early, doa, dob)
+            dq2, dk2, dv2 = sub_bwd(q2, k2, v2, og2, lg2, do2, False)
+            dqa = dqa + jnp.where(early, dq2, 0.0)
+            dqb = dqb + jnp.where(early, 0.0, dq2)
+            dkc = dkc.at[:, :, :c].add(jnp.where(early, dk2, 0.0))
+            dkc = dkc.at[:, :, c:].add(jnp.where(early, 0.0, dk2))
+            dvc = dvc.at[:, :, :c].add(jnp.where(early, dv2, 0.0))
+            dvc = dvc.at[:, :, c:].add(jnp.where(early, 0.0, dv2))
+            return (kc, vc, dkc, dvc, dqa, dqb), None
+
+        (_, _, dkc, dvc, dqa, dqb), _ = lax.scan(
+            step, (kx, vx, dkc, dvc, dqa, dqb), ef)
+        # grads rotated sp−1 hops with their blocks; one permute goes home
+        dkc = lax.ppermute(dkc, axis, homeperm)
+        dvc = lax.ppermute(dvc, axis, homeperm)
+        return jnp.concatenate([dqa, dqb], axis=2), dkc, dvc
+
+    @jax.custom_vjp
+    def zz(qx, kx, vx, ef):
+        oa, _, ob, _ = fwd_scan(qx, kx, vx, ef)
+        return jnp.concatenate([oa, ob], axis=2)
+
+    def zz_fwd(qx, kx, vx, ef):
+        oa, la, ob, lb = fwd_scan(qx, kx, vx, ef)
+        return (jnp.concatenate([oa, ob], axis=2),
+                (qx, kx, vx, ef, oa, la, ob, lb))
+
+    def zz_bwd(res, dout):
+        qx, kx, vx, ef, oa, la, ob, lb = res
+        doa = dout[:, :, :c].astype(jnp.float32)
+        dob = dout[:, :, c:].astype(jnp.float32)
+        dq, dk, dv = bwd_scan(qx, kx, vx, ef, oa, la, ob, lb, doa, dob)
+        return (dq.astype(qx.dtype), dk.astype(kx.dtype),
+                dv.astype(vx.dtype), jnp.zeros_like(ef))
+
+    zz.defvjp(zz_fwd, zz_bwd)
+    out = zz(kl(q), kl(k0), kl(v0), early_f)      # [B, H, 2c, D] f32
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
 def _zigzag_perm(t: int, sp: int):
     """Global index permutation placing chunks (d, 2sp−1−d) on device d.
 
@@ -253,7 +429,7 @@ def zigzag_order(t: int, sp: int):
 def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
                    axis: str = "sp", batch_axes=("dp", "fsdp"),
                    scale=None, schedule: str = "zigzag",
-                   layout: str = "contiguous"):
+                   layout: str = "contiguous", inner: str = "einsum"):
     """Global-view entry: q/k/v [B, T, H, D] with T sharded over ``axis``.
 
     Equivalent math to full softmax attention (tested token-exact vs the
@@ -276,6 +452,15 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     loss is permutation-invariant.  Requires causal and T % (2·sp) == 0
     (raises otherwise: the caller re-laid the data out, silence would
     compute garbage).
+
+    ``inner``: "einsum" (default — per-step sub-attends materialize
+    [c, c] logits, c = T/(2·sp)) or "flash" (sub-attends run the Pallas
+    flash kernel with logsumexp merging and a ring-level custom_vjp —
+    per-device attention memory drops to O(inputs + outputs), removing the
+    last long-context memory wall; see ``_zigzag_body_flash``).  "flash"
+    requires the zig-zag schedule (causal, T % (2·sp) == 0), head_dim % 8
+    == 0, and a per-device half-chunk divisible by a flash block (c ≥ 8);
+    raises otherwise — an opt-in flag must not silently degrade.
     """
     sp = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -313,11 +498,37 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     zig = (layout == "zigzag"
            or (schedule == "zigzag" and causal and q.shape[1] % (2 * sp) == 0))
 
+    if inner not in ("einsum", "flash"):
+        raise ValueError(f"inner must be einsum|flash, got {inner!r}")
+    if inner == "flash":
+        c = q.shape[1] // (2 * sp)
+        # importlib, NOT `from deepspeed_tpu.ops import flash_attention`:
+        # the package re-exports a FUNCTION of that name which shadows the
+        # submodule on attribute access
+        import importlib
+        _fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+        # zig already encodes causal ∧ T % (2·sp) == 0 for this layout;
+        # _block_sizes(c) is None for any c < 8.  Backward-pass hop bytes
+        # (KV replay + dk/dv homing) are NOT booked, matching the einsum
+        # inner whose autodiff backward ppermutes are likewise unbooked —
+        # the logger records the forward ring only, for either inner.
+        if not (zig and q.shape[3] % 8 == 0
+                and _fa._block_sizes(c) is not None):
+            raise ValueError(
+                "inner='flash' needs the causal zig-zag schedule with "
+                f"T % (2*sp) == 0, head_dim % 8 == 0, and half-chunk "
+                f"c = T/(2*sp) >= 8 divisible by a flash block (got "
+                f"T={q.shape[1]}, sp={sp}, d={q.shape[3]}, c={c})")
+
     if zig:
         @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                  out_specs=spec, check_vma=False)
         def inner_z(q_, k_, v_):
             my = lax.axis_index(axis)
+            if inner == "flash":
+                interp = jax.default_backend() != "tpu"
+                return _zigzag_body_flash(q_, k_, v_, my, sp, axis, scale,
+                                          interp)
             return _zigzag_body(q_, k_, v_, my, sp, axis, scale)
 
         if layout == "zigzag":

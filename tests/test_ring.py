@@ -178,6 +178,102 @@ class TestZigzagSchedule:
                                    atol=2e-5, rtol=1e-4)
 
 
+class TestFlashInner:
+    """Round-5: flash-kernel inner attends with logsumexp merging and a
+    ring-level custom_vjp — the [c, c] logit matrices never materialize,
+    removing the last per-device long-context memory wall."""
+
+    def test_matches_dense(self, mesh, rng):
+        q, k, v = _qkv(rng, T=64)          # c = 64/(2·4) = 8: one block
+        want = ops.causal_attention(q, k, v, impl="xla")
+        got = jax.jit(lambda *a: ring_attention(
+            mesh, *a, inner="flash"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_grads_match_dense(self, mesh, rng):
+        """The ring-level custom_vjp (global-lse flash backward per
+        sub-block, dk/dv rotating home) must equal dense-attention grads."""
+        q, k, v = _qkv(rng, T=64)
+        w = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+        def loss(fn):
+            return lambda q_, k_, v_: jnp.sum(fn(q_, k_, v_) * w * 0.1)
+        gd = jax.grad(loss(lambda *a: ops.causal_attention(
+            *a, impl="xla")), argnums=(0, 1, 2))(q, k, v)
+        gf = jax.jit(jax.grad(loss(lambda *a: ring_attention(
+            mesh, *a, inner="flash")), argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3)
+
+    def test_gqa(self, mesh, rng):
+        """GQA rides the flash kernels' native grouped-KV indexing — KV
+        still rotates un-expanded."""
+        B, T, nh, nkv, D = 2, 64, 4, 2, 8
+        q = jnp.asarray(rng.standard_normal((B, T, nh, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, nkv, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, nkv, D)), jnp.float32)
+        want = ops.causal_attention(q, k, v, impl="xla")
+        got = jax.jit(lambda *a: ring_attention(
+            mesh, *a, inner="flash"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=1e-4)
+        gd = jax.grad(lambda *a: jnp.sum(ops.causal_attention(
+            *a, impl="xla") * 0.01), argnums=(0, 1, 2))(q, k, v)
+        gf = jax.jit(jax.grad(lambda *a: jnp.sum(ring_attention(
+            mesh, *a, inner="flash") * 0.01), argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-3)
+
+    def test_native_layout_composes(self, mesh, rng):
+        from deepspeed_tpu.sequence import zigzag_order
+        q, k, v = _qkv(rng, T=64)
+        idx, inv = zigzag_order(64, 4)
+        qz, kz, vz = (jnp.take(x, idx, axis=1) for x in (q, k, v))
+        oz = jax.jit(lambda *a: ring_attention(
+            mesh, *a, layout="zigzag", inner="flash"))(qz, kz, vz)
+        want = ops.causal_attention(q, k, v, impl="xla")
+        np.testing.assert_allclose(np.asarray(jnp.take(oz, inv, axis=1)),
+                                   np.asarray(want), atol=2e-5, rtol=1e-4)
+
+    def test_unsupported_raises(self, mesh, rng):
+        q, k, v = _qkv(rng, T=32)          # c = 4 < 8: no flash block
+        with pytest.raises(ValueError, match="flash"):
+            ring_attention(mesh, q, k, v, inner="flash")
+        q2, k2, v2 = _qkv(rng, T=64)
+        with pytest.raises(ValueError, match="einsum|flash"):
+            ring_attention(mesh, q2, k2, v2, inner="nope")
+
+    def test_gpt_native_flash_loss_and_grads(self, mesh, rng):
+        """The full stack: native zig-zag layout + flash inner attends
+        through the GPT loss wrapper — loss AND grads must match the
+        single-device forward."""
+        import dataclasses
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig.tiny(vocab_size=64, max_seq_len=64)  # c = 8
+        batch = {"input_ids": rng.integers(0, 64, (4, 64)).astype(np.int32)}
+        plain = GPT(cfg)
+        var = plain.init(jax.random.PRNGKey(0), batch, deterministic=True)
+        want = float(plain.apply(var, batch, deterministic=True))
+        fcfg = dataclasses.replace(cfg, sequence_parallel=True,
+                                   sp_impl="ring", sp_ring_layout="native",
+                                   sp_ring_inner="flash")
+        native = GPT(fcfg, mesh=mesh)
+        got = float(jax.jit(
+            lambda p: native.apply(p, batch, deterministic=True))(var))
+        assert got == pytest.approx(want, rel=2e-4)
+        gw = jax.grad(
+            lambda p: plain.apply(p, batch, deterministic=True))(var)
+        gn = jax.jit(jax.grad(
+            lambda p: native.apply(p, batch, deterministic=True)))(var)
+        for a, b in zip(jax.tree_util.tree_leaves(gw),
+                        jax.tree_util.tree_leaves(gn)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-4, rtol=5e-3)
+
+
 class TestNativeLayout:
     """Round-4 verdict item 5: layout-native zig-zag ring — permute the batch
     into zig-zag placement ONCE per step, keep activations zig-zag through
