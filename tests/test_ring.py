@@ -178,6 +178,35 @@ class TestZigzagSchedule:
                                    atol=2e-5, rtol=1e-4)
 
 
+class TestEngineDonatedSteps:
+    """Regression: ring models crashed on the SECOND engine step (round 5)
+    — module-level jnp scalars and materialized index tables became lifted
+    executable parameters under the engine's donated jit, and the
+    fast-path call under-supplied buffers.  Model-level tests can't catch
+    it (one apply() per executable); only a multi-step engine drive can."""
+
+    @pytest.mark.parametrize("layout", ["drop_in", "native"])
+    def test_three_donated_steps(self, mesh, rng, layout):
+        import dataclasses
+        import deepspeed_tpu
+        from deepspeed_tpu.models import GPT, GPTConfig
+        from conftest import make_lm_batch
+        cfg = dataclasses.replace(
+            GPTConfig.tiny(vocab_size=64, max_seq_len=32),
+            sequence_parallel=True, sp_impl="ring", sp_ring_layout=layout)
+        batch = make_lm_batch(rng, 8, 32, 64)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT(cfg, mesh=mesh), mesh=mesh,
+            example_batch=batch,
+            config={"train_batch_size": 8,
+                    "train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                    "zero_optimization": {"stage": 2},
+                    "steps_per_print": 0})
+        losses = [float(engine.train_batch(batch).loss) for _ in range(3)]
+        assert losses[2] < losses[0]       # and no buffer-count crash
+
+
 class TestFlashInner:
     """Round-5: flash-kernel inner attends with logsumexp merging and a
     ring-level custom_vjp — the [c, c] logit matrices never materialize,
