@@ -332,6 +332,11 @@ def run_bench():
         "zero_optimization": {"stage": 2},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
+        # telemetry rides the flagship leg: comms-byte + memory columns for
+        # the BENCH row.  trace off (its per-step device sync would skew the
+        # timing); snapshot_interval 0 (exported explicitly post-measurement)
+        "telemetry": {"enabled": True, "trace_enabled": False,
+                      "snapshot_interval": 0},
     }
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, cfg_model.vocab_size,
@@ -351,6 +356,28 @@ def run_bench():
     extra = {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
              "params_m": round(engine.num_parameters / 1e6, 1),
              "loss": float(m.loss)}
+    try:
+        # telemetry snapshot next to the timing output: BENCH rows carry
+        # comms-byte and peak-memory columns, and the full registry dump
+        # lands in a sibling JSON for offline comparison
+        snap_path = os.environ.get("BENCH_TELEMETRY_OUT",
+                                   "telemetry_snapshot.json")
+        snap = engine.telemetry.export(step=engine.global_steps,
+                                       write=False)
+        engine.telemetry.exporter.write_json(snap_path, snap)
+        exe = snap.get("executables", {}).get("train_batch", {})
+        extra["comms_bytes_per_step"] = int(
+            exe.get("per_execution_collective_bytes", 0))
+        peak = max((s["value"] for s in snap.get("gauges", {}).get(
+            "device_memory_bytes", {}).get("samples", [])
+            if s.get("labels", {}).get("kind") == "peak"), default=0)
+        extra["peak_device_memory_bytes"] = int(peak)
+        extra["jit_cache_misses"] = int(sum(
+            s["value"] for s in snap.get("counters", {}).get(
+                "jit_cache_misses_total", {}).get("samples", [])))
+        extra["telemetry_snapshot"] = snap_path
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
+        extra["telemetry_error"] = str(e)[:120]
     del engine
 
     def emit():

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Tuple
 
-from deepspeed_tpu import checkpointing, comm, zero
+from deepspeed_tpu.utils import compat as _compat  # noqa: F401 — jax shims
+from deepspeed_tpu import checkpointing, comm, telemetry, zero
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
 from deepspeed_tpu.zero import OnDevice
@@ -30,6 +31,7 @@ __all__ = [
     "TrainState",
     "StepMetrics",
     "comm",
+    "telemetry",
     "zero",
     "checkpointing",
     "get_accelerator",

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.comm.comm import comms_logger
+from deepspeed_tpu.telemetry.registry import record_collective
 
 AxisName = Union[str, Sequence[str]]
 
@@ -31,7 +32,11 @@ def _nbytes(x) -> int:
 
 
 def _log(name: str, x, axis: AxisName):
-    comms_logger.record(name, _nbytes(x), str(axis))
+    nbytes = _nbytes(x)
+    comms_logger.record(name, nbytes, str(axis))
+    # telemetry counter registry (telemetry/registry.py): same trace-time
+    # semantics as the comms logger, but labeled + snapshot-exportable
+    record_collective(name, nbytes, str(axis))
 
 
 def get_world_size(axis: AxisName) -> int:

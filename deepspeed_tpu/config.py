@@ -242,6 +242,42 @@ class CometConfig(DeepSpeedConfigModel):
     api_key: Optional[str] = None
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Unified step telemetry (deepspeed_tpu/telemetry/): host-phase trace
+    spans, recompile watchdog, collective/memory counter registries, and the
+    snapshot exporter.  No reference analog — this is the measurement layer
+    the reference scatters across monitor/, utils/timer.py, and
+    see_memory_usage, unified and extended with the TPU-specific hazards
+    (silent jit recompiles, collective byte volume, HBM headroom).
+
+    Paths default under ``<output_path>/<job_name>/``: ``trace.json``
+    (Chrome-trace/Perfetto), ``snapshot.json``, ``metrics.prom``
+    (Prometheus text exposition).
+    """
+
+    enabled: bool = False
+    output_path: str = ""               # default "./telemetry"
+    job_name: str = "DeepSpeedTPUJob"
+    # span tracer: records host phases; forces one device sync per step
+    # (the device_complete span needs a completion time)
+    trace_enabled: bool = True
+    trace_path: Optional[str] = None
+    snapshot_path: Optional[str] = None
+    prometheus_path: Optional[str] = None
+    # steps between snapshot/prometheus/trace file exports; 0 = only on an
+    # explicit engine.telemetry.export() call
+    snapshot_interval: int = 1
+    # signature misses at step <= warmup are silent (first compiles and
+    # known gas/curriculum shape buckets); later misses warn loudly
+    recompile_warmup_steps: int = 1
+    # per-executable compiled-HLO collective bytes + cost/memory analysis;
+    # costs one extra (AOT) compile per new step signature
+    hlo_stats: bool = True
+    # fan the scalar subset through MonitorMaster (TensorBoard/CSV/W&B)
+    monitor_fanout: bool = True
+    max_trace_events: int = 200_000
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     """reference: "flops_profiler" block (profiling/flops_profiler)."""
 
@@ -316,6 +352,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     comet: CometConfig = Field(default_factory=CometConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(

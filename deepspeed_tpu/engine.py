@@ -476,8 +476,19 @@ class DeepSpeedTPUEngine:
             self._make_init(), out_shardings=self._as_shardings_tuple())
         self._build_step_functions()
 
-        with self.mesh:
+        # On legacy jax, ``with mesh:`` defines the thread-resources mesh
+        # that makes flax's scope.param unboxing apply LOGICAL partition
+        # names as sharding constraints mid-init — logical names are not
+        # mesh axes, so that is always an error (out_shardings are explicit
+        # NamedShardings and don't need the context).  On current jax the
+        # context is harmless and user init_fns may rely on it to resolve
+        # bare PartitionSpec constraints, so it stays.
+        from deepspeed_tpu.utils.compat import is_legacy_jax
+        if is_legacy_jax():
             self.state = self._jit_init(rng, example_batch)
+        else:
+            with self.mesh:
+                self.state = self._jit_init(rng, example_batch)
         if self.offloading:
             # stream the initial params to host: fp32 masters + moments are
             # built there (zero.Init-at-construction analog for the host tier)
@@ -495,8 +506,16 @@ class DeepSpeedTPUEngine:
         #      EngineTimers :145, flops profiler hook :1797) ----
         self.monitor = MonitorMaster(config)
         self.timers = SynchronizedWallClockTimer()
-        self.tput_timer = ThroughputTimer(warmup_steps=1)
+        # rate logging rides the engine's print cadence (reference
+        # ThroughputTimer prints its own line at steps_per_output)
+        self.tput_timer = ThroughputTimer(
+            steps_per_output=int(config.steps_per_print or 0),
+            warmup_steps=1)
         self.wall_clock_breakdown = bool(config.wall_clock_breakdown)
+        # unified step telemetry (telemetry/): span tracer + recompile
+        # watchdog + counter/gauge registries + snapshot exporter
+        from deepspeed_tpu.telemetry import StepTelemetry
+        self.telemetry = StepTelemetry(config, monitor=self.monitor)
 
         # ---- data-efficiency pipeline (reference runtime/data_pipeline/) ----
         self.curriculum_scheduler = None
@@ -634,6 +653,11 @@ class DeepSpeedTPUEngine:
         """(Re)jit the train/grad step programs.  Called at init and again by
         configure_moq — the compiled programs close over the compression
         specs at trace time, so a schedule change needs a re-trace."""
+        tel = getattr(self, "telemetry", None)   # absent on the init call
+        if tel is not None and tel.enabled:
+            # fresh jit objects have empty caches: the next dispatch IS a
+            # compile, and the old compiled-HLO figures are stale
+            tel.invalidate()
         self._jit_eval = None              # rebuilt lazily by eval_batch
         self._jit_grad = jax.jit(self._make_grad_fn())
         if self.offloading:
@@ -782,7 +806,7 @@ class DeepSpeedTPUEngine:
         (scalars, tiny vectors) take a quantized allreduce when blockable,
         else a plain fp32 psum (negligible bytes).
         """
-        from jax import shard_map
+        from deepspeed_tpu.utils.compat import shard_map
         from deepspeed_tpu.ops.quantization import qpsum_local, qrs_local
         mesh, axis = self.mesh, self._qgz_axis
         size = mesh.shape[axis]
@@ -1105,34 +1129,38 @@ class DeepSpeedTPUEngine:
         for the non-pipelined engine.
         """
         t0 = time.perf_counter()
+        tel = self.telemetry
+        step_id = self.global_steps + 1
         self.tput_timer.start()
-        batch = self._apply_data_efficiency(batch)
-        first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
-        # multi-process: each host feeds its process-local slice of the global
-        # batch (train_batch_size / process_count rows)
-        local_bs = self.config.train_batch_size // jax.process_count()
-        micro_local = local_bs // self.gas
-        # disambiguate [gas, micro_local, ...] (pre-shaped) from the flat
-        # [local_bs, ...] form by the SECOND dim too — when gas == local_bs
-        # the leading dim alone cannot tell them apart
-        if (first_shape[0] == self.gas and len(first_shape) > 1
-                and first_shape[1] == micro_local):
-            pass                            # already [gas, micro_local, ...]
-        elif first_shape[0] == local_bs:
-            batch = self._reshape_gas(batch)
-        else:
-            raise ValueError(
-                f"train_batch leading dims {first_shape[:2]} match neither "
-                f"[gas={self.gas}, micro_local={micro_local}, ...] nor the "
-                f"flat process-local batch [{local_bs}, ...] "
-                f"(train_batch_size={self.config.train_batch_size} / "
-                f"{jax.process_count()} processes)")
+        with tel.span("batch_input", step=step_id):
+            batch = self._apply_data_efficiency(batch)
+            first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
+            # multi-process: each host feeds its process-local slice of the
+            # global batch (train_batch_size / process_count rows)
+            local_bs = self.config.train_batch_size // jax.process_count()
+            micro_local = local_bs // self.gas
+            # disambiguate [gas, micro_local, ...] (pre-shaped) from the flat
+            # [local_bs, ...] form by the SECOND dim too — when gas ==
+            # local_bs the leading dim alone cannot tell them apart
+            if (first_shape[0] == self.gas and len(first_shape) > 1
+                    and first_shape[1] == micro_local):
+                pass                        # already [gas, micro_local, ...]
+            elif first_shape[0] == local_bs:
+                batch = self._reshape_gas(batch)
+            else:
+                raise ValueError(
+                    f"train_batch leading dims {first_shape[:2]} match "
+                    f"neither [gas={self.gas}, micro_local={micro_local}, "
+                    f"...] nor the flat process-local batch [{local_bs}, "
+                    f"...] (train_batch_size={self.config.train_batch_size} "
+                    f"/ {jax.process_count()} processes)")
         lead_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
         # [gas, micro_local, T, ...] → tokens per optimizer step (global)
         tokens = (int(np.prod(lead_shape[:3])) * jax.process_count()
                   if len(lead_shape) >= 3 else 0)
         self.timers(DATA_TIMER).start()
-        batch = self._shard_batch(batch, leading_gas=True)
+        with tel.span("host_to_device", step=step_id):
+            batch = self._shard_batch(batch, leading_gas=True)
         self.timers(DATA_TIMER).stop()
         fp = self.config.flops_profiler
         profile_pending = (fp.enabled and not self._flops_profiled
@@ -1141,20 +1169,37 @@ class DeepSpeedTPUEngine:
             self._last_batch = batch  # traced by the flops profiler, then freed
         self.timers(TRAIN_BATCH_TIMER).start()
         with self.mesh:
-            if self.offloading:
-                metrics = self._train_batch_offload(batch)
-            else:
-                self.state, metrics = self._jit_train_batch(self.state, batch)
-        if self.wall_clock_breakdown or profile_pending:
-            # synchronize so the timer covers device execution, not just
-            # dispatch (axon: fetching a value is the only reliable sync)
-            jax.device_get(metrics.loss)
+            if tel.enabled:
+                # recompile watchdog + (on a signature miss) compiled-HLO
+                # collective bytes / cost / memory figures
+                jfn = (self._jit_grads_batch if self.offloading
+                       else self._jit_train_batch)
+                tel.before_dispatch(
+                    "train_batch", batch, step_id,
+                    lower=lambda: jfn.lower(self.state, batch))
+            with tel.span("dispatch", step=step_id):
+                if self.offloading:
+                    metrics = self._train_batch_offload(batch)
+                else:
+                    self.state, metrics = self._jit_train_batch(self.state,
+                                                                batch)
+        with tel.span("device_complete", step=step_id):
+            if (tel.tracer.enabled or self.wall_clock_breakdown
+                    or profile_pending):
+                # synchronize so the timer covers device execution, not just
+                # dispatch (axon: fetching a value is the only reliable sync)
+                jax.device_get(metrics.loss)
         self.timers(TRAIN_BATCH_TIMER).stop()
         self.global_steps += 1
         self._last_metrics = metrics
         self._step_times.append(time.perf_counter() - t0)
         self.tput_timer.stop(int(self.config.train_batch_size), tokens)
-        self._post_step_reporting(metrics)
+        with tel.span("step_bookkeeping", step=step_id):
+            self._post_step_reporting(metrics)
+        tel.end_step(self.global_steps,
+                     samples=self.global_steps
+                     * int(self.config.train_batch_size),
+                     tokens=tokens)
         return metrics
 
     def eval_batch(self, batch):
@@ -1179,6 +1224,11 @@ class DeepSpeedTPUEngine:
                                      deterministic=True)
                 return loss.astype(jnp.float32)
             self._jit_eval = jax.jit(eval_fn)
+        if self.telemetry.enabled:
+            # watchdog only (no HLO analysis: eval is off the hot path and
+            # an AOT compile per eval shape isn't worth the figures)
+            self.telemetry.before_dispatch("eval_batch", batch,
+                                           self.global_steps)
         with self.mesh:
             return self._jit_eval(self.state, batch)
 
@@ -1354,6 +1404,7 @@ class DeepSpeedTPUEngine:
             self._last_batch = None  # free the pinned device batch
         # _step_times[-1] was synchronized (profile_pending forced a fetch)
         prof.latency = self._step_times[-1] if self._step_times else 0.0
+        self.telemetry.record_flops(prof.as_metrics())
         prof.print_model_profile(params=self.state.params,
                                  module_depth=fp.module_depth,
                                  top_modules=fp.top_modules,
@@ -1392,9 +1443,10 @@ class DeepSpeedTPUEngine:
 
     def _print_memory_breakdown(self):
         """reference: see_memory_usage / memory_breakdown config."""
+        from deepspeed_tpu.utils.memory import collect_memory_stats
         lines = []
-        for d in jax.local_devices():
-            stats = getattr(d, "memory_stats", lambda: None)()
+        for d, stats in zip(jax.local_devices(),
+                            collect_memory_stats()["devices"]):
             if stats:
                 used = stats.get("bytes_in_use", 0) / 2**30
                 limit = stats.get("bytes_limit", 0) / 2**30
@@ -1417,16 +1469,25 @@ class DeepSpeedTPUEngine:
         ``deepspeed_tpu.checkpoint.wait_pending()`` before exiting)."""
         from deepspeed_tpu.checkpoint import save_train_state
         tag = tag or f"global_step{self.global_steps}"
-        save_train_state(save_dir, tag, self.state,
-                         client_state=dict(client_state or {},
-                                           global_steps=self.global_steps),
-                         block=not async_save)
-        if self.offloading and jax.process_index() == 0:
-            # host-resident masters/moments ride alongside the orbax tree
-            # (reference: _save_zero_checkpoint per-rank optimizer shards)
-            import os
-            np.savez(os.path.join(save_dir, tag, "offload_state.npz"),
-                     **self.offload_opt.state_dict())
+        with self.telemetry.span("checkpoint_io", step=self.global_steps,
+                                 tag=tag, op="save"):
+            save_train_state(save_dir, tag, self.state,
+                             client_state=dict(client_state or {},
+                                               global_steps=self.global_steps),
+                             block=not async_save)
+            if self.offloading and jax.process_index() == 0:
+                # host-resident masters/moments ride alongside the orbax tree
+                # (reference: _save_zero_checkpoint per-rank optimizer shards)
+                import os
+                np.savez(os.path.join(save_dir, tag, "offload_state.npz"),
+                         **self.offload_opt.state_dict())
+        if self.telemetry.enabled and self.telemetry.snapshot_interval:
+            # flush so the checkpoint_io span reaches the trace file even
+            # when no further step follows (end-of-run checkpoints); same
+            # samples x-axis as end_step so monitor series stay monotonic
+            self.telemetry.export(
+                step=self.global_steps,
+                samples=self.global_steps * int(self.config.train_batch_size))
         return tag
 
     def save_16bit_model(self, save_dir: str,
@@ -1495,8 +1556,10 @@ class DeepSpeedTPUEngine:
         tag = tag or latest_tag(load_dir)
         if tag is None:
             return None, {}
-        self.state, client_state = restore_train_state(
-            load_dir, tag, self.state_shardings, self.state)
+        with self.telemetry.span("checkpoint_io", step=self.global_steps,
+                                 tag=tag, op="load"):
+            self.state, client_state = restore_train_state(
+                load_dir, tag, self.state_shardings, self.state)
         self.global_steps = int(client_state.get("global_steps", 0))
         if self.offloading:
             import os

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 
 from deepspeed_tpu.moe.sharded_moe import topk_gating
 

@@ -1,4 +1,6 @@
-from deepspeed_tpu.monitor.monitor import (MonitorMaster, TensorBoardMonitor,
+from deepspeed_tpu.monitor.monitor import (CometMonitor, CSVMonitor,
+                                           MonitorMaster, TensorBoardMonitor,
                                            WandbMonitor, csvMonitor)
 
-__all__ = ["MonitorMaster", "TensorBoardMonitor", "WandbMonitor", "csvMonitor"]
+__all__ = ["MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
+           "CSVMonitor", "CometMonitor", "csvMonitor"]

@@ -6,16 +6,19 @@ monitor/{tensorboard,csv_monitor,wandb}.py): the engine emits scalar events as
 enabled writer on process rank 0 (multi-host: exactly one process writes).
 
 Differences from the reference: rank filtering uses ``jax.process_index()``
-instead of torch.distributed; TensorBoard rides torch's bundled SummaryWriter
-(tensorboardX as fallback); a missing backend package degrades to a loud
-warning instead of an ImportError so a shared ds_config doesn't kill training
-on machines without wandb.
+instead of torch.distributed; TensorBoard rides tensorboardX when present
+(torch's bundled SummaryWriter as fallback — importing torch costs seconds
+and gigabytes on a TPU-native stack, so it is the last resort); a missing
+backend package degrades to a loud warning instead of an ImportError so a
+shared ds_config doesn't kill training on machines without wandb.
 """
 
 from __future__ import annotations
 
 import csv
 import os
+import re
+import warnings
 from typing import List, Sequence, Tuple
 
 from deepspeed_tpu.utils.logging import logger
@@ -49,13 +52,16 @@ class TensorBoardMonitor(Monitor):
             return
         try:
             try:
-                from torch.utils.tensorboard import SummaryWriter
-            except ImportError:  # pragma: no cover
+                # tensorboardX first: pulling in torch just for a
+                # SummaryWriter is a multi-second, multi-GB import on a
+                # stack that otherwise never touches it
                 from tensorboardX import SummaryWriter
+            except ImportError:  # pragma: no cover
+                from torch.utils.tensorboard import SummaryWriter
         except ImportError:  # pragma: no cover
             logger.warning(
                 "tensorboard monitor enabled but no SummaryWriter backend "
-                "(torch.utils.tensorboard / tensorboardX) is importable — "
+                "(tensorboardX / torch.utils.tensorboard) is importable — "
                 "tensorboard events will be dropped")
             self.enabled = False
             return
@@ -71,8 +77,14 @@ class TensorBoardMonitor(Monitor):
         self.summary_writer.flush()
 
 
-class csvMonitor(Monitor):
-    """reference monitor/csv_monitor.py — one csv file per event name."""
+class CSVMonitor(Monitor):
+    """reference monitor/csv_monitor.py — one csv file per event name.
+
+    Filenames sanitize EVERY non-alphanumeric character to ``_`` (not just
+    ``/`` and spaces): event names flow in from config-driven series
+    (telemetry label fan-out included) and may carry ``=``, ``:``, or
+    anything else that is unsafe or ambiguous in a path.
+    """
 
     def __init__(self, config):
         super().__init__(config)
@@ -84,12 +96,16 @@ class csvMonitor(Monitor):
                                     config.job_name)
         os.makedirs(self.log_dir, exist_ok=True)
 
+    @staticmethod
+    def _sanitize(name: str) -> str:
+        return re.sub(r"[^0-9a-zA-Z]", "_", name)
+
     def write_events(self, event_list: Sequence[Event]) -> None:
         if not self.enabled:
             return
         for name, value, step in event_list:
-            fname = os.path.join(
-                self.log_dir, name.replace("/", "_").replace(" ", "_") + ".csv")
+            fname = os.path.join(self.log_dir,
+                                 self._sanitize(name) + ".csv")
             header = name.split("/")[-1]
             new = fname not in self._seen and not os.path.exists(fname)
             self._seen.add(fname)
@@ -98,6 +114,16 @@ class csvMonitor(Monitor):
                 if new:
                     w.writerow(["step", header])
                 w.writerow([int(step), float(value)])
+
+
+class csvMonitor(CSVMonitor):  # noqa: N801
+    """Deprecated alias (the reference's lowercase class name, kept so
+    configs/imports naming it keep working)."""
+
+    def __init__(self, config):
+        warnings.warn("csvMonitor is deprecated; use CSVMonitor",
+                      DeprecationWarning, stacklevel=2)
+        super().__init__(config)
 
 
 class WandbMonitor(Monitor):
@@ -177,7 +203,7 @@ class MonitorMaster(Monitor):
         if config.tensorboard.enabled:
             self.tb_monitor = TensorBoardMonitor(config.tensorboard)
         if config.csv_monitor.enabled:
-            self.csv_monitor = csvMonitor(config.csv_monitor)
+            self.csv_monitor = CSVMonitor(config.csv_monitor)
         if config.wandb.enabled:
             self.wandb_monitor = WandbMonitor(config.wandb)
         if config.comet.enabled:

@@ -464,7 +464,7 @@ def wq_matmul_tp(x, store, mesh, mode: str, axis: str = "tp", *,
     usual eligibility checks run on LOCAL shapes, so an unsupported slice
     falls back to dequant-matmul per shard — still correctly partitioned.
     """
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     if mesh is None or mesh.shape.get(axis, 1) == 1:

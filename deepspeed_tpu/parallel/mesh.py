@@ -122,7 +122,13 @@ def manual_axes_now() -> frozenset:
     gradient path runs the WHOLE model inside a manual-over-dp region
     (engine._qgz_grads); model code that builds sharding constraints or
     sizes shards from the mesh must treat those axes as already-applied."""
-    am = jax.sharding.get_abstract_mesh()
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_am is None:
+        # older jax: no abstract-mesh query.  The only caller of manual
+        # regions here is the qgZ grad path, which needs the newer shard_map
+        # anyway — outside a manual region "no manual axes" is the truth.
+        return frozenset()
+    am = get_am()
     if am.empty:
         return frozenset()
     from jax.sharding import AxisType

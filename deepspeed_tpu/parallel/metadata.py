@@ -54,9 +54,27 @@ def annotate_abstract(boxed_tree):
 
 
 def unbox(tree):
-    """Strip flax AxisMetadata boxes, returning plain arrays/structs."""
+    """Strip flax AxisMetadata boxes, returning plain arrays/structs.
+
+    Constraints are NOT applied while unboxing: ``Partitioned.unbox`` would
+    apply the LOGICAL names as a sharding constraint whenever a legacy
+    global mesh is active (older jax's ``with mesh:``), and logical names
+    are not mesh axes — the engine maps logical → mesh axes itself via
+    ``partition.param_shardings`` and pins layouts through jit
+    out_shardings.  On newer jax the constraint was already skipped (no
+    legacy global mesh), so this is the one behavior for both."""
     try:
         from flax.linen import meta
-        return meta.unbox(tree)
     except ImportError:  # pragma: no cover
         return tree
+
+    def _unbox(x):
+        if isinstance(x, meta.AxisMetadata):
+            try:
+                return x.unbox(apply_constraint=False)
+            except TypeError:  # AxisMetadata impls without the kwarg
+                return x.unbox()
+        return x
+
+    return jax.tree_util.tree_map(
+        _unbox, tree, is_leaf=lambda x: isinstance(x, meta.AxisMetadata))

@@ -165,6 +165,18 @@ class FlopsProfiler:
         self.latency = min(times)
         return self
 
+    def as_metrics(self) -> Dict[str, float]:
+        """Scalar figures for the telemetry snapshot (StepTelemetry
+        ``record_flops``): the profiled step's flop cost and, when a latency
+        was measured, the achieved rate."""
+        out: Dict[str, float] = {"flops_per_step": float(self.flops)}
+        if self.xla_flops:
+            out["xla_flops_per_step"] = float(self.xla_flops)
+        if self.latency:
+            out["step_latency_s"] = float(self.latency)
+            out["achieved_flops_per_sec"] = float(self.flops) / self.latency
+        return out
+
     def print_model_profile(self, params: Optional[Any] = None,
                             module_depth: int = -1, top_modules: int = 1,
                             detailed: bool = True,

@@ -1,0 +1,39 @@
+"""Unified step telemetry.
+
+The reference ships monitoring as scattered pieces (MonitorMaster fan-out,
+EngineTimers, flops profiler, see_memory_usage); this package correlates
+them per step and adds the TPU-specific hazards nothing else watches:
+
+- ``tracer``         — host-phase span recording + Chrome-trace/Perfetto
+                       JSON export
+- ``watchdog``       — jit recompile detection with leaf-level shape diffs
+- ``registry``       — labeled counter/gauge registries (collective bytes,
+                       memory gauges, cache misses)
+- ``exporter``       — snapshot serialization: JSON, Prometheus text
+                       exposition, MonitorMaster fan-out
+- ``step_telemetry`` — the engine-facing facade driving all of the above
+
+See docs/observability.md for the config block and workflows.
+"""
+
+from deepspeed_tpu.telemetry.exporter import SnapshotExporter
+from deepspeed_tpu.telemetry.registry import (Counter, Gauge, MetricRegistry,
+                                              default_registry,
+                                              record_collective)
+from deepspeed_tpu.telemetry.step_telemetry import StepTelemetry
+from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
+from deepspeed_tpu.telemetry.watchdog import RecompileWatchdog, signature_of
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricRegistry",
+    "RecompileWatchdog",
+    "SnapshotExporter",
+    "SpanTracer",
+    "StepTelemetry",
+    "TraceEmitter",
+    "default_registry",
+    "record_collective",
+    "signature_of",
+]

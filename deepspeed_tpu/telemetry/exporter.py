@@ -1,0 +1,147 @@
+"""Snapshot exporter — registry state → JSON / Prometheus text / monitors.
+
+One serialization point for everything the telemetry layer measures: the
+counter/gauge registries, the span-phase summary, and the per-executable
+compiled figures (collective bytes, ``cost_analysis``/``memory_analysis``)
+gathered by ``StepTelemetry``.  Three sinks:
+
+- ``write_json``         — the machine-readable snapshot (bench rows, CI)
+- ``write_prometheus``   — text exposition format, scrapeable by any
+                           Prometheus-compatible collector via node textfile
+                           exporter or a file-serving sidecar
+- ``scalar_events``      — the flat scalar subset as MonitorMaster events,
+                           so TensorBoard/CSV/W&B pick up the new series
+                           through the existing fan-out for free
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.telemetry.registry import MetricRegistry
+
+Event = Tuple[str, float, int]
+
+
+def _prom_escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _prom_name(namespace: str, name: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    return f"{namespace}_{safe}" if namespace else safe
+
+
+def _prom_value(v: float) -> str:
+    """Full-precision sample rendering: '%g' (6 significant digits) would
+    quantize a multi-GB byte counter so coarsely that per-step increments
+    vanish and rate() reads zero.  Non-finite values use the exposition
+    format's NaN/+Inf/-Inf tokens (int(v) on them would raise and kill the
+    export)."""
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 2 ** 63:
+        return str(int(v))
+    return repr(v)
+
+
+class SnapshotExporter:
+    def __init__(self, registry: MetricRegistry, tracer=None,
+                 namespace: str = "deepspeed_tpu"):
+        self.registry = registry
+        self.tracer = tracer
+        self.namespace = namespace
+
+    # ---- snapshot assembly ----
+
+    def snapshot(self, step: Optional[int] = None,
+                 extra: Optional[dict] = None) -> dict:
+        snap = {
+            "schema": "deepspeed_tpu.telemetry.v1",
+            "unix_time": time.time(),
+            **self.registry.snapshot(),
+        }
+        if step is not None:
+            snap["step"] = int(step)
+        if self.tracer is not None and self.tracer.events:
+            snap["spans"] = self.tracer.summary()
+        if extra:
+            snap.update(extra)
+        return snap
+
+    def write_json(self, path: str, snap: Optional[dict] = None,
+                   step: Optional[int] = None) -> str:
+        snap = snap if snap is not None else self.snapshot(step=step)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    # ---- Prometheus text exposition ----
+
+    def prometheus_text(self, snap: Optional[dict] = None) -> str:
+        snap = snap if snap is not None else self.snapshot()
+        lines: List[str] = []
+        for kind_key, prom_type in (("counters", "counter"),
+                                    ("gauges", "gauge")):
+            for name, metric in sorted(snap.get(kind_key, {}).items()):
+                pname = _prom_name(self.namespace, name)
+                if metric.get("help"):
+                    lines.append(f"# HELP {pname} "
+                                 f"{_prom_escape(metric['help'])}")
+                lines.append(f"# TYPE {pname} {prom_type}")
+                for s in metric["samples"]:
+                    labels = s.get("labels") or {}
+                    if labels:
+                        body = ",".join(
+                            f'{k}="{_prom_escape(str(v))}"'
+                            for k, v in sorted(labels.items()))
+                        lines.append(
+                            f"{pname}{{{body}}} {_prom_value(s['value'])}")
+                    else:
+                        lines.append(f"{pname} {_prom_value(s['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_prometheus(self, path: str,
+                         snap: Optional[dict] = None) -> str:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.prometheus_text(snap))
+        os.replace(tmp, path)
+        return path
+
+    # ---- MonitorMaster fan-out ----
+
+    def scalar_events(self, snap: Optional[dict] = None, x: int = 0,
+                      prefix: str = "Train/Telemetry") -> List[Event]:
+        """Flatten every sample into ``(name, value, x)`` monitor events.
+        Series names join label VALUES in sorted-key order so they are
+        stable — for labels ``{kind: all_reduce, axis: dp}`` the keys sort
+        (axis, kind), giving
+        ``Train/Telemetry/collective_bytes_total/dp/all_reduce``."""
+        snap = snap if snap is not None else self.snapshot()
+        events: List[Event] = []
+        for kind_key in ("counters", "gauges"):
+            for name, metric in sorted(snap.get(kind_key, {}).items()):
+                for s in metric["samples"]:
+                    labels = s.get("labels") or {}
+                    parts = [prefix, name] + [
+                        str(labels[k]) for k in sorted(labels)]
+                    events.append(("/".join(parts), float(s["value"]),
+                                   int(x)))
+        return events

@@ -1,0 +1,290 @@
+"""StepTelemetry — the per-step telemetry facade the engine drives.
+
+One object owning the four telemetry pieces (span tracer, recompile
+watchdog, metric registries, snapshot exporter) plus the per-executable
+compiled-program analysis that connects them to XLA ground truth:
+
+- ``span(name, step)``         — host-phase spans around engine step stages
+- ``before_dispatch(...)``     — watchdog fingerprint + (on a signature
+                                 miss) compiled-HLO collective bytes and
+                                 ``cost_analysis``/``memory_analysis``
+                                 figures + per-execution byte counters
+- ``end_step(...)``            — cadence-gated memory sampling and snapshot
+                                 export (JSON + Prometheus + monitor fan-out)
+
+Everything is inert when ``telemetry.enabled`` is false: ``span`` returns a
+shared nullcontext and the other hooks return immediately, so the disabled
+path adds one attribute check per call to the hot loop.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import nullcontext
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.telemetry.exporter import SnapshotExporter
+from deepspeed_tpu.telemetry.registry import MetricRegistry, default_registry
+from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
+from deepspeed_tpu.telemetry.watchdog import RecompileWatchdog
+from deepspeed_tpu.utils.logging import logger
+
+_NULL = nullcontext()
+
+HLO_BYTES = "hlo_collective_bytes_total"
+HLO_CALLS = "hlo_collective_calls_total"
+
+# cost_analysis keys worth keeping (the full dict carries dozens of
+# backend-specific entries)
+_COST_KEYS = ("flops", "bytes accessed", "transcendentals")
+_MEMORY_ATTRS = ("generated_code_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes", "temp_size_in_bytes",
+                 "alias_size_in_bytes")
+
+
+class StepTelemetry:
+    def __init__(self, config, monitor=None,
+                 registry: Optional[MetricRegistry] = None):
+        tcfg = config.telemetry
+        self.enabled = bool(tcfg.enabled)
+        self.monitor = monitor
+        self.registry = registry if registry is not None else default_registry
+        import jax
+        pid = jax.process_index()
+        self._rank0 = pid == 0
+        self.tracer = SpanTracer(
+            enabled=self.enabled and bool(tcfg.trace_enabled), pid=pid,
+            max_events=int(tcfg.max_trace_events))
+        self.emitter = TraceEmitter()
+        self.watchdog = RecompileWatchdog(
+            warmup_steps=int(tcfg.recompile_warmup_steps),
+            registry=self.registry if self.enabled else None,
+            emit_warnings=self._rank0)
+        self.exporter = SnapshotExporter(self.registry, self.tracer)
+        base = os.path.join(tcfg.output_path or "./telemetry", tcfg.job_name)
+        self.trace_path = tcfg.trace_path or os.path.join(base, "trace.json")
+        self.snapshot_path = (tcfg.snapshot_path
+                              or os.path.join(base, "snapshot.json"))
+        self.prometheus_path = (tcfg.prometheus_path
+                                or os.path.join(base, "metrics.prom"))
+        self.hlo_stats = bool(tcfg.hlo_stats)
+        self.snapshot_interval = int(tcfg.snapshot_interval)
+        self.monitor_fanout = bool(tcfg.monitor_fanout)
+        # fn -> {signatures, executions, collectives, per-exec figures}
+        # (collectives/cost/memory reflect the most recent signature; the
+        # per-signature truth for counter attribution lives in _sig_stats)
+        self._exec: Dict[str, dict] = {}
+        self._sig_stats: Dict[tuple, dict] = {}
+        self._trace_flush_mark = 0
+
+    # ------------------------------------------------------------- spans
+
+    def span(self, name: str, step: Optional[int] = None, **args):
+        if not self.tracer.enabled:
+            return _NULL
+        return self.tracer.span(name, step=step, **args)
+
+    # --------------------------------------------------------- dispatch
+
+    def before_dispatch(self, fn_name: str, args_tree, step: int,
+                        lower: Optional[Callable] = None) -> bool:
+        """Watchdog-observe one jitted dispatch.  Returns True on a
+        signature miss (== an XLA compile).  On a miss, ``lower`` (a thunk
+        returning ``jitted.lower(*args)``) is used — when hlo_stats is on —
+        to pull collective bytes and cost/memory figures out of the compiled
+        program; every call then bumps the per-execution HLO byte counters
+        by the figures of THE SIGNATURE BEING DISPATCHED (shape buckets of
+        one function keep distinct per-step byte costs)."""
+        if not self.enabled:
+            return False
+        from deepspeed_tpu.telemetry.watchdog import signature_of
+        sig = signature_of(args_tree)
+        miss = self.watchdog.observe_signature(fn_name, sig, step)
+        info = self._exec.setdefault(
+            fn_name, {"signatures": 0, "executions": 0, "collectives": {},
+                      "cost_analysis": {}, "memory_analysis": {}})
+        if miss:
+            info["signatures"] += 1
+            collected = {}
+            if self.hlo_stats and lower is not None:
+                collected = self._analyze_executable(fn_name, lower, info)
+            # per-signature figures: counters for this and every later
+            # execution of this bucket use ITS compiled program — on an
+            # analysis failure the bucket counts NOTHING rather than
+            # inheriting another signature's bytes
+            self._sig_stats[(fn_name, sig)] = dict(collected)
+        info["executions"] += 1
+        collectives = self._sig_stats.get((fn_name, sig), {})
+        if collectives:
+            bytes_c = self.registry.counter(
+                HLO_BYTES, "collective payload bytes per execution of each "
+                "compiled step program (from compiled HLO), per kind")
+            calls_c = self.registry.counter(
+                HLO_CALLS, "collective op executions per compiled step "
+                "program run, per kind")
+            for kind, rec in collectives.items():
+                bytes_c.inc(rec["bytes"], kind=kind, fn=fn_name)
+                calls_c.inc(rec["count"], kind=kind, fn=fn_name)
+        return miss
+
+    def invalidate(self, fn_name: Optional[str] = None) -> None:
+        """Forget signature caches and per-executable figures — the engine
+        calls this when it re-jits its step programs (configure_moq): the
+        fresh jit caches are empty, so the next dispatch is a real compile
+        and the old compiled figures no longer describe the program."""
+        self.watchdog.invalidate(fn_name)
+        if fn_name is None:
+            self._exec.clear()
+            self._sig_stats.clear()
+        else:
+            self._exec.pop(fn_name, None)
+            for key in [k for k in self._sig_stats if k[0] == fn_name]:
+                del self._sig_stats[key]
+
+    def _analyze_executable(self, fn_name: str, lower: Callable,
+                            info: dict) -> dict:
+        """Compile the (freshly missed) signature AOT and harvest static
+        figures; returns this signature's collective figures ({} on
+        failure).  jit will compile the same program again on the real
+        call — the double compile is the price of the figures and is gated
+        behind ``telemetry.hlo_stats``.  Failures degrade to a warning:
+        telemetry must never kill training."""
+        from deepspeed_tpu.comm.comm import hlo_collective_bytes
+        from deepspeed_tpu.telemetry.registry import \
+            suppress_collective_recording
+        info["collectives"] = {}
+        try:
+            # the AOT lower() RETRACES the step — silence the wrapper-level
+            # trace-time hooks so their byte counters don't double-count
+            with suppress_collective_recording():
+                compiled = lower().compile()
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"telemetry: compile analysis of '{fn_name}' "
+                           f"failed: {e!r}")
+            return {}
+        try:
+            info["collectives"] = hlo_collective_bytes(compiled.as_text())
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"telemetry: HLO collective walk of '{fn_name}' "
+                           f"failed: {e!r}")
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            cost = {k: float(ca[k]) for k in _COST_KEYS if k in ca}
+            info["cost_analysis"] = cost
+            for k, v in cost.items():
+                self.registry.gauge(
+                    "xla_cost_" + k.replace(" ", "_"),
+                    "compiled-program cost_analysis figure, per jitted "
+                    "function").set(v, fn=fn_name)
+        except Exception:  # noqa: BLE001 — not all backends implement it
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            mem = {}
+            for attr in _MEMORY_ATTRS:
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    mem[attr] = int(v)
+            info["memory_analysis"] = mem
+            g = self.registry.gauge(
+                "xla_memory_bytes", "compiled-program memory_analysis "
+                "figures, per jitted function")
+            for attr, v in mem.items():
+                g.set(v, fn=fn_name,
+                      kind=attr.replace("_size_in_bytes", ""))
+        except Exception:  # noqa: BLE001
+            pass
+        return info["collectives"]
+
+    # ------------------------------------------------------------ memory
+
+    def sample_memory(self) -> None:
+        """Live/peak/limit bytes per local device + host RSS, as gauges
+        (reference see_memory_usage, now on a cadence instead of ad hoc)."""
+        if not self.enabled:
+            return
+        from deepspeed_tpu.utils.memory import collect_memory_stats
+        stats = collect_memory_stats()
+        g = self.registry.gauge(
+            "device_memory_bytes",
+            "XLA allocator stats per local device (in_use/peak/limit)")
+        for i, dev in enumerate(stats["devices"]):
+            for key, label in (("bytes_in_use", "in_use"),
+                               ("peak_bytes_in_use", "peak"),
+                               ("bytes_limit", "limit")):
+                if key in dev:
+                    g.set(dev[key], device=str(i), kind=label)
+        if stats.get("host_rss_bytes"):
+            self.registry.gauge(
+                "host_memory_rss_bytes",
+                "process max RSS on this host").set(stats["host_rss_bytes"])
+
+    def record_flops(self, metrics: Dict[str, float]) -> None:
+        """Flops-profiler figures as gauges (profiling/flops_profiler.py
+        ``as_metrics``) so the snapshot carries the model-cost numbers."""
+        if not self.enabled:
+            return
+        for name, value in metrics.items():
+            self.registry.gauge(
+                "flops_profiler_" + name,
+                "flops profiler figure for the profiled step").set(value)
+
+    # ----------------------------------------------------------- export
+
+    def end_step(self, step: int, samples: Optional[int] = None,
+                 tokens: int = 0) -> None:
+        if not self.enabled:
+            return
+        self.registry.counter("engine_steps_total",
+                              "optimizer steps taken").inc(1)
+        if tokens:
+            self.registry.counter("train_tokens_total",
+                                  "tokens consumed by train_batch").inc(
+                                      tokens)
+        if self.snapshot_interval and step % self.snapshot_interval == 0:
+            self.export(step=step, samples=samples, throttle_trace=True)
+
+    def export(self, step: Optional[int] = None,
+               samples: Optional[int] = None, write: bool = True,
+               throttle_trace: bool = False) -> dict:
+        """Assemble a snapshot; write the JSON/Prometheus/trace files
+        (rank 0) and fan the scalar subset through MonitorMaster.  Returns
+        the snapshot dict either way.
+
+        ``throttle_trace`` (the per-step cadence path) rewrites the trace
+        file only after the buffer grew ~10% since the last flush: the
+        trace dump is O(buffer), so unthrottled per-step rewrites of a
+        long run's buffer would dominate step bookkeeping.  Small runs
+        flush every export (the threshold rounds up to one event);
+        explicit exports and checkpoint flushes always write."""
+        if not self.enabled:
+            return {}
+        self.sample_memory()
+        executables = {}
+        for fn, info in self._exec.items():
+            per_exec = sum(rec["bytes"]
+                           for rec in info["collectives"].values())
+            executables[fn] = {**info,
+                               "per_execution_collective_bytes": per_exec}
+        snap = self.exporter.snapshot(step=step,
+                                      extra={"executables": executables})
+        if write and self._rank0:
+            try:
+                self.exporter.write_json(self.snapshot_path, snap)
+                self.exporter.write_prometheus(self.prometheus_path, snap)
+                if self.tracer.enabled:
+                    new = self.tracer.total_recorded - self._trace_flush_mark
+                    if (not throttle_trace
+                            or new >= max(1, len(self.tracer.events) // 10)):
+                        self.emitter.write(self.trace_path, self.tracer)
+                        self._trace_flush_mark = self.tracer.total_recorded
+            except Exception as e:  # noqa: BLE001 — never kill training
+                logger.warning(f"telemetry: export failed: {e!r}")
+        if (self.monitor_fanout and self.monitor is not None
+                and getattr(self.monitor, "enabled", False)):
+            x = samples if samples is not None else (step or 0)
+            self.monitor.write_events(
+                self.exporter.scalar_events(snap, x=x))
+        return snap

@@ -1,0 +1,143 @@
+"""Host-side span tracer + Chrome-trace/Perfetto emitter.
+
+T3 (arxiv 2401.16677) makes the case that optimizing compute/collective
+overlap starts from *seeing* the timeline; on TPU the device timeline comes
+from ``jax.profiler`` xplane captures, but the host-side step anatomy — batch
+assembly, host→device placement, dispatch, waiting on device completion,
+optimizer/step bookkeeping, checkpoint I/O — is invisible to it.  The
+``SpanTracer`` records those phases as complete events and ``TraceEmitter``
+writes the standard Chrome trace-event JSON that Perfetto / chrome://tracing
+load directly, so a training run's host anatomy can be inspected next to the
+device profile.
+
+Events use the ``ph: "X"`` (complete) form with microsecond timestamps
+relative to tracer construction; ``pid`` is the JAX process index so
+multi-host traces merge cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class SpanTracer:
+    """Records named host-side phase spans.
+
+    ``span()`` is a context manager; when the tracer is disabled it costs one
+    attribute check.  The event buffer is bounded — when full, the oldest
+    events are dropped and ``dropped_events`` counts them (a watchdog-style
+    disclosure rather than silent truncation or unbounded growth).
+    """
+
+    def __init__(self, enabled: bool = True, pid: int = 0,
+                 max_events: int = 200_000):
+        self.enabled = enabled
+        self.pid = int(pid)
+        self.max_events = int(max_events)
+        # deque(maxlen): O(1) overflow (a full list would memmove the whole
+        # buffer on every drop)
+        self.events: deque = deque(maxlen=self.max_events)
+        self.dropped_events = 0
+        self.total_recorded = 0
+        # incremental per-phase aggregates: summary() must not rescan the
+        # buffer (it is embedded in every snapshot export — an O(buffer)
+        # walk there would grow with run length)
+        self._agg: Dict[str, dict] = {}
+        self._epoch_ns = time.perf_counter_ns()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._epoch_ns) / 1e3
+
+    @contextmanager
+    def span(self, name: str, step: Optional[int] = None, **args):
+        if not self.enabled:
+            yield
+            return
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            self.record(name, t0, self._now_us() - t0, step=step, **args)
+
+    def record(self, name: str, ts_us: float, dur_us: float,
+               step: Optional[int] = None, **args) -> None:
+        if not self.enabled:
+            return
+        ev_args = dict(args)
+        if step is not None:
+            ev_args["step"] = int(step)
+        if len(self.events) == self.max_events:
+            self.dropped_events += 1
+        self.events.append({
+            "name": name, "cat": "host_phase", "ph": "X",
+            "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+            "pid": self.pid, "tid": 0, "args": ev_args,
+        })
+        self.total_recorded += 1
+        agg = self._agg.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                          "max_ms": 0.0})
+        dur_ms = dur_us / 1e3
+        agg["count"] += 1
+        agg["total_ms"] += dur_ms
+        if dur_ms > agg["max_ms"]:
+            agg["max_ms"] = dur_ms
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-phase count / total / max / mean milliseconds — the compact
+        form the snapshot exporter embeds.  Aggregated over EVERY recorded
+        span, including ones the bounded event buffer has already dropped
+        (the trace file keeps the last ``max_events``; the summary keeps
+        the whole run)."""
+        out: Dict[str, dict] = {}
+        for name, agg in self._agg.items():
+            out[name] = {
+                "count": agg["count"],
+                "total_ms": round(agg["total_ms"], 3),
+                "max_ms": round(agg["max_ms"], 3),
+                "mean_ms": round(agg["total_ms"] / max(agg["count"], 1), 3),
+            }
+        return out
+
+    def clear(self) -> None:
+        self.events = deque(maxlen=self.max_events)
+        self.dropped_events = 0
+        self.total_recorded = 0
+        self._agg = {}
+
+
+class TraceEmitter:
+    """Writes a SpanTracer's buffer as Chrome trace-event JSON.
+
+    The output is the ``{"traceEvents": [...]}`` object form (not the bare
+    array) so metadata fields ride along; Perfetto and chrome://tracing both
+    accept it.
+    """
+
+    def __init__(self, process_name: str = "deepspeed_tpu"):
+        self.process_name = process_name
+
+    def to_dict(self, tracer: SpanTracer) -> dict:
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": tracer.pid, "tid": 0,
+            "args": {"name": f"{self.process_name}/{tracer.pid}"},
+        }]
+        return {
+            "traceEvents": meta + list(tracer.events),
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": tracer.dropped_events},
+        }
+
+    def write(self, path: str, tracer: SpanTracer) -> str:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(tracer), f)
+        os.replace(tmp, path)   # readers never see a half-written trace
+        return path

@@ -1,0 +1,129 @@
+"""Recompile watchdog — the TPU performance hazard the reference never had.
+
+Under torch, a shape change costs a slow eager step; under jit it silently
+recompiles the *entire* train step (tens of seconds to minutes at scale) and
+then keeps both executables resident.  A dataloader that pads to raw lengths
+instead of buckets can recompile every step and read as "TPUs are slow".
+
+The watchdog fingerprints the abstract signature (pytree paths + shapes +
+dtypes) of everything entering each jitted executable.  Its cache mirrors
+jit's own: a signature miss here *is* a compile there.  Misses during warmup
+(first compiles, known gas/curriculum buckets) are counted silently; a miss
+after warmup logs ONE loud rank-0 warning carrying the exact leaf-level
+shape diff against the previous signature — the line a user needs to find
+the offending input.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Signature = Tuple[Tuple[str, Tuple[int, ...], str], ...]
+
+RECOMPILES = "jit_cache_misses_total"
+RECOMPILE_WARNINGS = "jit_recompile_warnings_total"
+
+
+def signature_of(tree) -> Signature:
+    """(path, shape, dtype) per leaf — the aval fingerprint jit keys on."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    sig = []
+    for path, leaf in flat:
+        sig.append((jax.tree_util.keystr(path),
+                    tuple(getattr(leaf, "shape", ()) or ()),
+                    str(getattr(leaf, "dtype", type(leaf).__name__))))
+    return tuple(sig)
+
+
+def _diff(old: Signature, new: Signature) -> str:
+    """Leaf-level shape/dtype diff, e.g.
+    ``['input_ids']: (2, 16) int32 -> (2, 24) int32``."""
+    old_map = {p: (s, d) for p, s, d in old}
+    new_map = {p: (s, d) for p, s, d in new}
+    lines = []
+    for p in sorted(set(old_map) | set(new_map)):
+        o, n = old_map.get(p), new_map.get(p)
+        if o == n:
+            continue
+        fmt = lambda v: f"{v[0]} {v[1]}" if v else "<absent>"  # noqa: E731
+        lines.append(f"  {p}: {fmt(o)} -> {fmt(n)}")
+    return "\n".join(lines) or "  (tree structure changed, no common leaves)"
+
+
+class RecompileWatchdog:
+    """Per-function signature cache with post-warmup recompile warnings.
+
+    ``observe`` returns True on a signature miss (== a jit compile).  The
+    warning text is also kept on ``last_warning`` so tests (and callers that
+    swallow logs) can assert on it without capturing stderr.
+    """
+
+    def __init__(self, warmup_steps: int = 1, registry=None,
+                 emit_warnings: bool = True):
+        self.warmup_steps = int(warmup_steps)
+        self.registry = registry
+        self.emit_warnings = emit_warnings
+        self._known: Dict[str, Dict[Signature, int]] = {}
+        self._last_sig: Dict[str, Signature] = {}
+        self.warnings_emitted = 0
+        self.last_warning: Optional[str] = None
+
+    def observe(self, fn_name: str, args_tree, step: int) -> bool:
+        return self.observe_signature(fn_name, signature_of(args_tree), step)
+
+    def observe_signature(self, fn_name: str, sig: Signature,
+                          step: int) -> bool:
+        known = self._known.setdefault(fn_name, {})
+        if sig in known:
+            return False
+        prev = self._last_sig.get(fn_name)
+        known[sig] = int(step)
+        self._last_sig[fn_name] = sig
+        if self.registry is not None:
+            self.registry.counter(
+                RECOMPILES,
+                "jit signature-cache misses (each one is an XLA compile) "
+                "per jitted function").inc(1, fn=fn_name)
+        if prev is not None and step > self.warmup_steps:
+            self._warn(fn_name, prev, sig, step)
+        return True
+
+    def _warn(self, fn_name: str, prev: Signature, sig: Signature,
+              step: int) -> None:
+        self.warnings_emitted += 1
+        msg = (
+            f"RECOMPILE at step {step}: jitted '{fn_name}' saw a new input "
+            f"signature after warmup (signature #{len(self._known[fn_name])} "
+            f"for this function) — XLA is recompiling the whole step "
+            f"program.  Shape diff vs previous signature:\n"
+            f"{_diff(prev, sig)}\n"
+            f"Steady-state training should reuse one signature; pad or "
+            f"bucket inputs to fixed shapes to stop paying this compile.")
+        self.last_warning = msg
+        if self.registry is not None:
+            self.registry.counter(
+                RECOMPILE_WARNINGS,
+                "post-warmup recompile warnings emitted").inc(1, fn=fn_name)
+        if self.emit_warnings:
+            logger.warning(msg)
+
+    def misses(self, fn_name: Optional[str] = None) -> int:
+        if fn_name is not None:
+            return len(self._known.get(fn_name, {}))
+        return sum(len(v) for v in self._known.values())
+
+    def invalidate(self, fn_name: Optional[str] = None) -> None:
+        """Forget cached signatures — call when the jitted programs are
+        rebuilt (engine re-jit via configure_moq): the fresh jit caches are
+        empty, so the next dispatch IS a compile and must be observed as
+        one."""
+        if fn_name is None:
+            self._known.clear()
+            self._last_sig.clear()
+        else:
+            self._known.pop(fn_name, None)
+            self._last_sig.pop(fn_name, None)

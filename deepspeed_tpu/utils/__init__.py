@@ -1,6 +1,7 @@
 from deepspeed_tpu.utils.logging import log_dist, logger
-from deepspeed_tpu.utils.memory import (instrument_w_nvtx, instrument_w_trace,
-                                        see_memory_usage)
+from deepspeed_tpu.utils.memory import (collect_memory_stats,
+                                        instrument_w_nvtx,
+                                        instrument_w_trace, see_memory_usage)
 
-__all__ = ["logger", "log_dist", "see_memory_usage", "instrument_w_trace",
-           "instrument_w_nvtx"]
+__all__ = ["logger", "log_dist", "see_memory_usage", "collect_memory_stats",
+           "instrument_w_trace", "instrument_w_nvtx"]

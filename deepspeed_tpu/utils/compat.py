@@ -1,0 +1,114 @@
+"""jax version-compatibility shims.
+
+This codebase targets the current jax API; older runtimes (which the CI
+image may pin) miss pieces of it.  Rather than scattering try/except at
+every call site, the accepted spellings live here:
+
+- ``shard_map``: newer jax exposes it at top level with ``check_vma`` and
+  ``axis_names`` (partial-manual) kwargs; older jax has
+  ``jax.experimental.shard_map.shard_map`` with ``check_rep`` and the
+  inverse ``auto`` parameter.  Callers use the NEW spelling; the shim
+  translates downward when needed.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _new_shard_map
+    _legacy = None
+except ImportError:  # pragma: no cover - exercised on older jax only
+    from jax.experimental.shard_map import shard_map as _legacy
+    _new_shard_map = None
+
+
+def _align_flax_legacy_mesh() -> None:
+    """Old-jax only: stop flax from applying LOGICAL axis names as mesh
+    sharding constraints.
+
+    Older jax defines the legacy thread-resources mesh inside ``with
+    mesh:``; flax's ``Partitioned.unbox`` (and every ``scope.param`` read
+    of a boxed variable) then applies its names as a
+    ``with_sharding_constraint``.  For this library's models the names are
+    LOGICAL — ``('vocab', 'embed')`` — not mesh axes, so that constraint
+    is always an error.  Newer jax never defines the legacy mesh and skips
+    it entirely.
+
+    The wrap below is surgical, not a blanket disable: a box whose names
+    ARE all axes of the active legacy mesh (another library's valid,
+    load-bearing auto-constraint) still takes the original path;
+    only boxes carrying names the mesh doesn't know skip the constraint —
+    which upstream would have crashed on anyway.  Explicitly-meshed
+    ``Partitioned(mesh=...)`` boxes are untouched."""
+    try:
+        from flax.core import meta as _meta
+        from jax.interpreters import pxla
+        orig_unbox = _meta.Partitioned.unbox
+
+        def unbox(self, apply_constraint=True):
+            if apply_constraint and self.mesh is None:
+                env_mesh = pxla.thread_resources.env.physical_mesh
+                if env_mesh.devices.shape != ():
+                    flat = []
+                    for n in self.names:
+                        if isinstance(n, (tuple, list)):
+                            flat += [m for m in n if m]
+                        elif n:
+                            flat.append(n)
+                    if not set(flat) <= set(env_mesh.axis_names):
+                        return self.value   # logical names: no constraint
+            return orig_unbox(self, apply_constraint)
+
+        _meta.Partitioned.unbox = unbox
+    except Exception:  # noqa: BLE001 — flax internals moved; nothing to fix
+        pass
+
+
+def _align_pallas_names() -> None:
+    """Old-jax only: ``pltpu.TPUCompilerParams`` was renamed to
+    ``pltpu.CompilerParams``; the kernels here use the new spelling."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        if (not hasattr(pltpu, "CompilerParams")
+                and hasattr(pltpu, "TPUCompilerParams")):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except Exception:  # noqa: BLE001 — pallas absent or reshaped
+        pass
+
+
+if _new_shard_map is None:  # pragma: no cover - old-jax path
+    _align_flax_legacy_mesh()
+    _align_pallas_names()
+
+
+def is_legacy_jax() -> bool:
+    """True on jax versions predating top-level ``jax.shard_map`` — the
+    marker this module uses for every old-API accommodation."""
+    return _new_shard_map is None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None, **kw):
+    """``jax.shard_map`` front-end accepting the new-API kwargs on any jax.
+
+    On older jax, ``check_vma`` maps to ``check_rep`` and ``axis_names``
+    (the manual axes) maps to ``auto`` (its complement over the mesh).
+    """
+    if _new_shard_map is not None:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    if axis_names is not None:
+        # partial-manual over `auto` on legacy shard_map has been observed
+        # to wedge XLA's partitioner (test_qgz hangs multi-minutes) — fail
+        # fast rather than eat a CI run's whole time budget
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names=...) needs a jax with "
+            "top-level jax.shard_map; this jax "
+            "only has the legacy experimental API")
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)

@@ -15,6 +15,33 @@ import functools
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
+def host_rss_bytes() -> int:
+    """Process max RSS in bytes (0 where ``resource`` is unavailable)."""
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # noqa: BLE001 — resource is POSIX-only
+        return 0
+
+
+def collect_memory_stats() -> dict:
+    """Allocator stats for every local device + host RSS, in one dict:
+    ``{"devices": [per-device memory_stats dicts], "host_rss_bytes": n}``.
+    Shared by see_memory_usage, the engine's memory_breakdown print, and the
+    telemetry memory gauges (telemetry/step_telemetry.py sample_memory) so
+    all three report the same numbers.  Backends without allocator stats
+    (CPU) yield empty per-device dicts."""
+    import jax
+    devices = []
+    for d in jax.local_devices():
+        try:
+            stats = getattr(d, "memory_stats", lambda: None)()
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            stats = None
+        devices.append(dict(stats or {}))
+    return {"devices": devices, "host_rss_bytes": host_rss_bytes()}
+
+
 def see_memory_usage(message: str, force: bool = False) -> dict:
     """Log device + host memory usage (reference runtime/utils.py
     see_memory_usage; rank-0 only like the original)."""
@@ -27,13 +54,10 @@ def see_memory_usage(message: str, force: bool = False) -> dict:
     for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
         if key in stats:
             parts.append(f"{key}={stats[key] / gb:.2f}GB")
-    try:
-        import resource
-        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    rss = host_rss_bytes()
+    if rss:
         parts.append(f"host_rss={rss / gb:.2f}GB")
         stats["host_rss_bytes"] = rss
-    except Exception:  # noqa: BLE001 — resource is POSIX-only
-        pass
     log_dist(f"MEM {message}: " + (", ".join(parts) or "no allocator stats"),
              ranks=[0])
     return stats

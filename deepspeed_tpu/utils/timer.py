@@ -87,6 +87,9 @@ class ThroughputTimer:
 
     ``update_epoch_count``-style bookkeeping is dropped; the engine feeds
     (batch_size, seq_len) per step and reads smoothed rates.
+    ``steps_per_output`` gates a rate log line every N counted steps
+    (reference :222 prints its throughput summary at the same cadence);
+    0 disables the output, matching the reference's None default.
     """
 
     def __init__(self, steps_per_output: int = 0, warmup_steps: int = 1):
@@ -111,6 +114,19 @@ class ThroughputTimer:
             self.total_time += dt
             self.total_samples += batch_size
             self.total_tokens += tokens
+            if (self.steps_per_output
+                    and self.global_steps % self.steps_per_output == 0):
+                self._log_rates(batch_size, tokens, dt)
+
+    def _log_rates(self, batch_size: int, tokens: int, dt: float):
+        parts = [f"step={self.global_steps}",
+                 f"samples/sec={batch_size / dt:.2f} "
+                 f"(avg {self.avg_samples_per_sec:.2f})"]
+        if tokens:
+            parts.append(f"tokens/sec={tokens / dt:.1f} "
+                         f"(avg {self.avg_tokens_per_sec:.1f})")
+        parts.append(f"step_time_ms={dt * 1e3:.1f}")
+        log_dist("throughput: " + " ".join(parts), ranks=[0])
 
     @property
     def avg_samples_per_sec(self) -> float:
