@@ -349,6 +349,41 @@ def run_bench():
     dt = _measure(engine, batch, iters=10)
     m = engine.train_batch(batch)          # final metrics for the report
 
+    # numerics-watch leg: the flagship engine runs health OFF (the health
+    # monitor's one per-step scalar fetch would serialize the timed dispatch
+    # chain, same reason trace is off) — so drive a short health-ENABLED leg
+    # on a small engine afterwards.  Its AnomalyDetector/FlightRecorder
+    # counters land in the shared default registry, so the snapshot exported
+    # below (and the numerics_anomalies/postmortem_dumps columns) reflect a
+    # leg where the tripwire can actually fire.
+    try:
+        h_cfg = GPTConfig(num_layers=2, num_heads=4, head_dim=16,
+                          hidden_size=64, vocab_size=512, max_seq_len=64,
+                          dropout=0.0, loss_chunk=64)
+        h_config = {
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"dp": -1},
+            "steps_per_print": 0,
+            "telemetry": {**config["telemetry"],
+                          "health": {"enabled": True,
+                                     "recorder_steps": 16}},
+        }
+        h_batch = {"input_ids": rng.integers(
+            0, h_cfg.vocab_size, size=(8, 64)).astype(np.int32)}
+        h_engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPTChunkedLoss(h_cfg), config=h_config,
+            example_batch={"input_ids": np.zeros((8, 64), np.int32)})
+        for _ in range(8):
+            hm = h_engine.train_batch(h_batch)
+        jax.device_get(hm.loss)
+        del h_engine
+    except Exception as e:  # noqa: BLE001 — the watch leg must not kill bench
+        extra_health_err = str(e)[:120]
+    else:
+        extra_health_err = None
+
     tokens_per_sec = BATCH * SEQ / dt
     flops = train_flops_per_step(engine.num_parameters, cfg_model.num_layers,
                                  cfg_model.hidden_size, BATCH, SEQ)
@@ -375,6 +410,18 @@ def run_bench():
         extra["jit_cache_misses"] = int(sum(
             s["value"] for s in snap.get("counters", {}).get(
                 "jit_cache_misses_total", {}).get("samples", [])))
+        # numerics watch columns, fed by the short health-enabled leg above
+        # (shared default registry): anomaly detections and postmortem dumps
+        # must be zero on a healthy bench run — a nonzero value here flags a
+        # numerics regression even when throughput holds
+        if extra_health_err is not None:
+            extra["numerics_watch_error"] = extra_health_err
+        extra["numerics_anomalies"] = int(sum(
+            s["value"] for s in snap.get("counters", {}).get(
+                "numerics_anomalies_total", {}).get("samples", [])))
+        extra["postmortem_dumps"] = int(sum(
+            s["value"] for s in snap.get("counters", {}).get(
+                "postmortem_dumps_total", {}).get("samples", [])))
         extra["telemetry_snapshot"] = snap_path
     except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
         extra["telemetry_error"] = str(e)[:120]

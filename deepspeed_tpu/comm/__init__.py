@@ -26,6 +26,7 @@ from deepspeed_tpu.comm.collectives import (
     permute,
     reduce_scatter,
 )
+from deepspeed_tpu.comm.aggregation import aggregate_health_scalars
 from deepspeed_tpu.comm.comm import (
     comms_logger,
     get_comms_logger,
@@ -51,4 +52,5 @@ __all__ = [
     "profile_jitted",
     "hlo_collective_bytes",
     "get_comms_logger",
+    "aggregate_health_scalars",
 ]

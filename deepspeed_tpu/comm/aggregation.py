@@ -1,0 +1,61 @@
+"""Cross-host aggregation of host-side scalars (health stats fan-in).
+
+The flight recorder keeps per-process records; for triage the process-0
+record should carry the FLEET view — min/max/mean per health scalar and the
+index of the process that tripped the trigger (the argmax process, with NaN
+ranked above every finite value: a NaN IS the anomaly being hunted).
+
+Single-process runs degrade to a no-op (the local value is the fleet);
+multi-process runs ride ``jax.experimental.multihost_utils
+.process_allgather``, one small fixed-width vector per call.  Every process
+must call this collectively — the engine does so from its per-step
+reporting path, which runs on all processes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def aggregate_health_scalars(
+        values: Dict[str, float]) -> Dict[str, Dict[str, float]]:
+    """All-gather ``values`` across processes; returns per-key
+    ``{min, max, mean, argmax_process}`` (stats over finite entries; the
+    argmax ranks NaN first, then +Inf, then finite magnitude)."""
+    import jax
+
+    keys = sorted(values)
+    if not keys:
+        return {}
+    vec = np.asarray([float(values[k]) for k in keys], np.float64)
+    if jax.process_count() <= 1:
+        rows = vec[None, :]
+    else:
+        from jax.experimental import multihost_utils
+        rows = np.asarray(multihost_utils.process_allgather(vec))
+    out: Dict[str, Dict[str, float]] = {}
+    for i, key in enumerate(keys):
+        col = rows[:, i]
+        finite = col[np.isfinite(col)]
+        out[key] = {
+            "min": float(finite.min()) if finite.size else float("nan"),
+            "max": float(finite.max()) if finite.size else float("nan"),
+            "mean": float(finite.mean()) if finite.size else float("nan"),
+            "argmax_process": _tripping_process(col),
+        }
+    return out
+
+
+def _tripping_process(col: np.ndarray) -> int:
+    """Index of the process whose value most likely tripped a trigger:
+    NaN outranks Inf outranks finite magnitude (a NaN IS the anomaly being
+    hunted); ties break to the lowest index."""
+    def rank(v: float):
+        if np.isnan(v):
+            return (2, 0.0)
+        if np.isinf(v):
+            return (1, 0.0)
+        return (0, abs(float(v)))
+    return int(max(range(len(col)), key=lambda j: rank(col[j])))

@@ -242,6 +242,53 @@ class CometConfig(DeepSpeedConfigModel):
     api_key: Optional[str] = None
 
 
+class TelemetryHealthConfig(DeepSpeedConfigModel):
+    """Numerics health monitor + postmortem flight recorder
+    (telemetry/health.py, telemetry/flight_recorder.py).
+
+    The reference engine treats numerics as a runtime signal (overflow
+    detection, ``skipped_steps``, grad-norm monitor fan-out); this block adds
+    the in-graph layer: per-module-group grad/param norms, NaN/Inf element
+    counts and update-to-param ratios computed INSIDE the jitted train step
+    (one extra small output — no recompile, no per-scalar syncs), a host-side
+    ring buffer of the last ``recorder_steps`` structured step records, and
+    anomaly rules.  On a non-finite loss, an overflow streak, an uncaught
+    exception, or an explicit ``engine.dump_postmortem()`` the recorder dumps
+    a timestamped postmortem bundle (records JSONL + Chrome trace +
+    Prometheus snapshot + resolved config + env report) that
+    ``python -m deepspeed_tpu.telemetry.postmortem <dir>`` summarizes.
+
+    Enabling this forces one device→host fetch of the step scalars per step
+    (the recorder needs every record) — the same cost class as
+    ``trace_enabled``.
+    """
+
+    enabled: bool = False
+    # module-path depth for health groups: params are grouped by the first N
+    # path segments (the flax collection key "params" is skipped), so depth 2
+    # buckets a GPT tree into backbone/wte, backbone/block_i, ...
+    group_depth: int = 2
+    # ring buffer capacity (structured step records kept for the postmortem)
+    recorder_steps: int = 64
+    # dump trigger: k consecutive overflow-skipped steps (0 disables)
+    overflow_streak: int = 3
+    # install a sys.excepthook that dumps the buffer on an uncaught exception
+    crash_dump: bool = True
+    # multi-host: gather the fleet min/mean/max view every N steps (plus
+    # always on a dump trigger or anomaly).  The gather is a blocking
+    # cross-host collective — per-step (1) would serialize every host's
+    # bookkeeping path on the slowest process.  0 disables the cadence
+    # (trigger-only).
+    fleet_interval: int = 16
+    # bundle directory; default <output_path>/<job_name>/postmortem
+    dump_path: Optional[str] = None
+    # ---- anomaly rules (one-shot warnings + labeled counter) ----
+    anomaly_window: int = 32            # rolling history length
+    loss_spike_zscore: float = 6.0      # z vs rolling loss mean/std
+    grad_norm_factor: float = 10.0      # explosion = norm > factor x mean
+    scale_collapse_factor: float = 16.0  # collapse = scale fell x16 in window
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """Unified step telemetry (deepspeed_tpu/telemetry/): host-phase trace
     spans, recompile watchdog, collective/memory counter registries, and the
@@ -276,6 +323,11 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # fan the scalar subset through MonitorMaster (TensorBoard/CSV/W&B)
     monitor_fanout: bool = True
     max_trace_events: int = 200_000
+    # numerics health monitor + flight recorder (active independently of the
+    # parent ``enabled`` switch — a postmortem is wanted exactly when nothing
+    # else is being watched)
+    health: TelemetryHealthConfig = Field(
+        default_factory=TelemetryHealthConfig)
 
 
 class FlopsProfilerConfig(DeepSpeedConfigModel):

@@ -64,6 +64,16 @@ class StepMetrics(NamedTuple):
     skipped_steps: jnp.ndarray
 
 
+# grad_norm reported for an overflow-skipped step: a FINITE sentinel instead
+# of the raw NaN/Inf, on both the device and the offload path — downstream
+# consumers (monitors, schedulers keying on get_global_grad_norm) must never
+# see a non-finite norm for a step whose update was skipped; the per-group
+# attribution of the overflow lives in the health stats.  Matches the
+# reference's overflow contract (skipped_steps counts it, the norm stays
+# usable).
+OVERFLOW_GNORM = -1.0
+
+
 def _cast_params(params, dtype):
     return jax.tree_util.tree_map(
         lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
@@ -471,6 +481,12 @@ class DeepSpeedTPUEngine:
                            "is 1 — there is no weight all-gather to quantize; "
                            "flag is inert on this mesh")
 
+        # numerics health monitor (telemetry.health): per-group stats are
+        # traced INTO the step programs, so the flags must exist before
+        # _build_step_functions
+        self._health_enabled = bool(config.telemetry.health.enabled)
+        self._health_depth = int(config.telemetry.health.group_depth)
+
         # ---- build + jit the step functions ----
         self._jit_init = jax.jit(
             self._make_init(), out_shardings=self._as_shardings_tuple())
@@ -500,6 +516,14 @@ class DeepSpeedTPUEngine:
         self._micro_steps = 0
         self.global_steps = 0
         self._last_metrics: Optional[StepMetrics] = None
+        # host mirror of the latest StepMetrics (+ health stats), filled by
+        # the ONE sanctioned device fetch in _fetch_metrics —
+        # get_global_grad_norm()/skipped_steps read this instead of syncing
+        # per scalar
+        self._last_metrics_host: Optional[StepMetrics] = None
+        self._last_health = None          # device pytree (or host dict)
+        self._last_health_host: dict = {}
+        self._host_metrics_step = -1
         self._step_times = []
 
         # ---- observability (reference: MonitorMaster engine.py:1000,
@@ -666,19 +690,29 @@ class DeepSpeedTPUEngine:
             self._train_batch_fn = self._grads_batch_fn  # flops profiler trace
             self._jit_grads_batch = jax.jit(
                 self._grads_batch_fn,
-                out_shardings=(self.grad_shardings, None, None))
+                out_shardings=(self.grad_shardings, None, None, None))
             self._jit_train_batch = None
             self._jit_apply = None
             self._jit_gnorm = jax.jit(optax.global_norm)
+            # trio (forward/backward/step) offload path: the accumulated
+            # grads never pass through _jit_grads_batch, so health stats
+            # need their own jitted program
+            self._jit_health = None
+            if self._health_enabled:
+                from deepspeed_tpu.telemetry.health import (
+                    compute_group_health)
+                self._jit_health = jax.jit(
+                    lambda params, grads: compute_group_health(
+                        params, grads, depth=self._health_depth))
         else:
             self._train_batch_fn = self._make_train_batch()
             self._jit_train_batch = jax.jit(
                 self._train_batch_fn,
                 donate_argnums=(0,),
-                out_shardings=(self._as_shardings_tuple(), None))
+                out_shardings=(self._as_shardings_tuple(), None, None))
             self._jit_apply = jax.jit(
                 self._make_apply_fn(), donate_argnums=(0,),
-                out_shardings=(self._as_shardings_tuple(), None))
+                out_shardings=(self._as_shardings_tuple(), None, None))
 
     def configure_moq(self, sample_batch, layer_paths=None, *,
                       multiplier: int = 4, max_iter: int = 20,
@@ -884,10 +918,15 @@ class DeepSpeedTPUEngine:
         denom = scale * n_micro
         return jax.tree_util.tree_map(lambda g: g / denom, grads)
 
-    def _apply_update(self, state: TrainState, grads) -> Tuple[TrainState, StepMetrics]:
+    def _apply_update(self, state: TrainState, grads
+                      ) -> Tuple[TrainState, StepMetrics, dict]:
         finite = grads_finite(grads)
         new_ls = update_loss_scale(state.loss_scale, finite, self.config.fp16)
-        grad_norm = optax.global_norm(grads)
+        # overflow steps surface the finite OVERFLOW_GNORM sentinel, not the
+        # raw NaN/Inf norm; skipped_steps records the overflow and the health
+        # stats (below) carry the per-group attribution
+        grad_norm = jnp.where(finite, optax.global_norm(grads),
+                              jnp.float32(OVERFLOW_GNORM))
 
         def do_step(operand):
             params, opt_state, grads = operand
@@ -916,7 +955,15 @@ class DeepSpeedTPUEngine:
             loss_scale=new_ls.scale,
             skipped_steps=new_ls.skipped,
         )
-        return new_state, metrics
+        # per-module-group numerics stats ride the step program as one extra
+        # (tiny) output — same trace, no extra compile; {} when disabled
+        health = {}
+        if self._health_enabled:
+            from deepspeed_tpu.telemetry.health import compute_group_health
+            health = compute_group_health(state.params, grads,
+                                          new_params=new_params,
+                                          depth=self._health_depth)
+        return new_state, metrics, health
 
     def _accumulate_grads(self, state: TrainState, batch):
         """Scan over gas microbatches accumulating fp32 grads — the ONE
@@ -951,80 +998,110 @@ class DeepSpeedTPUEngine:
             def train_batch_pipe(state: TrainState, batch):
                 grads, loss = self._grads_one_micro(state, batch, 0)
                 grads = self._unscale(grads, state.loss_scale.scale, 1)
-                new_state, metrics = self._apply_update(state, grads)
+                new_state, metrics, health = self._apply_update(state, grads)
                 return new_state, metrics._replace(
-                    loss=loss.astype(jnp.float32))
+                    loss=loss.astype(jnp.float32)), health
             return train_batch_pipe
 
         def train_batch(state: TrainState, batch):
             # batch leaves: [gas, micro_global, ...]
             acc, losses = self._accumulate_grads(state, batch)
             grads = self._unscale(acc, state.loss_scale.scale, self.gas)
-            new_state, metrics = self._apply_update(state, grads)
+            new_state, metrics, health = self._apply_update(state, grads)
             metrics = metrics._replace(loss=jnp.mean(losses).astype(jnp.float32))
-            return new_state, metrics
+            return new_state, metrics, health
         return train_batch
 
     def _make_grads_batch(self):
         """Offload-mode device program: accumulated scaled fp32 grads + mean
-        loss + grad norm (of the scaled sum).  No optimizer state touched —
-        that's the host's job (runtime/offload.py)."""
+        loss + grad norm (of the scaled sum) + health stats.  No optimizer
+        state touched — that's the host's job (runtime/offload.py)."""
+        def health_of(state, grads):
+            # grads here are still loss-scaled sums; the host step rescales
+            # the norms (NaN/Inf counts are scale-invariant).  No
+            # update_ratio on this path — the update happens host-side.
+            if not self._health_enabled:
+                return {}
+            from deepspeed_tpu.telemetry.health import compute_group_health
+            return compute_group_health(state.params, grads,
+                                        depth=self._health_depth)
+
         if self.gas_in_model:
             def grads_pipe(state: TrainState, batch):
                 grads, loss = self._grads_one_micro(state, batch, 0)
-                return grads, loss.astype(jnp.float32), optax.global_norm(grads)
+                return (grads, loss.astype(jnp.float32),
+                        optax.global_norm(grads), health_of(state, grads))
             return grads_pipe
 
         def grads_batch(state: TrainState, batch):
             acc, losses = self._accumulate_grads(state, batch)
             return (acc, jnp.mean(losses).astype(jnp.float32),
-                    optax.global_norm(acc))
+                    optax.global_norm(acc), health_of(state, acc))
         return grads_batch
 
     def _train_batch_offload(self, batch):
-        grads, loss, gnorm = self._jit_grads_batch(self.state, batch)
+        grads, loss, gnorm, health = self._jit_grads_batch(self.state, batch)
         n_micro = 1 if self.gas_in_model else self.gas
-        return self._host_step(grads, loss, gnorm, n_micro)
+        return self._host_step(grads, loss, gnorm, n_micro, health_dev=health)
 
-    def _host_step(self, grads_dev, loss_dev, gnorm_dev, n_micro
-                   ) -> StepMetrics:
+    def _host_step(self, grads_dev, loss_dev, gnorm_dev, n_micro,
+                   health_dev=None) -> StepMetrics:
         """The offloaded optimizer step: fetch grads, host Adam on the fp32
         masters (cpu/nvme tier), stream compute-dtype params back.  Loss-scale
         bookkeeping runs in plain Python (reference: _take_model_step +
         DeepSpeedCPUAdam.step on the offload path)."""
         from deepspeed_tpu.runtime.precision import update_loss_scale_host
-        gnorm_scaled = float(jax.device_get(gnorm_dev))
         state = self.state
-        scale = float(state.loss_scale.scale)
+        # one host fetch for every scalar this step reads (gnorm, loss, the
+        # loss-scale state machine, the schedule clock, health stats) — the
+        # per-scalar float(...) pattern cost a device round trip each
+        gnorm_scaled, loss_host, ls_host, step_host, health_host = \
+            jax.device_get((gnorm_dev, loss_dev, state.loss_scale,
+                            state.step, health_dev))
+        gnorm_scaled = float(gnorm_scaled)  # sync-ok: host value from the fetch above
+        scale = float(ls_host.scale)        # sync-ok: host value from the fetch above
         denom = scale * n_micro
         finite = bool(np.isfinite(gnorm_scaled))
-        raw_norm = gnorm_scaled / denom
+        # overflow: finite sentinel + skipped_steps, matching the device
+        # path's _apply_update contract (was: raw NaN/Inf leaked into the
+        # reported norm)
+        raw_norm = gnorm_scaled / denom if finite else OVERFLOW_GNORM
         if finite:
             grads_np = jax.device_get(grads_dev)
-            clip = float(self.config.gradient_clipping or 0.0)
+            clip = float(self.config.gradient_clipping or 0.0)  # sync-ok: config scalar
             coef = 1.0
             if clip > 0.0 and raw_norm > clip:
                 coef = clip / (raw_norm + 1e-6)
             # optax schedules see the update count (0-based), matching the
             # device path's optax scheduling
-            lr = (float(self.lr_schedule(self.offload_opt.step_count))
+            lr = (float(self.lr_schedule(self.offload_opt.step_count))  # sync-ok: host schedule math
                   if self.lr_schedule is not None
-                  else float(self._opt_params.get("lr", 1e-3)))
+                  else float(self._opt_params.get("lr", 1e-3)))  # sync-ok: config scalar
             new_params_np = self.offload_opt.update(
                 grads_np, lr=lr, grad_scale=coef / denom)
             with self.mesh:
                 new_params = jax.device_put(new_params_np,
                                             self.param_shardings)
-            new_step = jnp.int32(int(state.step) + 1)
+            new_step = jnp.int32(int(step_host) + 1)
         else:
             new_params, new_step = state.params, state.step
-        new_ls = update_loss_scale_host(state.loss_scale, finite,
-                                        self.config.fp16)
+        new_ls = update_loss_scale_host(ls_host, finite, self.config.fp16)
         self.state = TrainState(step=new_step, params=new_params,
                                 opt_state=(), loss_scale=new_ls,
                                 rng=state.rng)
+        if health_host:
+            # device program measured the loss-scaled grad sums — rescale
+            # the norms to match the reported raw_norm (counts and param
+            # norms are scale-free)
+            from deepspeed_tpu.telemetry.health import to_python
+            health_host = to_python(health_host)
+            for stats in health_host.values():
+                gn = stats.get("grad_norm")
+                if gn is not None and np.isfinite(gn):
+                    stats["grad_norm"] = gn / denom
+        self._last_health = health_host or {}
         return StepMetrics(
-            loss=jnp.float32(float(jax.device_get(loss_dev))),
+            loss=jnp.float32(float(loss_host)),  # sync-ok: host value from the fetch above
             grad_norm=jnp.float32(raw_norm),
             loss_scale=new_ls.scale,
             skipped_steps=new_ls.skipped)
@@ -1038,8 +1115,7 @@ class DeepSpeedTPUEngine:
     def _make_apply_fn(self):
         def apply_fn(state: TrainState, grads, n_micro):
             grads = self._unscale(grads, state.loss_scale.scale, n_micro)
-            new_state, metrics = self._apply_update(state, grads)
-            return new_state, metrics
+            return self._apply_update(state, grads)
         return apply_fn
 
     # ------------------------------------------------------------------ data
@@ -1179,10 +1255,12 @@ class DeepSpeedTPUEngine:
                     lower=lambda: jfn.lower(self.state, batch))
             with tel.span("dispatch", step=step_id):
                 if self.offloading:
+                    # sets _last_health (host dict) itself
                     metrics = self._train_batch_offload(batch)
                 else:
-                    self.state, metrics = self._jit_train_batch(self.state,
-                                                                batch)
+                    self.state, metrics, health = self._jit_train_batch(
+                        self.state, batch)
+                    self._last_health = health
         with tel.span("device_complete", step=step_id):
             if (tel.tracer.enabled or self.wall_clock_breakdown
                     or profile_pending):
@@ -1269,17 +1347,21 @@ class DeepSpeedTPUEngine:
         if not self.is_gradient_accumulation_boundary():
             return None
         assert self._accum_grads is not None, "call forward() before step()"
-        mean_loss = jnp.float32(np.mean([float(l)
-                                         for l in self._micro_losses]))
+        # one fetch for all micro losses (was a float() sync per microbatch)
+        mean_loss = jnp.float32(np.mean(jax.device_get(self._micro_losses)))
         if self.offloading:
             with self.mesh:
                 gnorm = self._jit_gnorm(self._accum_grads)
+                health = (self._jit_health(self.state.params,
+                                           self._accum_grads)
+                          if self._jit_health is not None else None)
             metrics = self._host_step(self._accum_grads, mean_loss, gnorm,
-                                      self.gas)
+                                      self.gas, health_dev=health)
         else:
             with self.mesh:
-                self.state, metrics = self._jit_apply(
+                self.state, metrics, health = self._jit_apply(
                     self.state, self._accum_grads, jnp.float32(self.gas))
+            self._last_health = health
             metrics = metrics._replace(loss=mean_loss)
         self._accum_grads = None
         self._micro_losses = []
@@ -1316,57 +1398,113 @@ class DeepSpeedTPUEngine:
         return self.config.train_batch_size
 
     def get_lr(self):
-        step = int(self.state.step)
-        if self.lr_schedule is not None:
-            return [float(self.lr_schedule(step))]
-        return [float(self._opt_params.get("lr", 0.0))]
+        if self.lr_schedule is None:
+            return [float(self._opt_params.get("lr", 0.0))]
+        host = self._last_metrics_host
+        if host is not None and self._host_metrics_step == self.global_steps:
+            # state.step mirror without a device sync: overflow-skipped
+            # steps do not advance the schedule clock
+            step = self.global_steps - host.skipped_steps
+        else:
+            step = int(self.state.step)  # sync-ok: cold path, no cached copy
+        return [float(self.lr_schedule(step))]
 
-    def get_global_grad_norm(self):
+    def _fetch_metrics(self, metrics: StepMetrics,
+                       health=None) -> StepMetrics:
+        """THE sanctioned device→host fetch point for step scalars: ONE
+        ``jax.device_get`` moves the whole StepMetrics (+ the small health
+        pytree) and the host copy is cached for every later reader —
+        ``get_global_grad_norm()``, ``skipped_steps``, prints, monitors,
+        the flight recorder.  scripts/check_no_sync.py enforces that the
+        step path performs host syncs only here (or via an explicit
+        ``device_get`` / ``# sync-ok`` disclosure)."""
+        from deepspeed_tpu.telemetry.health import to_python
+        vals, health_host = jax.device_get((tuple(metrics), health))
+        host = StepMetrics(loss=float(vals[0]), grad_norm=float(vals[1]),
+                           loss_scale=float(vals[2]),
+                           skipped_steps=int(vals[3]))
+        self._last_metrics_host = host
+        self._last_health_host = to_python(health_host)
+        self._host_metrics_step = self.global_steps
+        return host
+
+    def _reset_host_metrics_cache(self) -> None:
+        """Drop the cached host metrics — checkpoint loads rewind
+        global_steps, which could otherwise alias a stale cache entry."""
+        self._last_metrics = None
+        self._last_metrics_host = None
+        self._last_health = None
+        self._last_health_host = {}
+        self._host_metrics_step = -1
+        self.telemetry.reset_numerics_baseline()
+
+    def _host_metrics(self) -> Optional[StepMetrics]:
+        """Cached host StepMetrics for the latest step (fetching once if a
+        reader arrives before the reporting path did)."""
         if self._last_metrics is None:
             return None
-        return float(self._last_metrics.grad_norm)
+        if (self._last_metrics_host is None
+                or self._host_metrics_step != self.global_steps):
+            self._fetch_metrics(self._last_metrics, self._last_health)
+        return self._last_metrics_host
+
+    def get_global_grad_norm(self):
+        host = self._host_metrics()
+        return None if host is None else host.grad_norm
 
     @property
     def skipped_steps(self):
-        if self._last_metrics is None:
-            return 0
-        return int(self._last_metrics.skipped_steps)
+        host = self._host_metrics()
+        return 0 if host is None else host.skipped_steps
 
-    def _maybe_print(self, metrics: StepMetrics):
+    def dump_postmortem(self, note: Optional[str] = None):
+        """Explicitly dump the flight-recorder buffer as a postmortem bundle
+        (requires ``telemetry.health.enabled``); returns the bundle dir."""
+        return self.telemetry.dump_postmortem(note=note)
+
+    def _maybe_print(self, host: StepMetrics):
         spp = self.config.steps_per_print
         if spp and self.global_steps % spp == 0:
             log_dist(
-                f"step={self.global_steps} loss={float(metrics.loss):.4f} "
+                f"step={self.global_steps} loss={host.loss:.4f} "
                 f"lr={self.get_lr()[0]:.3e} "
-                f"grad_norm={float(metrics.grad_norm):.3f} "
-                f"loss_scale={float(metrics.loss_scale):.0f}", ranks=[0])
+                f"grad_norm={host.grad_norm:.3f} "
+                f"loss_scale={host.loss_scale:.0f}", ranks=[0])
 
     def _post_step_reporting(self, metrics: StepMetrics):
-        """Console print + monitor fan-out + timer log + flops profile, at
-        their configured cadences (reference engine.py:2264 _write_monitor,
-        :1797 flops profiler hook, :145 EngineTimers)."""
+        """Console print + monitor fan-out + flight recorder + timer log +
+        flops profile, at their configured cadences (reference
+        engine.py:2264 _write_monitor, :1797 flops profiler hook, :145
+        EngineTimers).  All host reads go through the single
+        ``_fetch_metrics`` fetch; steps where nothing reports skip the
+        device sync entirely."""
         if self.pld is not None:
             # keep the host mirror in sync with the in-graph schedule so
             # get_theta()/get_state() report the effective value; the theta
             # applied THIS step was computed from the pre-increment state.step
             self.pld.update_state(self.global_steps - 1)
-        self._maybe_print(metrics)
         spp = self.config.steps_per_print
         at_cadence = spp and self.global_steps % spp == 0
         # monitors write even when console printing is off (steps_per_print=0
         # means every step, matching the reference's monitor-independent
         # cadence; costs one device sync per write)
         monitor_cadence = at_cadence or (not spp and self.monitor.enabled)
-        if self.monitor.enabled and monitor_cadence:
+        need_host = bool(at_cadence or (self.monitor.enabled
+                                        and monitor_cadence)
+                         or self._health_enabled)
+        host = (self._fetch_metrics(metrics, self._last_health)
+                if need_host else None)
+        if host is not None and at_cadence:
+            self._maybe_print(host)
+        samples = self.global_steps * int(self.config.train_batch_size)
+        if self.monitor.enabled and monitor_cadence and host is not None:
             # x-axis is samples seen, matching the reference's
             # Train/Samples/* convention (engine.py:2272)
-            samples = self.global_steps * int(self.config.train_batch_size)
             events = [
-                ("Train/Samples/train_loss", float(metrics.loss), samples),
+                ("Train/Samples/train_loss", host.loss, samples),
                 ("Train/Samples/lr", self.get_lr()[0], samples),
-                ("Train/Samples/grad_norm", float(metrics.grad_norm), samples),
-                ("Train/Samples/loss_scale", float(metrics.loss_scale),
-                 samples),
+                ("Train/Samples/grad_norm", host.grad_norm, samples),
+                ("Train/Samples/loss_scale", host.loss_scale, samples),
             ]
             if self.tput_timer.avg_samples_per_sec:
                 events.append(("Train/Samples/throughput_samples_per_sec",
@@ -1375,6 +1513,12 @@ class DeepSpeedTPUEngine:
                 events.append(("Train/Samples/throughput_tokens_per_sec",
                                self.tput_timer.avg_tokens_per_sec, samples))
             self.monitor.write_events(events)
+        if self._health_enabled and host is not None:
+            # anomaly rules + ring buffer + dump triggers (nonfinite loss,
+            # overflow streak) — telemetry/health.py, flight_recorder.py
+            self.telemetry.health_step(
+                self.global_steps, host, self._last_health_host,
+                lr=self.get_lr()[0], samples=samples)
         if self.wall_clock_breakdown and at_cadence:
             self.timers.log([DATA_TIMER, TRAIN_BATCH_TIMER], normalizer=spp)
         fp = self.config.flops_profiler
@@ -1542,6 +1686,7 @@ class DeepSpeedTPUEngine:
         self.state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), new, self.state_shardings)
         self.global_steps = step
+        self._reset_host_metrics_cache()
         if self.offloading:
             sd = offload_state_dict_from_fragments(host.params, frags, step)
             if len(sd) > 1:
@@ -1561,6 +1706,7 @@ class DeepSpeedTPUEngine:
             self.state, client_state = restore_train_state(
                 load_dir, tag, self.state_shardings, self.state)
         self.global_steps = int(client_state.get("global_steps", 0))
+        self._reset_host_metrics_cache()
         if self.offloading:
             import os
             p = os.path.join(load_dir, tag, "offload_state.npz")

@@ -49,7 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu import comm
 from deepspeed_tpu.config import DeepSpeedTPUConfig, parse_config
-from deepspeed_tpu.engine import StepMetrics
+from deepspeed_tpu.engine import OVERFLOW_GNORM, StepMetrics
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.parallel import partition
 from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
@@ -494,7 +494,11 @@ class InfinityEngine:
         # host Adam over the full logical tree
         self.offload_opt.initialize(self._assemble_host_tree())
 
-        # bookkeeping / observability
+        # bookkeeping / observability — the streamed path feeds the same
+        # flight recorder as the in-HBM engine (telemetry.health block)
+        from deepspeed_tpu.telemetry import StepTelemetry
+        self.telemetry = StepTelemetry(config)
+        self._health_enabled = self.telemetry.health_enabled
         self.global_steps = 0
         self.loss_scale_state = init_loss_scale(config.fp16)
         self._last_metrics: Optional[StepMetrics] = None
@@ -720,15 +724,40 @@ class InfinityEngine:
                                   + _tree_nbytes(self.head_host))
 
         # ---- host optimizer step (fp32 masters; reference CPU Adam flow) ----
+        # per-segment grad stats ride the same squared-sum pass the overflow
+        # check already makes; NaN/Inf element counts are only computed for
+        # segments that actually went non-finite (the common path stays one
+        # reduction per leaf)
+        denom = scale * self.gas
+        seg_groups = ([("embed", accum["embed"]), ("head", accum["head"])]
+                      + [(f"layer_{i}", lp)
+                         for i, lp in enumerate(accum["layers"])])
         sq = 0.0
         finite = True
-        for leaf in jax.tree_util.tree_leaves(accum):
-            s = float(np.sum(np.square(leaf, dtype=np.float64)))
-            sq += s
-            if not np.isfinite(s):
+        health = {}
+        for name, tree in seg_groups:
+            gsq = 0.0
+            nan_c = inf_c = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                s = float(np.sum(np.square(leaf, dtype=np.float64)))
+                gsq += s
+                if not np.isfinite(s):
+                    nan_c += int(np.isnan(leaf).sum())
+                    inf_c += int(np.isinf(leaf).sum())
+            sq += gsq
+            if not np.isfinite(gsq):
                 finite = False
-        denom = scale * self.gas
-        raw_norm = float(np.sqrt(sq)) / denom if finite else float("inf")
+            if self._health_enabled:
+                health[name] = {
+                    "grad_norm": (float(np.sqrt(gsq)) / denom
+                                  if np.isfinite(gsq) else float(gsq)),
+                    "grad_nan": nan_c,
+                    "grad_inf": inf_c,
+                }
+        # overflow: finite sentinel + skipped_steps (engine._apply_update
+        # contract); the per-segment health stats keep the raw attribution
+        raw_norm = (float(np.sqrt(sq)) / denom if finite
+                    else OVERFLOW_GNORM)
         if finite:
             clip = float(cfg.gradient_clipping or 0.0)
             coef = 1.0
@@ -748,16 +777,27 @@ class InfinityEngine:
         self.loss_scale_state = update_loss_scale_host(
             self.loss_scale_state, finite, cfg.fp16)
         self.global_steps += 1
+        loss_mean = float(np.mean(losses))
         metrics = StepMetrics(
-            loss=jnp.float32(np.mean(losses)),
+            loss=jnp.float32(loss_mean),
             grad_norm=jnp.float32(raw_norm),
             loss_scale=self.loss_scale_state.scale,
             skipped_steps=self.loss_scale_state.skipped)
         self._last_metrics = metrics
+        if self._health_enabled:
+            # all values already host-side on this path — no device fetch
+            host = StepMetrics(
+                loss=loss_mean, grad_norm=float(raw_norm),
+                loss_scale=float(jax.device_get(
+                    self.loss_scale_state.scale)),
+                skipped_steps=int(jax.device_get(
+                    self.loss_scale_state.skipped)))
+            self.telemetry.health_step(self.global_steps, host, health,
+                                       lr=self.get_lr()[0])
         spp = cfg.steps_per_print
         if spp and self.global_steps % spp == 0:
             log_dist(f"step={self.global_steps} "
-                     f"loss={float(metrics.loss):.4f} "
+                     f"loss={loss_mean:.4f} "
                      f"grad_norm={raw_norm:.3f}", ranks=[0])
         return metrics
 
@@ -793,6 +833,10 @@ class InfinityEngine:
     def get_global_grad_norm(self):
         return (float(self._last_metrics.grad_norm)
                 if self._last_metrics else None)
+
+    def dump_postmortem(self, note: Optional[str] = None):
+        """Explicit flight-recorder dump (engine.dump_postmortem parity)."""
+        return self.telemetry.dump_postmortem(note=note)
 
     @property
     def train_batch_size(self):

@@ -11,12 +11,23 @@ them per step and adds the TPU-specific hazards nothing else watches:
                        memory gauges, cache misses)
 - ``exporter``       — snapshot serialization: JSON, Prometheus text
                        exposition, MonitorMaster fan-out
+- ``health``         — in-graph per-module-group numerics stats (grad/param
+                       norms, NaN/Inf counts, update ratios) + anomaly rules
+- ``flight_recorder``— host ring buffer of step records with postmortem
+                       bundle dumps on NaN / overflow streak / crash
+- ``postmortem``     — bundle summarizer CLI
+                       (``python -m deepspeed_tpu.telemetry.postmortem``)
 - ``step_telemetry`` — the engine-facing facade driving all of the above
 
 See docs/observability.md for the config block and workflows.
 """
 
 from deepspeed_tpu.telemetry.exporter import SnapshotExporter
+from deepspeed_tpu.telemetry.flight_recorder import (FlightRecorder,
+                                                     install_crash_handler)
+from deepspeed_tpu.telemetry.health import (AnomalyDetector,
+                                            compute_group_health,
+                                            flatten_health, group_names)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, MetricRegistry,
                                               default_registry,
                                               record_collective)
@@ -25,7 +36,9 @@ from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
 from deepspeed_tpu.telemetry.watchdog import RecompileWatchdog, signature_of
 
 __all__ = [
+    "AnomalyDetector",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "MetricRegistry",
     "RecompileWatchdog",
@@ -33,7 +46,11 @@ __all__ = [
     "SpanTracer",
     "StepTelemetry",
     "TraceEmitter",
+    "compute_group_health",
     "default_registry",
+    "flatten_health",
+    "group_names",
+    "install_crash_handler",
     "record_collective",
     "signature_of",
 ]

@@ -1,0 +1,193 @@
+"""Postmortem flight recorder — a ring buffer of step records + crash dumps.
+
+A NaN at step 40k used to leave no record of which layer, which host, or
+what the preceding steps looked like: the process died (or the loss curve
+flat-lined) and the evidence died with it.  The recorder keeps the last N
+structured step records (metrics, per-group health stats, loss-scale state,
+span durations) on the host and, when something trips — a non-finite loss,
+an overflow streak, an uncaught exception, or an explicit
+``engine.dump_postmortem()`` — writes a timestamped bundle:
+
+    <dump_dir>/<YYYYmmdd-HHMMSS>-step<N>-<reason>/
+        records.jsonl    # the ring buffer, oldest record first
+        meta.json        # reason, trigger step, span summary, fleet info
+        config.json      # the resolved engine config
+        snapshot.prom    # Prometheus text exposition of every registry
+        trace.json       # Chrome-trace spans (when the tracer is on)
+        env.txt          # environment report (ds_report analog)
+
+``python -m deepspeed_tpu.telemetry.postmortem <dir>`` summarizes a bundle.
+
+Dump-once semantics: each automatic trigger reason fires at most once per
+recorder (a NaN loss persists for every remaining step — one bundle is
+evidence, five hundred are a disk-filler); explicit dumps always write.
+Bundle writers are registered callbacks so the recorder never imports the
+exporter/config machinery itself, and a writer failure degrades to a
+warning — the postmortem path must never be the thing that kills training.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DUMPS = "postmortem_dumps_total"
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 64,
+                 dump_dir: str = "./telemetry/postmortem",
+                 write_files: bool = True, registry=None):
+        self.capacity = int(capacity)
+        self.records: deque = deque(maxlen=self.capacity)
+        self.dump_dir = dump_dir
+        # multi-host: only process 0 writes bundles (same contract as the
+        # snapshot exporter); every process still keeps its buffer
+        self.write_files = bool(write_files)
+        self.registry = registry
+        self.dumps: List[str] = []
+        self._dumped_reasons: set = set()
+        # name -> fn(bundle_dir): extra bundle artifacts (config, prom, ...)
+        self._writers: Dict[str, Callable[[str], None]] = {}
+        self._meta_fn: Optional[Callable[[], dict]] = None
+
+    # ------------------------------------------------------------- feeding
+
+    def record(self, rec: dict) -> None:
+        self.records.append(rec)
+
+    def add_bundle_writer(self, name: str,
+                          fn: Callable[[str], None]) -> None:
+        self._writers[name] = fn
+
+    def set_meta_fn(self, fn: Callable[[], dict]) -> None:
+        self._meta_fn = fn
+
+    # ------------------------------------------------------------- dumping
+
+    def dump(self, reason: str = "manual", note: Optional[str] = None,
+             force: Optional[bool] = None) -> Optional[str]:
+        """Write the bundle; returns its directory (None when skipped).
+
+        Automatic reasons are one-shot per recorder; ``reason="manual"`` (or
+        ``force=True``) always writes.
+        """
+        if force is None:
+            force = reason == "manual"
+        if not force and reason in self._dumped_reasons:
+            return None
+        if not self.write_files:
+            # non-writing process (rank != 0): the trigger is still handled
+            # (one-shot) and counted, there is just no local bundle
+            self._dumped_reasons.add(reason)
+            self._count(reason)
+            return None
+        last_step = self.records[-1].get("step", 0) if self.records else 0
+        base = f"{time.strftime('%Y%m%d-%H%M%S')}-step{last_step}-{reason}"
+        out = os.path.join(self.dump_dir, base)
+        n = 1
+        while os.path.exists(out):       # two dumps in one second
+            out = os.path.join(self.dump_dir, f"{base}.{n}")
+            n += 1
+        try:
+            os.makedirs(out, exist_ok=True)
+            with open(os.path.join(out, "records.jsonl"), "w") as f:
+                for rec in self.records:
+                    f.write(json.dumps(rec, default=_json_default) + "\n")
+            meta = {
+                "reason": reason,
+                "note": note,
+                "unix_time": time.time(),
+                "num_records": len(self.records),
+                "last_step": last_step,
+            }
+            if self._meta_fn is not None:
+                try:
+                    meta.update(self._meta_fn() or {})
+                except Exception as e:  # noqa: BLE001
+                    meta["meta_error"] = repr(e)
+            with open(os.path.join(out, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1, sort_keys=True,
+                          default=_json_default)
+        except Exception as e:  # noqa: BLE001 — never kill training
+            # the reason is NOT marked handled: a transient write failure
+            # (disk full, permissions) must not suppress every later dump
+            # for this reason, and the counter must not report a bundle
+            # that does not exist
+            logger.warning(f"flight recorder: bundle write failed: {e!r}")
+            return None
+        self._dumped_reasons.add(reason)
+        self._count(reason)
+        for name, fn in self._writers.items():
+            try:
+                fn(out)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"flight recorder: bundle artifact "
+                               f"'{name}' failed: {e!r}")
+        self.dumps.append(out)
+        logger.warning(f"postmortem bundle ({reason}) written to {out} — "
+                       f"summarize with: python -m "
+                       f"deepspeed_tpu.telemetry.postmortem {out}")
+        return out
+
+    def _count(self, reason: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(
+                DUMPS, "postmortem bundles written, per trigger reason").inc(
+                    1, reason=reason)
+
+
+def _json_default(obj):
+    """Last-resort JSON encoder: numpy scalars → python, else repr."""
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:  # noqa: BLE001
+            pass
+    return repr(obj)
+
+
+# ---------------------------------------------------------------- crash hook
+
+# Recorders register weakly: the hook must not keep a dead engine (and its
+# device arrays) alive for the rest of the process.
+_crash_recorders: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_prev_excepthook = None
+
+
+def _crash_excepthook(exc_type, exc_value, exc_tb) -> None:
+    """Dump every live recorder, then chain to the previous hook — the
+    traceback the user sees is unchanged; a bundle now sits next to it."""
+    for rec in list(_crash_recorders):
+        try:
+            rec.dump("exception",
+                     note=f"{exc_type.__name__}: {exc_value}")
+        except Exception:  # noqa: BLE001 — the original traceback wins
+            pass
+    (_prev_excepthook or sys.__excepthook__)(exc_type, exc_value, exc_tb)
+
+
+_hook_installed = False
+
+
+def install_crash_handler(recorder: FlightRecorder) -> None:
+    """Register ``recorder`` for dump-on-uncaught-exception.  The process
+    excepthook is wrapped ONCE per process and chains to whatever was
+    installed before.  Later installs only add the recorder: if another
+    library has since wrapped sys.excepthook (and chains to us), re-wrapping
+    would capture that wrapper as our "previous" hook and crash time would
+    recurse wrapper -> us -> wrapper forever."""
+    global _prev_excepthook, _hook_installed
+    _crash_recorders.add(recorder)
+    if not _hook_installed:
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _crash_excepthook
+        _hook_installed = True

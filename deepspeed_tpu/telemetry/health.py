@@ -1,0 +1,224 @@
+"""In-graph numerics health statistics + anomaly rules.
+
+T3-style fine-grained attribution (arXiv 2401.16677) and ZeRO++-style
+precision tricks (arXiv 2306.10209) both need per-group numerics visibility:
+a NaN at step 40k is useless information unless the record says WHICH module
+group went non-finite and what the preceding steps looked like.  This module
+provides the device half of that story:
+
+- ``compute_group_health`` runs INSIDE the jitted train step and reduces the
+  grad/param trees to a small per-module-group pytree of scalars — grad/param
+  global norms, NaN/Inf element counts, update-to-param ratio.  It is traced
+  once with the step program (one extra output, no recompile) and costs a few
+  bandwidth-bound passes over the parameters.
+- ``AnomalyDetector`` runs on the HOST over the fetched scalars and fires
+  one-shot watchdog-style warnings (loss spike z-score, grad-norm explosion,
+  loss-scale collapse) plus a labeled counter for the snapshot exporter.
+
+The host ring buffer + dump machinery lives in flight_recorder.py; the
+engine-facing orchestration is ``StepTelemetry.health_step``.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+ANOMALIES = "numerics_anomalies_total"
+
+# metrics whose per-group values are element counts, not norms
+_COUNT_KEYS = ("grad_nan", "grad_inf")
+
+
+def _path_segment(entry) -> str:
+    """One pytree path entry → its plain string key."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def group_name(path, depth: int = 2) -> str:
+    """Module-group label for a leaf path: the first ``depth`` segments,
+    skipping a leading flax collection key ("params")."""
+    segs = [_path_segment(e) for e in path]
+    if segs and segs[0] == "params":
+        segs = segs[1:]
+    return "/".join(segs[:depth]) or "<root>"
+
+
+def group_names(tree, depth: int = 2) -> List[str]:
+    """The (sorted) group labels ``compute_group_health`` will emit."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return sorted({group_name(p, depth) for p, _ in flat})
+
+
+def compute_group_health(params, grads, new_params=None, *,
+                         depth: int = 2) -> Dict[str, Dict[str, Any]]:
+    """Per-module-group numerics stats, computed in-graph.
+
+    Returns ``{group: {grad_norm, param_norm, grad_nan, grad_inf
+    [, update_ratio]}}`` — all 0-d jax arrays.  ``update_ratio`` (the
+    reference's effective-update health signal, ||Δp|| / ||p||) is emitted
+    only when ``new_params`` is given; on overflow-skipped steps Δp == 0 so
+    the ratio reads 0 there.  Group labels are static strings fixed at trace
+    time, so the output pytree structure never changes between steps.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    flat_g = jax.tree_util.tree_flatten_with_path(grads)[0]
+    p_leaves = jax.tree_util.tree_leaves(params)
+    q_leaves = (jax.tree_util.tree_leaves(new_params)
+                if new_params is not None else [None] * len(p_leaves))
+    acc: Dict[str, Dict[str, Any]] = {}
+    for (path, g), p, q in zip(flat_g, p_leaves, q_leaves):
+        name = group_name(path, depth)
+        a = acc.setdefault(name, {
+            "g_sq": jnp.float32(0.0), "p_sq": jnp.float32(0.0),
+            "d_sq": jnp.float32(0.0), "nan": jnp.int32(0),
+            "inf": jnp.int32(0)})
+        # int params get float0 grads from jax.grad — nothing to measure
+        if (hasattr(g, "dtype") and hasattr(g, "ndim")
+                and jnp.issubdtype(g.dtype, jnp.floating)):
+            g32 = g.astype(jnp.float32)
+            a["g_sq"] = a["g_sq"] + jnp.sum(g32 * g32)
+            a["nan"] = a["nan"] + jnp.sum(jnp.isnan(g32)).astype(jnp.int32)
+            a["inf"] = a["inf"] + jnp.sum(jnp.isinf(g32)).astype(jnp.int32)
+        if (hasattr(p, "dtype")
+                and jnp.issubdtype(p.dtype, jnp.floating)):
+            p32 = p.astype(jnp.float32)
+            a["p_sq"] = a["p_sq"] + jnp.sum(p32 * p32)
+            if q is not None:
+                d = q.astype(jnp.float32) - p32
+                a["d_sq"] = a["d_sq"] + jnp.sum(d * d)
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, a in acc.items():
+        p_norm = jnp.sqrt(a["p_sq"])
+        rec = {
+            "grad_norm": jnp.sqrt(a["g_sq"]),
+            "param_norm": p_norm,
+            "grad_nan": a["nan"],
+            "grad_inf": a["inf"],
+        }
+        if new_params is not None:
+            rec["update_ratio"] = jnp.sqrt(a["d_sq"]) / (p_norm + 1e-12)
+        out[name] = rec
+    return out
+
+
+def to_python(health) -> Dict[str, Dict[str, float]]:
+    """Host (device_get) health pytree → plain float/int dict (JSON-safe)."""
+    if not health:
+        return {}
+    out: Dict[str, Dict[str, float]] = {}
+    for group, stats in health.items():
+        rec = {}
+        for key, val in stats.items():
+            rec[key] = int(val) if key in _COUNT_KEYS else float(val)
+        out[group] = rec
+    return out
+
+
+def flatten_health(health: Dict[str, Dict[str, float]],
+                   prefix: str = "") -> Dict[str, float]:
+    """{group: {stat: v}} → {"group/stat": v} — the flat scalar form the
+    cross-host aggregation helper consumes."""
+    flat: Dict[str, float] = {}
+    for group, stats in (health or {}).items():
+        for key, val in stats.items():
+            flat[f"{prefix}{group}/{key}"] = float(val)
+    return flat
+
+
+class AnomalyDetector:
+    """Rolling-window anomaly rules over the per-step host scalars.
+
+    Mirrors the recompile watchdog's disclosure contract: every detection
+    bumps the labeled ``numerics_anomalies_total{rule=...}`` counter, but the
+    log WARNING fires once per rule per run (a diverging run would otherwise
+    print the same line every step).  ``last_warning`` keeps the latest text
+    for tests and callers that swallow logs.
+    """
+
+    RULES = ("loss_spike", "grad_norm_explosion", "loss_scale_collapse")
+
+    def __init__(self, window: int = 32, loss_spike_zscore: float = 6.0,
+                 grad_norm_factor: float = 10.0,
+                 scale_collapse_factor: float = 16.0,
+                 min_history: int = 8, registry=None,
+                 emit_warnings: bool = True):
+        self.loss_spike_zscore = float(loss_spike_zscore)
+        self.grad_norm_factor = float(grad_norm_factor)
+        self.scale_collapse_factor = float(scale_collapse_factor)
+        self.min_history = int(min_history)
+        self.registry = registry
+        self.emit_warnings = emit_warnings
+        self._losses: deque = deque(maxlen=int(window))
+        self._gnorms: deque = deque(maxlen=int(window))
+        self._scales: deque = deque(maxlen=int(window))
+        self.warned: set = set()
+        self.last_warning: Optional[str] = None
+
+    def observe(self, step: int, loss: float, grad_norm: float,
+                loss_scale: float) -> List[str]:
+        """Feed one step's scalars; returns the rules that fired."""
+        fired: List[str] = []
+        if math.isfinite(loss) and len(self._losses) >= self.min_history:
+            n = len(self._losses)
+            mean = sum(self._losses) / n
+            var = sum((x - mean) ** 2 for x in self._losses) / n
+            # std floor: a perfectly flat window would flag any wiggle
+            std = max(math.sqrt(var), 1e-3 * abs(mean) + 1e-8)
+            z = (loss - mean) / std
+            if z > self.loss_spike_zscore:
+                fired.append("loss_spike")
+                self._warn("loss_spike", step,
+                           f"loss {loss:.6g} is {z:.1f} sigma above the "
+                           f"rolling mean {mean:.6g} (window {n})")
+        if (math.isfinite(grad_norm) and grad_norm > 0
+                and len(self._gnorms) >= self.min_history):
+            mean_g = sum(self._gnorms) / len(self._gnorms)
+            if mean_g > 0 and grad_norm > self.grad_norm_factor * mean_g:
+                fired.append("grad_norm_explosion")
+                self._warn("grad_norm_explosion", step,
+                           f"grad norm {grad_norm:.6g} exceeds "
+                           f"{self.grad_norm_factor:g}x the rolling mean "
+                           f"{mean_g:.6g}")
+        if (self._scales and loss_scale > 0
+                and loss_scale * self.scale_collapse_factor
+                <= max(self._scales)):
+            fired.append("loss_scale_collapse")
+            self._warn("loss_scale_collapse", step,
+                       f"loss scale collapsed to {loss_scale:g} from a "
+                       f"recent peak of {max(self._scales):g} — persistent "
+                       f"overflows are eating the dynamic range")
+        # append AFTER the checks so a step never masks its own anomaly
+        if math.isfinite(loss):
+            self._losses.append(float(loss))
+        if math.isfinite(grad_norm) and grad_norm > 0:
+            self._gnorms.append(float(grad_norm))
+        if loss_scale > 0:
+            self._scales.append(float(loss_scale))
+        if fired and self.registry is not None:
+            c = self.registry.counter(
+                ANOMALIES, "numerics anomaly detections, per rule "
+                "(loss_spike / grad_norm_explosion / loss_scale_collapse)")
+            for rule in fired:
+                c.inc(1, rule=rule)
+        return fired
+
+    def _warn(self, rule: str, step: int, detail: str) -> None:
+        msg = (f"NUMERICS anomaly '{rule}' at step {step}: {detail}.  "
+               f"Further '{rule}' detections are counted "
+               f"({ANOMALIES}{{rule={rule}}}) but not re-warned.")
+        self.last_warning = msg
+        if rule in self.warned:
+            return
+        self.warned.add(rule)
+        if self.emit_warnings:
+            logger.warning(msg)

@@ -1,0 +1,178 @@
+"""Postmortem bundle summarizer — ``python -m deepspeed_tpu.telemetry.postmortem <dir>``.
+
+Reads a flight-recorder bundle (flight_recorder.py) and prints the triage
+view a NaN hunt starts from: the trigger, the last recorded steps' loss /
+grad-norm / loss-scale trajectory, which module groups carried non-finite
+gradients, the worst per-group norms, anomaly detections, and which bundle
+artifacts are present for deeper digging.  Pure stdlib + file reads — it
+must run on a machine with no accelerator (or no jax) at all.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from typing import List, Optional
+
+
+def _load_records(bundle_dir: str) -> List[dict]:
+    path = os.path.join(bundle_dir, "records.jsonl")
+    records = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue                     # a torn line must not kill triage
+    return records
+
+
+def _fmt(v, nd: int = 5) -> str:
+    if v is None:
+        return "-"
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return f"{v:.{nd}g}"
+
+
+def summarize(bundle_dir: str, tail: int = 8) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add("=" * 72)
+    add(f"postmortem bundle: {bundle_dir}")
+    add("=" * 72)
+
+    meta = {}
+    meta_path = os.path.join(bundle_dir, "meta.json")
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            add(f"meta.json unreadable: {e!r}")
+    if meta:
+        add(f"trigger ........... {meta.get('reason', '?')}"
+            + (f" ({meta['note']})" if meta.get("note") else ""))
+        add(f"last step ......... {meta.get('last_step', '?')}")
+        add(f"records ........... {meta.get('num_records', '?')}")
+        if "process_index" in meta:
+            add(f"process ........... {meta['process_index']}")
+
+    records = _load_records(bundle_dir)
+    if not records:
+        add("records.jsonl ..... MISSING or empty — nothing was recorded "
+            "before the trigger")
+    else:
+        add("")
+        add(f"last {min(tail, len(records))} of {len(records)} step records "
+            f"(loss / grad_norm / loss_scale / skipped / anomalies):")
+        for rec in records[-tail:]:
+            anom = ",".join(rec.get("anomalies") or []) or "-"
+            add(f"  step {rec.get('step', '?'):>8}: "
+                f"loss={_fmt(rec.get('loss'))} "
+                f"gnorm={_fmt(rec.get('grad_norm'))} "
+                f"scale={_fmt(rec.get('loss_scale'), 6)} "
+                f"skipped={rec.get('skipped_steps', '-')} "
+                f"anomalies={anom}")
+
+        # ---- per-group attribution across the whole buffer ----
+        nonfinite: dict = {}
+        worst_norm: dict = {}
+        for rec in records:
+            for group, stats in (rec.get("health") or {}).items():
+                bad = (int(stats.get("grad_nan", 0) or 0)
+                       + int(stats.get("grad_inf", 0) or 0))
+                if bad:
+                    nonfinite[group] = nonfinite.get(group, 0) + bad
+                gn = stats.get("grad_norm")
+                if gn is not None and math.isfinite(float(gn)):
+                    worst_norm[group] = max(worst_norm.get(group, 0.0),
+                                            float(gn))
+        add("")
+        if nonfinite:
+            add("module groups with non-finite gradient elements "
+                "(summed over the buffer):")
+            for group, count in sorted(nonfinite.items(),
+                                       key=lambda kv: -kv[1]):
+                add(f"  {group:<40} {count}")
+        else:
+            add("no non-finite gradient elements recorded per group "
+                "(health stats absent or clean)")
+        if worst_norm:
+            add("largest finite per-group grad norms seen:")
+            top = sorted(worst_norm.items(), key=lambda kv: -kv[1])[:5]
+            for group, norm in top:
+                add(f"  {group:<40} {_fmt(norm)}")
+
+        fired: dict = {}
+        for rec in records:
+            for rule in rec.get("anomalies") or []:
+                fired[rule] = fired.get(rule, 0) + 1
+        if fired:
+            add("anomaly detections in the buffer: "
+                + ", ".join(f"{r}x{c}" for r, c in sorted(fired.items())))
+
+        fleet = records[-1].get("fleet")
+        if fleet:
+            add("")
+            add("fleet aggregates on the trigger record (min/mean/max, "
+                "tripping process):")
+            for key in sorted(fleet)[:12]:
+                agg = fleet[key]
+                add(f"  {key:<44} {_fmt(agg.get('min'))} / "
+                    f"{_fmt(agg.get('mean'))} / {_fmt(agg.get('max'))} "
+                    f"(p{agg.get('argmax_process', '?')})")
+
+    add("")
+    add("bundle artifacts:")
+    for name, hint in (("records.jsonl", "step records"),
+                       ("meta.json", "trigger metadata"),
+                       ("config.json", "resolved engine config"),
+                       ("snapshot.prom", "Prometheus metric snapshot"),
+                       ("trace.json", "Chrome trace (ui.perfetto.dev)"),
+                       ("env.txt", "environment report")):
+        present = os.path.exists(os.path.join(bundle_dir, name))
+        add(f"  [{'x' if present else ' '}] {name:<16} {hint}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.postmortem",
+        description="Summarize a flight-recorder postmortem bundle")
+    ap.add_argument("bundle", help="bundle directory (or a parent "
+                    "postmortem/ dir — the newest bundle is picked)")
+    ap.add_argument("--tail", type=int, default=8,
+                    help="step records to print (default 8)")
+    args = ap.parse_args(argv)
+    bundle = args.bundle
+    if not os.path.isdir(bundle):
+        print(f"error: {bundle} is not a directory", file=sys.stderr)
+        return 2
+    if not os.path.exists(os.path.join(bundle, "records.jsonl")):
+        # a parent dir full of bundles: pick the newest one
+        subs = sorted(
+            d for d in os.listdir(bundle)
+            if os.path.exists(os.path.join(bundle, d, "records.jsonl")))
+        if subs:
+            bundle = os.path.join(bundle, subs[-1])
+    print(summarize(bundle, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,9 @@ path adds one attribute check per call to the hot loop.
 
 from __future__ import annotations
 
+import math
 import os
+import time
 from contextlib import nullcontext
 from typing import Callable, Dict, Optional
 
@@ -76,6 +78,43 @@ class StepTelemetry:
         self._exec: Dict[str, dict] = {}
         self._sig_stats: Dict[tuple, dict] = {}
         self._trace_flush_mark = 0
+
+        # ---- numerics health monitor + flight recorder (telemetry.health
+        # block) — active INDEPENDENTLY of the parent enabled switch: a
+        # postmortem is wanted exactly when nothing else is being watched
+        hc = tcfg.health
+        self.health_cfg = hc
+        self.health_enabled = bool(hc.enabled)
+        self.recorder = None
+        self.anomaly = None
+        self._config = config
+        self._prev_skipped: Optional[int] = 0
+        self._overflow_streak = 0
+        if self.health_enabled:
+            from deepspeed_tpu.telemetry.flight_recorder import (
+                FlightRecorder, install_crash_handler)
+            from deepspeed_tpu.telemetry.health import AnomalyDetector
+            self.recorder = FlightRecorder(
+                capacity=int(hc.recorder_steps),
+                dump_dir=hc.dump_path or os.path.join(base, "postmortem"),
+                write_files=self._rank0, registry=self.registry)
+            self.recorder.add_bundle_writer("config.json",
+                                            self._write_bundle_config)
+            self.recorder.add_bundle_writer("snapshot.prom",
+                                            self._write_bundle_prometheus)
+            self.recorder.add_bundle_writer("trace.json",
+                                            self._write_bundle_trace)
+            self.recorder.add_bundle_writer("env.txt", self._write_bundle_env)
+            self.recorder.set_meta_fn(lambda: {
+                "process_index": pid, "spans": self.tracer.summary()})
+            self.anomaly = AnomalyDetector(
+                window=int(hc.anomaly_window),
+                loss_spike_zscore=float(hc.loss_spike_zscore),
+                grad_norm_factor=float(hc.grad_norm_factor),
+                scale_collapse_factor=float(hc.scale_collapse_factor),
+                registry=self.registry, emit_warnings=self._rank0)
+            if hc.crash_dump:
+                install_crash_handler(self.recorder)
 
     # ------------------------------------------------------------- spans
 
@@ -197,6 +236,157 @@ class StepTelemetry:
         except Exception:  # noqa: BLE001
             pass
         return info["collectives"]
+
+    # ------------------------------------------------------------ health
+
+    def health_step(self, step: int, metrics_host, health=None,
+                    lr: Optional[float] = None,
+                    samples: Optional[int] = None) -> Optional[str]:
+        """Feed one step's HOST-side scalars into the numerics pipeline:
+        anomaly rules, the flight-recorder ring buffer, cross-host
+        aggregation, and the automatic dump triggers (non-finite loss,
+        overflow streak).  ``metrics_host`` is the engine's cached host
+        ``StepMetrics`` (plain floats — the caller already paid the single
+        ``jax.device_get``); ``health`` is the plain per-group stats dict.
+        Returns the bundle path when a trigger fired, else None."""
+        if not self.health_enabled:
+            return None
+        loss = float(metrics_host.loss)
+        grad_norm = float(metrics_host.grad_norm)
+        scale = float(metrics_host.loss_scale)
+        skipped = int(metrics_host.skipped_steps)
+        # overflow streak: consecutive steps whose update was skipped.
+        # _prev_skipped is None right after a checkpoint restore (the
+        # cumulative counter may have jumped either way) — resync the
+        # baseline without reading a phantom overflow into the streak.
+        if self._prev_skipped is None:
+            self._overflow_streak = 0
+        elif skipped > self._prev_skipped:
+            self._overflow_streak += 1
+        else:
+            self._overflow_streak = 0
+        self._prev_skipped = skipped
+        fired = self.anomaly.observe(step, loss, grad_norm, scale)
+        reason = None
+        if not math.isfinite(loss):
+            reason = "nonfinite_loss"
+        elif (int(self.health_cfg.overflow_streak) > 0
+              and self._overflow_streak
+              >= int(self.health_cfg.overflow_streak)):
+            reason = "overflow_streak"
+        rec = {
+            "step": int(step),
+            "unix_time": time.time(),
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "loss_scale": scale,
+            "skipped_steps": skipped,
+            "overflow_streak": self._overflow_streak,
+            "anomalies": fired,
+            "health": health or {},
+        }
+        if lr is not None:
+            rec["lr"] = float(lr)
+        if self.tracer.enabled and self.tracer.last_dur_ms:
+            rec["spans_ms"] = dict(self.tracer.last_dur_ms)
+        import jax
+        # fleet view (min/max/mean per scalar + tripping-process index) at
+        # the fleet_interval cadence, and always when a dump trigger or
+        # anomaly fires — NOT every step: the gather is a blocking
+        # cross-host collective.  Every input to this decision (loss,
+        # grad_norm, scale, streak — all replicated values) is identical on
+        # every process, so all processes reach the collective together.
+        fi = int(self.health_cfg.fleet_interval)
+        want_fleet = (reason is not None or bool(fired)
+                      or (fi > 0 and step % fi == 0))
+        if want_fleet and jax.process_count() > 1:
+            from deepspeed_tpu.comm.aggregation import (
+                aggregate_health_scalars)
+            from deepspeed_tpu.telemetry.health import flatten_health
+            try:
+                flat = {"loss": loss, "grad_norm": grad_norm,
+                        **flatten_health(health or {})}
+                rec["fleet"] = aggregate_health_scalars(flat)
+            except Exception as e:  # noqa: BLE001 — never kill training
+                logger.warning(f"telemetry: fleet aggregation failed: {e!r}")
+        self.recorder.record(rec)
+        if fired and self.monitor is not None and getattr(
+                self.monitor, "enabled", False):
+            x = samples if samples is not None else step
+            self.monitor.write_events(
+                [(f"Train/Numerics/anomaly/{rule}", 1.0, int(x))
+                 for rule in fired])
+        if reason is not None:
+            return self.recorder.dump(reason, note=f"step {step}")
+        return None
+
+    def reset_numerics_baseline(self) -> None:
+        """Called after a checkpoint restore: the cumulative skipped_steps
+        counter may have jumped in either direction, so the overflow-streak
+        comparison must resync its baseline on the next observation instead
+        of counting the jump as an overflow (or missing a real one)."""
+        self._prev_skipped = None
+        self._overflow_streak = 0
+
+    def dump_postmortem(self, reason: str = "manual",
+                        note: Optional[str] = None) -> Optional[str]:
+        """Explicitly write a postmortem bundle (engine.dump_postmortem).
+        Requires ``telemetry.health.enabled``; returns the bundle dir."""
+        if self.recorder is None:
+            logger.warning("dump_postmortem: telemetry.health is disabled — "
+                           "no flight recorder to dump")
+            return None
+        return self.recorder.dump(reason, note=note, force=True)
+
+    # ---- bundle artifact writers (registered with the flight recorder;
+    # each failure degrades to a warning inside the recorder) ----
+
+    def _write_bundle_config(self, bundle_dir: str) -> None:
+        with open(os.path.join(bundle_dir, "config.json"), "w") as f:
+            f.write(self._config.model_dump_json(indent=2))
+
+    def _write_bundle_prometheus(self, bundle_dir: str) -> None:
+        self.exporter.write_prometheus(
+            os.path.join(bundle_dir, "snapshot.prom"))
+
+    def _write_bundle_trace(self, bundle_dir: str) -> None:
+        if self.tracer.enabled and self.tracer.events:
+            self.emitter.write(os.path.join(bundle_dir, "trace.json"),
+                               self.tracer)
+
+    def _write_bundle_env(self, bundle_dir: str) -> None:
+        # a LIGHT env report: the full ``env_report()`` probes the op
+        # registry (pallas kernel compiles, ~10s) — too slow for a dump
+        # that may be racing a dying process
+        import platform
+        import sys as _sys
+
+        import jax
+        lines = ["deepspeed_tpu postmortem environment report"]
+        from deepspeed_tpu.version import __version__
+        lines.append(f"deepspeed_tpu ... {__version__}")
+        for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+            try:
+                import importlib
+                v = getattr(importlib.import_module(mod), "__version__", "?")
+            except Exception:  # noqa: BLE001
+                v = "not importable"
+            lines.append(f"{mod:<16}{v}")
+        lines.append(f"python ......... {_sys.version.split()[0]} "
+                     f"({platform.platform()})")
+        try:
+            devs = jax.devices()
+            lines.append(f"backend ........ {jax.default_backend()} "
+                         f"({len(devs)} device(s)); process "
+                         f"{jax.process_index()}/{jax.process_count()}")
+        except Exception as e:  # noqa: BLE001
+            lines.append(f"backend ........ unavailable ({e})")
+        env_keys = [k for k in sorted(os.environ)
+                    if k.startswith(("JAX_", "XLA_", "LIBTPU", "TPU_"))]
+        for k in env_keys:
+            lines.append(f"env {k}={os.environ[k]}")
+        with open(os.path.join(bundle_dir, "env.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
 
     # ------------------------------------------------------------ memory
 

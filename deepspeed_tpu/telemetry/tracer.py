@@ -48,6 +48,9 @@ class SpanTracer:
         # buffer (it is embedded in every snapshot export — an O(buffer)
         # walk there would grow with run length)
         self._agg: Dict[str, dict] = {}
+        # most recent duration per phase — the flight recorder embeds this
+        # in each step record without scanning the buffer
+        self.last_dur_ms: Dict[str, float] = {}
         self._epoch_ns = time.perf_counter_ns()
 
     def _now_us(self) -> float:
@@ -86,6 +89,7 @@ class SpanTracer:
         agg["total_ms"] += dur_ms
         if dur_ms > agg["max_ms"]:
             agg["max_ms"] = dur_ms
+        self.last_dur_ms[name] = round(dur_ms, 3)
 
     def summary(self) -> Dict[str, dict]:
         """Per-phase count / total / max / mean milliseconds — the compact
@@ -108,6 +112,7 @@ class SpanTracer:
         self.dropped_events = 0
         self.total_recorded = 0
         self._agg = {}
+        self.last_dur_ms = {}
 
 
 class TraceEmitter:

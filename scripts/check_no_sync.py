@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Lint: no per-scalar device→host syncs on the engine step path.
+
+Every ``float(...)`` / ``np.asarray(...)`` applied to a device value forces
+a device round trip; sprinkled through the hot step path they serialize
+dispatch against device completion (the bug class fixed by routing all step
+scalars through the single ``_fetch_metrics`` fetch).  This lint greps the
+step-path functions of ``deepspeed_tpu/engine.py`` for the pattern and
+fails on any occurrence that is not explicitly disclosed:
+
+- lines containing ``device_get`` are allowed (an explicit, visible host
+  fetch — the sanctioned way to cross the boundary);
+- lines carrying a ``# sync-ok`` comment are allowed (a reviewed,
+  intentional sync with its reason next to it);
+- the ``_fetch_metrics`` function body is the sanctioned fetch point and is
+  not scanned.
+
+Grep-level by design: it cannot prove a value is device-resident, so it
+errs on the side of making every ``float(``/``np.asarray(`` in the step
+path either route through ``device_get`` or carry a visible annotation.
+
+Exit status: 0 clean, 1 violations (listed), 2 usage/parse errors.
+Run directly or via the test suite (tests/test_health.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+ENGINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir,
+    "deepspeed_tpu", "engine.py")
+
+# the engine's per-step hot path: batch in → dispatch → reporting
+STEP_PATH_FUNCS = {
+    "train_batch",
+    "_train_batch_offload",
+    "_host_step",
+    "forward",
+    "backward",
+    "step",
+    "_post_step_reporting",
+    "_maybe_print",
+    "_host_metrics",
+}
+
+# the single sanctioned device→host fetch point — not scanned
+SANCTIONED_FUNCS = {"_fetch_metrics"}
+
+SYNC_PATTERN = re.compile(r"\bfloat\(|\bnp\.asarray\(")
+ALLOW_PATTERN = re.compile(r"device_get|#\s*sync-ok")
+
+
+def _function_spans(tree: ast.Module) -> List[Tuple[str, int, int]]:
+    """Module-level functions and class methods ONLY — nested defs are the
+    jit-traced inner closures (e.g. train_batch inside _make_train_batch),
+    where a float(...) runs once at trace time and is not a per-step sync."""
+    spans = []
+    defs = list(tree.body)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            defs.extend(node.body)
+    for node in defs:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans.append((node.name, node.lineno, node.end_lineno))
+    return spans
+
+
+def check_file(path: str = ENGINE_PATH) -> List[str]:
+    with open(path) as f:
+        source = f.read()
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    violations = []
+    for name, start, end in _function_spans(tree):
+        if name not in STEP_PATH_FUNCS or name in SANCTIONED_FUNCS:
+            continue
+        for lineno in range(start, end + 1):
+            line = lines[lineno - 1]
+            code = line.split("#", 1)[0]   # the pattern must be in CODE,
+            # while the sync-ok disclosure lives in the comment part
+            if SYNC_PATTERN.search(code) and not ALLOW_PATTERN.search(line):
+                violations.append(
+                    f"{os.path.relpath(path)}:{lineno} in {name}(): "
+                    f"{line.strip()}")
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="flag per-scalar device syncs on the engine step path")
+    ap.add_argument("path", nargs="?", default=ENGINE_PATH)
+    args = ap.parse_args(argv)
+    try:
+        violations = check_file(args.path)
+    except (OSError, SyntaxError) as e:
+        print(f"check_no_sync: cannot scan {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    if violations:
+        print("check_no_sync: device-sync hazards on the engine step path\n"
+              "(route scalars through _fetch_metrics / an explicit "
+              "device_get, or annotate a reviewed sync with '# sync-ok'):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"check_no_sync: OK — step path of {os.path.relpath(args.path)} "
+          f"is free of undisclosed host syncs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
