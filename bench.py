@@ -49,12 +49,32 @@ def train_flops_per_step(n_params, n_layers, hidden, batch, seq) -> float:
     return 6.0 * n_params * tokens + 12.0 * n_layers * hidden * seq * tokens
 
 
-def _measure(engine, batch, iters=8):
+def _measure(engine, batch, iters=8, prefetch=False):
     """Warmup/compile then timed steps.  The value fetch is the sync: step N
     depends on state N-1, so fetching the last loss drains the whole chain
-    (block_until_ready is not reliable through the remote-TPU relay)."""
+    (block_until_ready is not reliable through the remote-TPU relay).
+
+    ``prefetch=True`` drives the loop through ``engine.prefetch_loader``
+    (runtime/prefetch.py): the worker thread forms/shards/device_puts each
+    batch ahead of its step, so the timed region measures the async-pipeline
+    steady state — ``train_batch``'s input phases collapse to a queue pop.
+    Warmup steps also flow through the prefetcher (same code path the timed
+    steps take)."""
     import jax
-    for _ in range(3):
+    warmup = 3
+    if prefetch and hasattr(engine, "prefetch_loader"):
+        src = (batch for _ in range(warmup + iters))
+        with engine.prefetch_loader(src) as pf:
+            it = iter(pf)
+            for _ in range(warmup):
+                m = engine.train_batch(next(it))
+            jax.device_get(m.loss)
+            t0 = time.perf_counter()
+            for pb in it:
+                m = engine.train_batch(pb)
+            jax.device_get(m.loss)
+            return (time.perf_counter() - t0) / iters
+    for _ in range(warmup):
         m = engine.train_batch(batch)
     jax.device_get(m.loss)
     t0 = time.perf_counter()
@@ -346,7 +366,7 @@ def run_bench():
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config,
                                                example_batch=example)
 
-    dt = _measure(engine, batch, iters=10)
+    dt = _measure(engine, batch, iters=10, prefetch=True)
     m = engine.train_batch(batch)          # final metrics for the report
 
     # numerics-watch leg: the flagship engine runs health OFF (the health
@@ -422,6 +442,20 @@ def run_bench():
         extra["postmortem_dumps"] = int(sum(
             s["value"] for s in snap.get("counters", {}).get(
                 "postmortem_dumps_total", {}).get("samples", [])))
+        # async-pipeline columns: the flagship timed loop runs through the
+        # background prefetcher, so batches handed out / starvation events
+        # say whether the input pipeline kept the device fed (starvation
+        # must be 0 after warmup for the h2d bubble to be truly gone)
+        extra["prefetch_batches"] = int(sum(
+            s["value"] for s in snap.get("counters", {}).get(
+                "prefetch_batches_total", {}).get("samples", [])))
+        extra["prefetch_starvation"] = int(sum(
+            s["value"] for s in snap.get("counters", {}).get(
+                "prefetch_starvation_total", {}).get("samples", [])))
+        overlap = [s["value"] for s in snap.get("gauges", {}).get(
+            "host_step_overlap_ratio", {}).get("samples", [])]
+        if overlap:  # only present on a ZeRO-Offload overlap_step leg
+            extra["host_step_overlap_ratio"] = round(float(overlap[-1]), 4)
         extra["telemetry_snapshot"] = snap_path
     except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
         extra["telemetry_error"] = str(e)[:120]

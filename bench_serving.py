@@ -62,12 +62,10 @@ def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
            quant_weights=False, quant_bits=8):
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
 
+    # group_size left unset: QuantizationConfig defaults it per bits (256
+    # for int4 — the W4A16 Mosaic kernel's de-interleaved activation tile
+    # needs group % 256; 128 for int8)
     quant = {"enabled": bool(quant_weights), "bits": quant_bits}
-    if quant_bits == 4:
-        # the W4A16 Mosaic kernel's de-interleaved activation tile needs
-        # group % 256 (ops/wq_matmul.kernel4_supported); 128 would silently
-        # measure the dequant fallback
-        quant["group_size"] = 256
     eng = InferenceEngineV2(
         cfg,
         {"state_manager": {

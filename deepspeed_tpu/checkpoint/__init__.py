@@ -22,13 +22,19 @@ import atexit
 import json
 import os
 import threading
-from typing import Any, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
 LATEST_FILE = "latest"
+# exists inside <tag>/ from before the first byte of an asynchronous write
+# until its commit — a crash mid-write leaves the marker behind, 'latest'
+# still points at the previous committed tag, and restore of the marked tag
+# fails loudly instead of loading a torn state
+IN_PROGRESS_FILE = ".in_progress"
 
 
 def __getattr__(name):
@@ -77,38 +83,97 @@ def _ckpt_path(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), tag, "state")
 
 
+def mark_in_progress(save_dir: str, tag: str) -> None:
+    """Drop the IN_PROGRESS marker into <tag>/ (creating the dir) BEFORE the
+    first checkpoint byte is written.  Process 0 only — the marker protects
+    the shared directory, not per-process state."""
+    if jax.process_index() == 0:
+        os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+        with open(os.path.join(save_dir, tag, IN_PROGRESS_FILE), "w") as f:
+            f.write(str(time.time()))
+
+
+def in_progress(load_dir: str, tag: str) -> bool:
+    return os.path.exists(os.path.join(load_dir, tag, IN_PROGRESS_FILE))
+
+
+def commit_latest(save_dir: str, tag: str) -> None:
+    """The metadata commit point — call only once every checkpoint byte is
+    durable.  Commit order: marker comes off → 'latest' moves.  A crash
+    before the marker removal leaves 'latest' at the previous tag and the
+    marked tag un-restorable; a crash between the two steps leaves a
+    committed tag that 'latest' doesn't point at — the previous checkpoint
+    still loads either way (reference: 'latest' tag file, engine.py
+    _save_checkpoint, written only post-commit).  Shared by the device
+    engine's save path and InfinityEngine's writer thread."""
+    marker = os.path.join(save_dir, tag, IN_PROGRESS_FILE)
+    if os.path.exists(marker):
+        os.remove(marker)
+    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+        f.write(tag)
+
+
+def check_not_in_progress(load_dir: str, tag: str) -> None:
+    """Refuse to restore a tag whose async write never committed."""
+    if in_progress(load_dir, tag):
+        raise RuntimeError(
+            f"checkpoint {os.path.join(load_dir, tag)} carries "
+            f"{IN_PROGRESS_FILE}: its async write never committed (crash "
+            f"mid-write) — the state under it may be torn.  Load the "
+            f"previous committed tag ('latest' still points there) or "
+            f"delete the directory.")
+
+
 def _write_meta(save_dir: str, tag: str, client_state: dict) -> None:
     if jax.process_index() == 0:
         with open(os.path.join(save_dir, tag, "client_state.json"), "w") as f:
             json.dump(client_state or {}, f)
-        # reference: 'latest' tag file (engine.py _save_checkpoint) — written
-        # only once the checkpoint is committed, so 'latest' never points at
-        # a partial save
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(tag)
+        commit_latest(save_dir, tag)
 
 
 def save_train_state(save_dir: str, tag: str, state, client_state: dict = None,
-                     block: bool = True) -> str:
+                     block: bool = True,
+                     on_commit: Optional[Callable[[float], None]] = None,
+                     pre_commit: Optional[Callable[[], None]] = None
+                     ) -> str:
     """Save the train state.  ``block=False`` returns as soon as the on-device
     arrays are snapshotted — the write streams in the background while
     training continues (reference async_io/decoupled checkpointing; orbax
-    AsyncCheckpointer), and the 'latest' pointer lands on commit."""
+    AsyncCheckpointer), and the 'latest' pointer lands on commit.
+    ``pre_commit()`` (if given) runs after the orbax write is durable but
+    BEFORE the metadata commit ('latest' move / marker removal) — on the
+    waiter thread for async saves — so sidecar files the restore path
+    requires (e.g. the ZeRO-Offload masters npz) land strictly inside the
+    in-progress window; a failure there aborts the commit.
+    ``on_commit(write_seconds)`` (if given) runs right after the metadata
+    commit — on THIS thread for ``block=True``, on the waiter thread
+    otherwise (the engine uses it to close its ``checkpoint_write`` span and
+    zero the backlog gauge)."""
     global _PENDING
     wait_pending()                       # serialize with any previous save
     path = _ckpt_path(save_dir, tag)
+    mark_in_progress(save_dir, tag)
+    t0 = time.perf_counter()
     ckptr = _checkpointer()
     ckptr.save(path, state, force=True)
     if block:
-        ckptr.wait_until_finished()
+        ckptr.wait_until_finished()      # sync-ok: caller asked block=True
+        if pre_commit is not None:
+            pre_commit()
         _write_meta(save_dir, tag, client_state)
+        if on_commit is not None:
+            on_commit(time.perf_counter() - t0)
         return path
 
     def _finish():
         global _PENDING_ERROR
         try:
             ckptr.wait_until_finished()
+            if pre_commit is not None:
+                pre_commit()
             _write_meta(save_dir, tag, client_state)
+            if on_commit is not None:
+                on_commit(time.perf_counter() - t0)
         except BaseException as e:  # noqa: BLE001 — surfaced by wait_pending
             _PENDING_ERROR = e
 
@@ -131,6 +196,7 @@ def restore_train_state(load_dir: str, tag: str, shardings, like_state
     """Restore into the given shardings (resharding on load is free — this is the
     universal-checkpoint capability, reference checkpoint/ds_to_universal.py)."""
     wait_pending()                       # a racing async save must commit
+    check_not_in_progress(load_dir, tag)
     path = _ckpt_path(load_dir, tag)
     abstract = jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),

@@ -80,6 +80,14 @@ class OffloadConfig(DeepSpeedConfigModel):
     # reference offload_config.py:96 (ZeRO-Offload++ partial offload): the
     # host tier here is all-or-nothing — any ratio < 1 warns inert
     ratio: float = 1.0
+    # ZeRO-Offload delayed one-step update (reference "delayed parameter
+    # update", DeepSpeedZeroConfig offload + stage_1_and_2 DPU): run the
+    # host Adam of step N on a worker thread overlapped with step N+1's
+    # device grad computation.  Step N+1's gradients then see parameters
+    # ONE update stale — documented staleness, regression-tested; set False
+    # for the bitwise-serial host step.  Read only on offload_optimizer
+    # (ignored for offload_param, whose engine owns its own schedule).
+    overlap_step: bool = True
 
 
 class ZeroConfig(DeepSpeedConfigModel):
@@ -175,6 +183,22 @@ class HybridEngineConfig(DeepSpeedConfigModel):
     release_inference_cache: bool = False
     pin_parameters: bool = True
     tp_gather_partition_size: int = 8
+
+
+class DataPipelineConfig(DeepSpeedConfigModel):
+    """Host→device input pipeline (runtime/prefetch.py).
+
+    ``prefetch_depth`` microbatch stacks are formed, sharded and
+    ``device_put`` AHEAD of their step by a background worker when the
+    loader is wrapped via ``engine.prefetch_loader(loader)`` /
+    ``DeepSpeedDataLoader.prefetch(engine)`` — ``train_batch``'s
+    ``host_to_device`` span then collapses to a queue pop.  The queue is
+    bounded (backpressure: at most ``prefetch_depth`` staged batches pin
+    device memory).  0 disables the worker (the wrapper prepares each batch
+    synchronously, same API).  See docs/performance.md.
+    """
+
+    prefetch_depth: int = 2
 
 
 class DataSamplingConfig(DeepSpeedConfigModel):
@@ -407,6 +431,8 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     data_efficiency: DataEfficiencyConfig = Field(
         default_factory=DataEfficiencyConfig)
+    data_pipeline: DataPipelineConfig = Field(
+        default_factory=DataPipelineConfig)
     hybrid_engine: HybridEngineConfig = Field(
         default_factory=HybridEngineConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = Field(

@@ -366,6 +366,15 @@ class DeepSpeedTPUEngine:
         else:
             self.offload_opt = None
             self.optimizer, self._opt_params = self._build_tx(client_optimizer)
+        # overlapped host step (offload_optimizer.overlap_step): the CPU Adam
+        # of step N runs on a worker thread while the device computes step
+        # N+1's grads against one-update-stale params (reference ZeRO-Offload
+        # delayed parameter update); runtime/offload.py HostStepWorker
+        self._overlap_step = bool(self.offloading and off.overlap_step)
+        self._host_worker = None
+        if self._overlap_step:
+            from deepspeed_tpu.runtime.offload import HostStepWorker
+            self._host_worker = HostStepWorker()
 
         # normalize the example batch's leading dim to the global microbatch so
         # init tracing and the jitted step see shardable shapes; only leaves
@@ -1040,16 +1049,59 @@ class DeepSpeedTPUEngine:
         return grads_batch
 
     def _train_batch_offload(self, batch):
+        # dispatch FIRST: the device starts this step's grads against the
+        # params currently on device — under overlap_step those are ONE
+        # update stale (the previous host Adam may still be in flight) —
+        # and only then join the previous overlapped host step, so the CPU
+        # Adam of step N-1 hides behind step N's device grad computation
+        # (reference ZeRO-Offload delayed parameter update)
         grads, loss, gnorm, health = self._jit_grads_batch(self.state, batch)
+        if self._overlap_step:
+            self._join_host_step()
         n_micro = 1 if self.gas_in_model else self.gas
-        return self._host_step(grads, loss, gnorm, n_micro, health_dev=health)
+        return self._host_step(grads, loss, gnorm, n_micro, health_dev=health,
+                               overlap=self._overlap_step)
+
+    def _join_host_step(self) -> None:
+        """Install the params produced by the overlapped ZeRO-Offload host
+        step (``offload_optimizer.overlap_step``); no-op when nothing is in
+        flight.  A worker failure re-raises HERE — one train_batch late, but
+        a lost optimizer update never looks like a completed one.  Every API
+        that reads committed params (eval/checkpoint/export/trio) fences
+        through this first."""
+        w = self._host_worker
+        if w is None or not w.busy:
+            return
+        t0 = time.perf_counter()
+        new_params = w.join()
+        blocked = time.perf_counter() - t0
+        if new_params is not None:
+            self.state = self.state._replace(params=new_params)
+        work = w.last_work_s
+        if self.telemetry.enabled and work > 0.0:
+            # 1.0 = the whole host step hid behind device compute; 0.0 = the
+            # join blocked for the full host-step duration (no overlap won)
+            self.telemetry.registry.gauge(
+                "host_step_overlap_ratio",
+                "fraction of the overlapped ZeRO-Offload host optimizer "
+                "step hidden behind device compute (1.0 = fully overlapped)"
+            ).set(max(0.0, 1.0 - blocked / work))
 
     def _host_step(self, grads_dev, loss_dev, gnorm_dev, n_micro,
-                   health_dev=None) -> StepMetrics:
+                   health_dev=None, overlap=False) -> StepMetrics:
         """The offloaded optimizer step: fetch grads, host Adam on the fp32
         masters (cpu/nvme tier), stream compute-dtype params back.  Loss-scale
         bookkeeping runs in plain Python (reference: _take_model_step +
-        DeepSpeedCPUAdam.step on the offload path)."""
+        DeepSpeedCPUAdam.step on the offload path).
+
+        ``overlap=True`` (train_batch under ``overlap_step``) runs the
+        grads fetch + Adam + params device_put on the HostStepWorker instead
+        of inline — identical math on identical inputs, so the off-path is
+        bitwise-reproduced; only WHEN the new params land differs (at the
+        next step's ``_join_host_step``).  The scalar bookkeeping (loss
+        scale, clip coefficient, schedule clock) stays on this thread either
+        way: it needs only gnorm, which the single fetch below already
+        blocks on."""
         from deepspeed_tpu.runtime.precision import update_loss_scale_host
         state = self.state
         # one host fetch for every scalar this step reads (gnorm, loss, the
@@ -1067,23 +1119,43 @@ class DeepSpeedTPUEngine:
         # reported norm)
         raw_norm = gnorm_scaled / denom if finite else OVERFLOW_GNORM
         if finite:
-            grads_np = jax.device_get(grads_dev)
             clip = float(self.config.gradient_clipping or 0.0)  # sync-ok: config scalar
             coef = 1.0
             if clip > 0.0 and raw_norm > clip:
                 coef = clip / (raw_norm + 1e-6)
             # optax schedules see the update count (0-based), matching the
-            # device path's optax scheduling
+            # device path's optax scheduling.  No worker is in flight here
+            # (callers join before _host_step), so reading step_count — which
+            # only the worker mutates — is race-free.
             lr = (float(self.lr_schedule(self.offload_opt.step_count))  # sync-ok: host schedule math
                   if self.lr_schedule is not None
                   else float(self._opt_params.get("lr", 1e-3)))  # sync-ok: config scalar
-            new_params_np = self.offload_opt.update(
-                grads_np, lr=lr, grad_scale=coef / denom)
-            with self.mesh:
-                new_params = jax.device_put(new_params_np,
-                                            self.param_shardings)
+
+            def host_update(grad_scale=coef / denom, lr=lr):
+                # the heavy half: grads fetch + host Adam over the fp32
+                # masters + compute-dtype params upload.  Under overlap this
+                # body runs on the HostStepWorker while the caller dispatches
+                # the next device step — same math on the same inputs as the
+                # inline path, so off/on differ only in WHEN params land.
+                grads_np = jax.device_get(grads_dev)
+                new_params_np = self.offload_opt.update(
+                    grads_np, lr=lr, grad_scale=grad_scale)
+                with self.mesh:
+                    return jax.device_put(new_params_np,
+                                          self.param_shardings)
+
+            if overlap:
+                self._host_worker.submit(host_update)
+                # stale on purpose (ZeRO-Offload delayed parameter update):
+                # the next step's grads run against these params; the fresh
+                # ones install at that step's _join_host_step
+                new_params = state.params
+            else:
+                new_params = host_update()
             new_step = jnp.int32(int(step_host) + 1)
         else:
+            # overflow: nothing to overlap — the step is skipped entirely
+            # (no Adam, no staleness), only the loss-scale machine advances
             new_params, new_step = state.params, state.step
         new_ls = update_loss_scale_host(ls_host, finite, self.config.fp16)
         self.state = TrainState(step=new_step, params=new_params,
@@ -1194,6 +1266,74 @@ class DeepSpeedTPUEngine:
             return x.reshape((self.gas, x.shape[0] // self.gas) + x.shape[1:])
         return jax.tree_util.tree_map(r, batch)
 
+    def _form_batch(self, batch):
+        """Host-side half of batch preparation (no device traffic):
+        data-efficiency transforms + normalization to the
+        [gas, micro_local, ...] form; returns (batch, global tokens per
+        optimizer step).  train_batch's ``batch_input`` phase, shared with
+        ``prepare_batch`` so the prefetch worker forms batches identically."""
+        batch = self._apply_data_efficiency(batch)
+        first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
+        # multi-process: each host feeds its process-local slice of the
+        # global batch (train_batch_size / process_count rows)
+        local_bs = self.config.train_batch_size // jax.process_count()
+        micro_local = local_bs // self.gas
+        # disambiguate [gas, micro_local, ...] (pre-shaped) from the flat
+        # [local_bs, ...] form by the SECOND dim too — when gas ==
+        # local_bs the leading dim alone cannot tell them apart
+        if (first_shape[0] == self.gas and len(first_shape) > 1
+                and first_shape[1] == micro_local):
+            pass                            # already [gas, micro_local, ...]
+        elif first_shape[0] == local_bs:
+            batch = self._reshape_gas(batch)
+        else:
+            raise ValueError(
+                f"train_batch leading dims {first_shape[:2]} match "
+                f"neither [gas={self.gas}, micro_local={micro_local}, "
+                f"...] nor the flat process-local batch [{local_bs}, "
+                f"...] (train_batch_size={self.config.train_batch_size} "
+                f"/ {jax.process_count()} processes)")
+        lead_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
+        # [gas, micro_local, T, ...] → tokens per optimizer step (global)
+        tokens = (int(np.prod(lead_shape[:3])) * jax.process_count()
+                  if len(lead_shape) >= 3 else 0)
+        return batch, tokens
+
+    def prepare_batch(self, batch):
+        """Form, shard, and ``device_put`` ONE host batch ahead of its step —
+        the work of train_batch's ``batch_input`` + ``host_to_device``
+        phases — returning a :class:`PreparedBatch` that ``train_batch``
+        accepts directly.  This is the ``prepare_fn`` the prefetch worker
+        runs (``prefetch_loader``); calling it inline is equivalent.
+
+        Note: curriculum/random-LTD schedules read ``global_steps`` at
+        PREPARE time, so under prefetch a difficulty change lands up to
+        ``prefetch_depth`` steps late (bounded by the queue depth)."""
+        from deepspeed_tpu.runtime.prefetch import PreparedBatch
+        step = self.global_steps
+        batch, tokens = self._form_batch(batch)
+        batch = self._shard_batch(batch, leading_gas=True)
+        return PreparedBatch(batch=batch, tokens=tokens, step_enqueued=step)
+
+    def prefetch_loader(self, source, depth: Optional[int] = None):
+        """Wrap an iterable of host batches in the background device-prefetch
+        pipeline (runtime/prefetch.py): a worker thread keeps up to ``depth``
+        batches formed/sharded/``device_put`` ahead of the step, so
+        ``train_batch``'s ``host_to_device`` span collapses to a queue pop.
+        ``depth`` defaults to ``data_pipeline.prefetch_depth``; 0 prepares
+        each batch synchronously behind the same iterator surface.  Use as a
+        context manager (or call ``.close()``) for clean worker shutdown."""
+        from deepspeed_tpu.runtime.prefetch import (PrefetchIterator,
+                                                    _InlinePrefetch)
+        if depth is None:
+            depth = int(self.config.data_pipeline.prefetch_depth)
+        if depth <= 0:
+            return _InlinePrefetch(source, self.prepare_batch)
+        return PrefetchIterator(
+            source, self.prepare_batch, depth=depth,
+            registry=self.telemetry.registry if self.telemetry.enabled
+            else None)
+
     # ------------------------------------------------------------------ API
 
     def train_batch(self, batch) -> StepMetrics:
@@ -1204,40 +1344,26 @@ class DeepSpeedTPUEngine:
         Mirrors PipelineEngine.train_batch (runtime/pipe/engine.py:326) semantics
         for the non-pipelined engine.
         """
+        from deepspeed_tpu.runtime.prefetch import PreparedBatch
         t0 = time.perf_counter()
         tel = self.telemetry
         step_id = self.global_steps + 1
         self.tput_timer.start()
-        with tel.span("batch_input", step=step_id):
-            batch = self._apply_data_efficiency(batch)
-            first_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
-            # multi-process: each host feeds its process-local slice of the
-            # global batch (train_batch_size / process_count rows)
-            local_bs = self.config.train_batch_size // jax.process_count()
-            micro_local = local_bs // self.gas
-            # disambiguate [gas, micro_local, ...] (pre-shaped) from the flat
-            # [local_bs, ...] form by the SECOND dim too — when gas ==
-            # local_bs the leading dim alone cannot tell them apart
-            if (first_shape[0] == self.gas and len(first_shape) > 1
-                    and first_shape[1] == micro_local):
-                pass                        # already [gas, micro_local, ...]
-            elif first_shape[0] == local_bs:
-                batch = self._reshape_gas(batch)
-            else:
-                raise ValueError(
-                    f"train_batch leading dims {first_shape[:2]} match "
-                    f"neither [gas={self.gas}, micro_local={micro_local}, "
-                    f"...] nor the flat process-local batch [{local_bs}, "
-                    f"...] (train_batch_size={self.config.train_batch_size} "
-                    f"/ {jax.process_count()} processes)")
-        lead_shape = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
-        # [gas, micro_local, T, ...] → tokens per optimizer step (global)
-        tokens = (int(np.prod(lead_shape[:3])) * jax.process_count()
-                  if len(lead_shape) >= 3 else 0)
-        self.timers(DATA_TIMER).start()
-        with tel.span("host_to_device", step=step_id):
-            batch = self._shard_batch(batch, leading_gas=True)
-        self.timers(DATA_TIMER).stop()
+        if isinstance(batch, PreparedBatch):
+            # the prefetch worker already formed/sharded/device_put this
+            # batch while the previous step ran (runtime/prefetch.py) — both
+            # input phases collapse to an unwrap
+            self.timers(DATA_TIMER).start()
+            with tel.span("host_to_device", step=step_id, prefetched=True):
+                batch, tokens = batch.batch, batch.tokens
+            self.timers(DATA_TIMER).stop()
+        else:
+            with tel.span("batch_input", step=step_id):
+                batch, tokens = self._form_batch(batch)
+            self.timers(DATA_TIMER).start()
+            with tel.span("host_to_device", step=step_id):
+                batch = self._shard_batch(batch, leading_gas=True)
+            self.timers(DATA_TIMER).stop()
         fp = self.config.flops_profiler
         profile_pending = (fp.enabled and not self._flops_profiled
                            and self.global_steps + 1 >= fp.profile_step)
@@ -1292,6 +1418,7 @@ class DeepSpeedTPUEngine:
         eval); other models run their training-mode forward with the current
         state rng.  Returns the scalar loss as a float32 jax array.
         """
+        self._join_host_step()     # eval on committed params, never stale
         # no leading gas dim: pipeline models treat a flat [B, T] batch as a
         # single microbatch (pipe/module.py _3d)
         batch = self._shard_batch(batch)
@@ -1319,6 +1446,7 @@ class DeepSpeedTPUEngine:
             raise RuntimeError(
                 "pipeline models only support train_batch(), not the "
                 "forward/backward/step trio")
+        self._join_host_step()     # mixing trio + train_batch: fence first
         batch = self._apply_data_efficiency(batch)
         batch = self._shard_batch(batch)
         with self.mesh:
@@ -1347,6 +1475,10 @@ class DeepSpeedTPUEngine:
         if not self.is_gradient_accumulation_boundary():
             return None
         assert self._accum_grads is not None, "call forward() before step()"
+        # the trio's host step runs inline (overlap is a train_batch-loop
+        # optimization); a stray overlapped step must land before the
+        # masters are touched again
+        self._join_host_step()
         # one fetch for all micro losses (was a float() sync per microbatch)
         mean_loss = jnp.float32(np.mean(jax.device_get(self._micro_losses)))
         if self.offloading:
@@ -1564,6 +1696,7 @@ class DeepSpeedTPUEngine:
         Functional state is NOT mutated (the step runs on a copy of the
         inputs through an undonated jit)."""
         from deepspeed_tpu.comm.comm import profile_jitted
+        self._join_host_step()
         batch = self._apply_data_efficiency(batch)
         first = tuple(jax.tree_util.tree_leaves(batch)[0].shape)
         local_bs = self.config.train_batch_size // jax.process_count()
@@ -1608,23 +1741,65 @@ class DeepSpeedTPUEngine:
                         async_save: bool = False):
         """reference engine.save_checkpoint (engine.py:3056): sharded save via
         orbax; every process participates (global-view jax.Arrays).
-        ``async_save=True`` returns once device arrays are snapshotted and
-        streams the write in the background (call
-        ``deepspeed_tpu.checkpoint.wait_pending()`` before exiting)."""
+        ``async_save=True`` returns once device arrays are snapshotted
+        (``checkpoint_snapshot`` span, blocking and short) and streams the
+        serialize/write in the background (``checkpoint_write`` span,
+        recorded at commit); an in-progress marker + commit-ordered 'latest'
+        keep a crash mid-write from ever orphaning the previous checkpoint.
+        Call ``engine.wait_for_checkpoint()`` before exiting (the checkpoint
+        module also fences atexit)."""
+        import os
+
         from deepspeed_tpu.checkpoint import save_train_state
+        self._join_host_step()   # only committed params reach the snapshot
+        self.wait_for_checkpoint()   # previous save commits (and zeroes the
+        #                              backlog gauge) before this one starts
         tag = tag or f"global_step{self.global_steps}"
-        with self.telemetry.span("checkpoint_io", step=self.global_steps,
-                                 tag=tag, op="save"):
+        tel = self.telemetry
+        step = self.global_steps
+        pre_commit = None
+        if self.offloading and jax.process_index() == 0:
+            # host-resident masters/moments ride alongside the orbax tree
+            # (reference: _save_zero_checkpoint per-rank optimizer shards),
+            # streamed as npz on the waiter thread, pre-commit: it lands
+            # inside the in-progress window, before 'latest' can move,
+            # without blocking the dispatch thread.  Only an async save
+            # snapshots a COPY (state_dict returns live views the next host
+            # step mutates; a blocking save writes before anything can, and
+            # the copy would transiently double the optimizer-state
+            # footprint on exactly the host-RAM-bound runs that offload)
+            sd = self.offload_opt.state_dict()
+            if async_save:
+                sd = {k: (np.copy(v) if isinstance(v, np.ndarray) else v)
+                      for k, v in sd.items()}
+            npz_path = os.path.join(save_dir, tag, "offload_state.npz")
+
+            def pre_commit(_sd=sd, _path=npz_path):
+                np.savez(_path, **_sd)
+        backlog = (tel.registry.gauge(
+            "checkpoint_write_backlog",
+            "async checkpoint writes still streaming in the background")
+            if tel.enabled else None)
+
+        def on_commit(write_s, _tag=tag, _step=step):
+            # runs on the waiter thread for async saves, inline for blocking
+            # ones — tracer.record/gauge.set are thread-safe appends
+            if backlog is not None:
+                backlog.set(0)
+            if tel.tracer.enabled:
+                end = tel.tracer.now_us()
+                tel.tracer.record("checkpoint_write", end - write_s * 1e6,
+                                  write_s * 1e6, step=_step, tag=_tag,
+                                  op="save")
+
+        if backlog is not None and async_save:
+            backlog.set(1)
+        with tel.span("checkpoint_snapshot", step=step, tag=tag, op="save"):
             save_train_state(save_dir, tag, self.state,
                              client_state=dict(client_state or {},
                                                global_steps=self.global_steps),
-                             block=not async_save)
-            if self.offloading and jax.process_index() == 0:
-                # host-resident masters/moments ride alongside the orbax tree
-                # (reference: _save_zero_checkpoint per-rank optimizer shards)
-                import os
-                np.savez(os.path.join(save_dir, tag, "offload_state.npz"),
-                         **self.offload_opt.state_dict())
+                             block=not async_save, on_commit=on_commit,
+                             pre_commit=pre_commit)
         if self.telemetry.enabled and self.telemetry.snapshot_interval:
             # flush so the checkpoint_io span reaches the trace file even
             # when no further step follows (end-of-run checkpoints); same
@@ -1633,6 +1808,16 @@ class DeepSpeedTPUEngine:
                 step=self.global_steps,
                 samples=self.global_steps * int(self.config.train_batch_size))
         return tag
+
+    def wait_for_checkpoint(self) -> None:
+        """Fence for the async checkpoint pipeline: block until any
+        in-flight background write fully commits ('latest' moved, the
+        in-progress marker removed), re-raising a failed write — a lost
+        checkpoint must not look like a successful one.  Also registered
+        atexit by the checkpoint module, so a forgotten fence degrades to a
+        slow exit, not a torn checkpoint."""
+        from deepspeed_tpu.checkpoint import wait_pending
+        wait_pending()
 
     def save_16bit_model(self, save_dir: str,
                          filename: str = "model_states.safetensors") -> str:
@@ -1645,6 +1830,7 @@ class DeepSpeedTPUEngine:
         import os as _os
 
         from deepspeed_tpu.checkpoint.universal import _flatten_params
+        self._join_host_step()
         _os.makedirs(save_dir, exist_ok=True)
         params = jax.device_get(self.state.params)   # gathers sharded leaves
         flat = {k: np.asarray(v).astype(self.compute_dtype)
@@ -1662,6 +1848,7 @@ class DeepSpeedTPUEngine:
         fragments (+ Adam moments) in a framework-neutral layout any topology
         or toolchain can ingest."""
         from deepspeed_tpu.checkpoint import universal as _u
+        self._join_host_step()
         if self.offloading:
             return _u.export_universal_offload(
                 jax.device_get(self.state.params), self.offload_opt, out_dir,
@@ -1678,6 +1865,7 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.checkpoint.universal import (
             apply_universal, load_universal,
             offload_state_dict_from_fragments)
+        self._join_host_step()   # an in-flight update must not overwrite
         frags, meta = load_universal(universal_dir)
         host = jax.device_get(self.state)
         step = int(meta.get("step", int(np.asarray(host.step))))
@@ -1698,6 +1886,7 @@ class DeepSpeedTPUEngine:
         comes free from named shardings (the reference needs universal-checkpoint
         machinery for that)."""
         from deepspeed_tpu.checkpoint import latest_tag, restore_train_state
+        self._join_host_step()   # an in-flight update must not overwrite
         tag = tag or latest_tag(load_dir)
         if tag is None:
             return None, {}

@@ -111,6 +111,46 @@ _warned_shapes = set()
 trace_counts = {"w8": 0, "w8t": 0, "w4": 0}
 
 
+def _tile_legal(block, array_shape) -> bool:
+    """Mosaic's block-shape rule (jax pallas/mosaic/lowering.py
+    ``_check_block_mappings``): for rank >= 2, the block's last dim must be
+    % 128 or equal the array's, and its second-minor must be % 8 or equal
+    the array's."""
+    if len(block) < 2:
+        return block[0] == array_shape[0] or block[0] % 128 == 0
+    b0, a0 = block[-1], array_shape[-1]
+    b1, a1 = block[-2], array_shape[-2]
+    return (b0 == a0 or b0 % 128 == 0) and (b1 == a1 or b1 % 8 == 0)
+
+
+def _preflight(variant: str, blocks, interpret: bool) -> bool:
+    """True when every (block, array_shape) pair the kernel is about to
+    stage satisfies Mosaic's tiling rule (interpret mode accepts anything).
+    The eligibility gates above should make this unreachable — but the
+    round-5 on-chip sweep recorded a serving leg dying inside an unguarded
+    block-shape raise (BENCH_MEASURED_r05 ``serving_wq_error``), so the rule
+    is re-checked against the EXACT blocks before ``pallas_call`` and an
+    illegal combination takes the dequant fallback (warn-once) instead of
+    erroring out of the caller's step."""
+    for block, ashape in blocks:
+        # a None block (no usable tile divisor) falls back on ANY backend;
+        # interpret mode otherwise accepts every block shape
+        if block is None or (not interpret
+                             and not _tile_legal(block, ashape)):
+            key = ("preflight", variant) + tuple(
+                tuple(b) if b else b for b, _ in blocks)
+            if key not in _warned_shapes:
+                _warned_shapes.add(key)
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(
+                    "%s: staged block shapes %s are not Mosaic-legal "
+                    "(last two block dims must be %%(8, 128) or equal the "
+                    "array dims); falling back to dequantize-then-matmul",
+                    variant, [b for b, _ in blocks])
+            return False
+    return True
+
+
 def kernel_supported(x, store, interpret: Optional[bool] = None) -> bool:
     """True when the Pallas path can run (M and N are NOT constrained —
     both pad to the tile).  Unsupported 2-D stores warn ONCE per shape: a
@@ -280,17 +320,22 @@ def wq_matmul_t(x, store, *, interpret: Optional[bool] = None):
         return x @ dequantize_weight(store, x.dtype).T
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    trace_counts["w8t"] += 1
     v, s = store["v"], store["s"]
     vocab, h = v.shape
     m0 = x.shape[0]
     pad = (-m0) % _sublane(x.dtype)
-    if pad:
-        x = jnp.pad(x, ((0, pad), (0, 0)))
-    m = x.shape[0]
+    m = m0 + pad
     g = vocab // s.shape[0]
     bm = _pick(m, 256)
     bk = _pick(h, 512)
+    if bm is None or bk is None or not _preflight("wq_matmul_t", [
+            ((bm, bk), (m, h)), ((g, bk), (vocab, h)),
+            ((1, 1, bk), (vocab // g, 1, h)), ((bm, g), (m, vocab))],
+            interpret):
+        return x @ dequantize_weight(store, x.dtype).T
+    trace_counts["w8t"] += 1
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
     nk = h // bk
     out = pl.pallas_call(
         functools.partial(_kernel, nk=nk, contract=1),
@@ -321,17 +366,22 @@ def wq_matmul(x, store, *, interpret: Optional[bool] = None):
         return x @ dequantize_weight(store, x.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    trace_counts["w8"] += 1
     v, s = store["v"], store["s"]
     k, n = v.shape
     m0 = x.shape[0]
     pad = (-m0) % _sublane(x.dtype)     # decode token counts tile to rows
-    if pad:
-        x = jnp.pad(x, ((0, pad), (0, 0)))
-    m = x.shape[0]
+    m = m0 + pad
     g = k // s.shape[0]
     bm = _pick(m, 256)
     bn = _pick_n(n, 512)
+    if not _preflight("wq_matmul", [
+            (None if bm is None else (bm, g), (m, k)),
+            ((g, bn), (k, n)), ((1, 1, bn), (k // g, 1, n)),
+            (None if bm is None else (bm, bn), (m, n))], interpret):
+        return x @ dequantize_weight(store, x.dtype)
+    trace_counts["w8"] += 1
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
     nk = k // g
     out = pl.pallas_call(
         functools.partial(_kernel, nk=nk, contract=0),
@@ -365,21 +415,26 @@ def wq_matmul4(x, store, *, interpret: Optional[bool] = None):
         return x @ dequantize_weight4(store, x.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    trace_counts["w4"] += 1
     p, s = store["v4"], store["s"]
     kh, n = p.shape                     # kh = K/2
     k = 2 * kh
     m0 = x.shape[0]
     pad = (-m0) % _sublane(x.dtype)
-    if pad:
-        x = jnp.pad(x, ((0, pad), (0, 0)))
-    m = x.shape[0]
-    xe = x[:, 0::2]                     # [M, K/2] — O(M·K) shuffle, free
-    xo = x[:, 1::2]                     # next to the GEMM it feeds
+    m = m0 + pad
     g = k // s.shape[0]
     gh = g // 2
     bm = _pick(m, 256)
     bn = _pick_n(n, 512)
+    if not _preflight("wq_matmul4", [
+            (None if bm is None else (bm, gh), (m, kh)),
+            ((gh, bn), (kh, n)), ((1, 1, bn), (k // g, 1, n)),
+            (None if bm is None else (bm, bn), (m, n))], interpret):
+        return x @ dequantize_weight4(store, x.dtype)
+    trace_counts["w4"] += 1
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    xe = x[:, 0::2]                     # [M, K/2] — O(M·K) shuffle, free
+    xo = x[:, 1::2]                     # next to the GEMM it feeds
     nk = k // g
     out = pl.pallas_call(
         functools.partial(_kernel4, nk=nk),

@@ -90,6 +90,16 @@ class DeepSpeedDataLoader:
         except TypeError:
             raise TypeError("underlying dataset has no __len__")
 
+    def prefetch(self, engine, depth: Optional[int] = None):
+        """Wrap this loader in the engine's background device-prefetch
+        pipeline (runtime/prefetch.py): returns an iterator of
+        ``PreparedBatch`` whose forming/sharding/``device_put`` happened on
+        a worker thread ahead of the step, so ``engine.train_batch``'s
+        ``host_to_device`` phase is a queue pop.  ``depth`` defaults to the
+        engine's ``data_pipeline.prefetch_depth``.  Use as a context
+        manager (or call ``.close()``) for a clean worker shutdown."""
+        return engine.prefetch_loader(self, depth=depth)
+
 
 def _default_collate(examples):
     """Stack a list of example pytrees into a batch pytree."""

@@ -39,6 +39,7 @@ params are written back to the param store (RAM, or per-layer NVMe files via
 from __future__ import annotations
 
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -499,6 +500,12 @@ class InfinityEngine:
         from deepspeed_tpu.telemetry import StepTelemetry
         self.telemetry = StepTelemetry(config)
         self._health_enabled = self.telemetry.health_enabled
+        # async checkpoint writer (save_checkpoint(async_save=True)): this
+        # engine's state is host-resident numpy, so the writer thread works
+        # from a stable snapshot copy; wait_for_checkpoint() is the fence
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_error: Optional[BaseException] = None
+        self._ckpt_atexit = False
         self.global_steps = 0
         self.loss_scale_state = init_loss_scale(config.fp16)
         self._last_metrics: Optional[StepMetrics] = None
@@ -851,38 +858,119 @@ class InfinityEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None,
                         async_save: bool = False):
+        """``async_save=True`` snapshots the host-resident state on THIS
+        thread (``checkpoint_snapshot`` span — the masters/moments are live
+        numpy the next host step mutates in place, so the writer works from
+        a stable copy) and streams the npz/json write on a background
+        thread (``checkpoint_write`` span, recorded at commit).  Commit
+        order matches the device engine: data durable → in-progress marker
+        off → 'latest' moves — a crash mid-write leaves 'latest' at the
+        previous committed tag.  Fence with ``wait_for_checkpoint()``."""
+        import json
+        import time as _time
+
+        from deepspeed_tpu.checkpoint import commit_latest, mark_in_progress
+        self.wait_for_checkpoint()       # serialize with any previous save
         tag = tag or f"global_step{self.global_steps}"
         out = os.path.join(save_dir, tag)
         os.makedirs(out, exist_ok=True)
-        if jax.process_index() == 0:
+        if jax.process_index() != 0:
+            return tag
+        tel = self.telemetry
+        step = self.global_steps
+        with tel.span("checkpoint_snapshot", step=step, tag=tag, op="save"):
             ls = self.loss_scale_state
-            np.savez(os.path.join(out, "offload_state.npz"),
-                     **self.offload_opt.state_dict())
-            import json
+            sd = self.offload_opt.state_dict()
+            if async_save:
+                # the writer thread needs a stable copy — the next host step
+                # mutates the live masters/moments in place.  A blocking save
+                # writes before anything can mutate, so it skips the copy
+                # (doubling the optimizer-state footprint is exactly what an
+                # Infinity-sized run can't afford)
+                sd = {k: (np.copy(v) if isinstance(v, np.ndarray) else v)
+                      for k, v in sd.items()}
+            meta = {"global_steps": step,
+                    "loss_scale": [float(ls.scale),
+                                   int(ls.growth_counter),
+                                   int(ls.hysteresis),
+                                   int(ls.skipped)],
+                    "rng": np.asarray(
+                        jax.random.key_data(self._rng)
+                        if jnp.issubdtype(self._rng.dtype,
+                                          jax.dtypes.prng_key)
+                        else self._rng).tolist(),
+                    **(client_state or {})}
+            mark_in_progress(save_dir, tag)
+        backlog = (tel.registry.gauge(
+            "checkpoint_write_backlog",
+            "async checkpoint writes still streaming in the background")
+            if tel.enabled else None)
+
+        def write():
+            t0 = _time.perf_counter()
+            np.savez(os.path.join(out, "offload_state.npz"), **sd)
             with open(os.path.join(out, "infinity_meta.json"), "w") as f:
-                json.dump({"global_steps": self.global_steps,
-                           "loss_scale": [float(ls.scale),
-                                          int(ls.growth_counter),
-                                          int(ls.hysteresis),
-                                          int(ls.skipped)],
-                           "rng": np.asarray(
-                               jax.random.key_data(self._rng)
-                               if jnp.issubdtype(self._rng.dtype,
-                                                 jax.dtypes.prng_key)
-                               else self._rng).tolist(),
-                           **(client_state or {})}, f)
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
+                json.dump(meta, f)
+            commit_latest(save_dir, tag)   # data durable → marker off →
+            #                                'latest' moves (commit point)
+            if backlog is not None:
+                backlog.set(0)
+            if tel.tracer.enabled:
+                dur = _time.perf_counter() - t0
+                end = tel.tracer.now_us()
+                tel.tracer.record("checkpoint_write", end - dur * 1e6,
+                                  dur * 1e6, step=step, tag=tag, op="save")
+
+        if not async_save:
+            write()
+            return tag
+        if backlog is not None:
+            backlog.set(1)
+
+        def guarded():
+            try:
+                write()
+            except BaseException as e:  # noqa: BLE001 — wait_for_checkpoint
+                self._ckpt_error = e    # re-raises at the fence
+
+        if not self._ckpt_atexit:
+            # a forgotten fence degrades to a slow exit, not a silently
+            # swallowed write failure (mirrors the checkpoint module's
+            # atexit wait_pending() on the device-engine path)
+            import atexit
+            atexit.register(self.wait_for_checkpoint)
+            self._ckpt_atexit = True
+        # non-daemon: a clean interpreter exit joins the writer instead of
+        # tearing the file mid-write
+        self._ckpt_thread = threading.Thread(
+            target=guarded, name="ds-infinity-ckpt", daemon=False)
+        self._ckpt_thread.start()
         return tag
+
+    def wait_for_checkpoint(self) -> None:
+        """Fence for ``save_checkpoint(async_save=True)``: block until the
+        background write fully commits ('latest' moved, marker removed),
+        re-raising a failed write — a lost checkpoint must not look like a
+        successful one."""
+        t, self._ckpt_thread = self._ckpt_thread, None
+        if t is not None:
+            t.join()
+        if self._ckpt_error is not None:
+            e, self._ckpt_error = self._ckpt_error, None
+            raise e
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         import json
+
+        from deepspeed_tpu.checkpoint import check_not_in_progress
+        self.wait_for_checkpoint()       # a racing async save must commit
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
+        check_not_in_progress(load_dir, tag)
         out = os.path.join(load_dir, tag)
         with np.load(os.path.join(out, "offload_state.npz")) as sd:
             self.offload_opt.load_state_dict(dict(sd))

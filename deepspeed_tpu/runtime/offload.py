@@ -79,6 +79,63 @@ class _NVMeMoments:
         self.file.pwrite(v_buf[: hi - lo], self._v_off + lo * 4)
 
 
+class HostStepWorker:
+    """One-slot background executor for the OVERLAPPED ZeRO-Offload host
+    optimizer step (``offload_optimizer.overlap_step``, reference: ZeRO-
+    Offload's delayed parameter update — the CPU Adam of step N runs while
+    the device computes step N+1's gradients against one-update-stale
+    parameters).
+
+    Exactly one host step may be in flight: ``submit`` while busy is a
+    programming error (the engine joins the previous step before submitting
+    the next — that join is where the measured overlap ratio comes from).
+    The single worker thread also serializes ``OffloadAdam.step_count``
+    mutation without locks.
+    """
+
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ds-host-step")
+        self._pending = None
+        # wall-clock seconds the last completed step spent on the worker —
+        # with the time join() blocked, this yields the overlap ratio the
+        # engine's host_step_overlap_ratio gauge reports
+        self.last_work_s = 0.0
+
+    @property
+    def busy(self) -> bool:
+        return self._pending is not None
+
+    def submit(self, fn, *args, **kwargs):
+        if self._pending is not None:
+            raise RuntimeError(
+                "HostStepWorker.submit with a step already in flight — "
+                "join() the previous overlapped host step first")
+
+        def timed():
+            import time
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                self.last_work_s = time.perf_counter() - t0
+
+        self._pending = self._pool.submit(timed)
+        return self._pending
+
+    def join(self):
+        """Block until the in-flight host step finishes; returns its result
+        (None when nothing was pending) and re-raises worker failures —
+        a lost optimizer update must not look like a completed one."""
+        if self._pending is None:
+            return None
+        fut, self._pending = self._pending, None
+        return fut.result()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True)
+
+
 class OffloadAdam:
     """Host Adam(W) over flat per-leaf buffers (reference DeepSpeedCPUAdam +
     the swap pipeline).  Built by the engine when

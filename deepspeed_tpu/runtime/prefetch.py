@@ -1,0 +1,220 @@
+"""Background device-input prefetch — stage 1 of the asynchronous step
+pipeline.
+
+The reference DeepSpeed engine hides host-side input latency behind device
+compute wherever it can (dataloader workers + pinned-memory async H2D copies;
+ZeRO-3's coalesced prefetching all-gathers).  Our engine's ``train_batch``
+used to pay a blocking ``device_put`` per step — the telemetry
+``host_to_device`` span, measured at ~0.02 GiB/s on the r05 probe, squarely
+on the dispatch thread's critical path.
+
+``PrefetchIterator`` moves that work off the step: a worker thread pulls
+host batches from the source iterable, runs ``prepare_fn`` (the engine's
+``prepare_batch`` — data-efficiency transforms, [gas, micro, ...] forming,
+sharded ``device_put``) and parks the resulting :class:`PreparedBatch` in a
+bounded queue ``depth`` deep.  The consumer's ``__next__`` is a queue pop,
+so ``engine.train_batch``'s ``host_to_device`` span collapses to unwrapping
+an already-device-resident batch.
+
+Contract:
+
+- **backpressure** — at most ``depth`` prepared batches exist at once (the
+  bounded queue blocks the worker), bounding device memory pinned by staged
+  inputs to ``depth`` microbatch stacks;
+- **ordering** — batches are yielded in source order (single worker, FIFO
+  queue);
+- **exception propagation** — a failure in the source iterable or in
+  ``prepare_fn`` re-raises from ``__next__`` on the consumer thread, after
+  all batches prepared before the failure have been consumed;
+- **shutdown** — ``close()`` (also context-manager exit) stops the worker,
+  drains the queue, and joins; end-of-source yields ``StopIteration`` after
+  the queue drains;
+- **telemetry** — ``prefetch_queue_depth`` gauge plus
+  ``prefetch_batches_total`` / ``prefetch_starvation_total`` counters
+  (a starvation event is a pop that found the queue empty after warmup —
+  the first ``depth`` pops, while the worker may still be filling the
+  queue — meaning the device outran the host pipeline; see
+  docs/performance.md).
+
+The worker thread is the ONLY place this subsystem may block on host↔device
+transfers; ``scripts/check_no_sync.py`` lints the consumer surface
+(``__next__``/``close``) for undisclosed syncs on the dispatch thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, NamedTuple, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class PreparedBatch(NamedTuple):
+    """A batch already formed, sharded and ``device_put`` for
+    ``engine.train_batch`` — the step's ``host_to_device`` phase collapses
+    to unwrapping this."""
+
+    batch: Any            # device pytree, [gas, micro_global, ...] leaves
+    tokens: int           # global tokens per optimizer step (0 if unknown)
+    step_enqueued: int    # engine.global_steps when the worker prepared it
+
+
+_STOP = object()          # end-of-source sentinel (also carries exceptions)
+
+
+class _InlinePrefetch:
+    """``prefetch_depth=0`` degenerate form: the same iterator surface with
+    no worker thread — each ``__next__`` prepares synchronously.  Keeps
+    caller code identical across the on/off configurations."""
+
+    def __init__(self, source: Iterable, prepare_fn: Callable[[Any], Any]):
+        self._source = iter(source)
+        self._prepare = prepare_fn
+        self.batches = 0
+        self.starvation_count = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        out = self._prepare(next(self._source))
+        self.batches += 1
+        return out
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class PrefetchIterator:
+    """Bounded background-thread prefetcher over a host-batch iterable.
+
+    Build via ``engine.prefetch_loader(loader)`` (or
+    ``DeepSpeedDataLoader.prefetch(engine)``) rather than directly — the
+    engine binds ``prepare_fn`` and the telemetry registry.
+    """
+
+    def __init__(self, source: Iterable, prepare_fn: Callable[[Any], Any],
+                 depth: int = 2, registry=None, name: str = "train"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth} "
+                             f"(0 disables prefetch at the config level)")
+        self.depth = int(depth)
+        self._prepare = prepare_fn
+        self._source = iter(source)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._registry = registry
+        self._name = name
+        self.batches = 0              # batches handed to the consumer
+        self.starvation_count = 0     # post-warmup pops that found it empty
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name=f"ds-prefetch-{name}", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------- worker
+    def _run(self):
+        """Worker body — the one place this subsystem blocks on
+        host→device transfers (prepare_fn device_puts)."""
+        try:
+            for host_batch in self._source:
+                if self._stop.is_set():
+                    return
+                prepared = self._prepare(host_batch)
+                if not self._put(prepared):
+                    return                      # closed while blocked on put
+        except BaseException as e:  # noqa: BLE001 — re-raised in __next__
+            self._error = e
+        finally:
+            self._put(_STOP)
+
+    def _put(self, item) -> bool:
+        """Blocking put that stays responsive to close(); False = closed."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ----------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        # a pop that finds the queue empty AFTER warmup means the device
+        # consumed faster than the host pipeline produced — the bubble
+        # prefetch exists to remove.  The first ``depth`` pops are warmup
+        # (the worker can still be legitimately filling the queue for the
+        # first time), so they never count.
+        starved = self._q.empty() and self.batches >= self.depth
+        item = self._q.get()
+        if item is _STOP:
+            self.close()
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
+        self.batches += 1
+        if starved:
+            self.starvation_count += 1
+        if self._registry is not None:
+            self._registry.gauge(
+                "prefetch_queue_depth",
+                "prepared device batches waiting in the prefetch queue"
+            ).set(self._q.qsize(), loader=self._name)
+            self._registry.counter(
+                "prefetch_batches_total",
+                "batches handed to train_batch by the prefetch pipeline"
+            ).inc(1, loader=self._name)
+            if starved:
+                self._registry.counter(
+                    "prefetch_starvation_total",
+                    "post-warmup pops that found the prefetch queue empty "
+                    "(device outran the host input pipeline)"
+                ).inc(1, loader=self._name)
+        return item
+
+    # ----------------------------------------------------------- shutdown
+    def close(self):
+        """Stop the worker and drain the queue; idempotent.  Prepared
+        device batches still queued are dropped (their device buffers free
+        with the last reference)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        while True:                    # unblock a worker stuck on put()
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._worker.join(timeout=10.0)
+        if self._worker.is_alive():    # pathological prepare_fn hang
+            logger.warning("prefetch worker did not exit within 10s of "
+                           "close(); abandoning it (daemon thread)")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -466,12 +466,24 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"layout must be contiguous|zigzag, got {layout!r}")
+    if inner not in ("einsum", "flash"):
+        # validated BEFORE the sp==1 early return (round-5 advisor finding:
+        # a bad inner string was silently accepted on single-shard meshes)
+        raise ValueError(f"inner must be einsum|flash, got {inner!r}")
     if sp == 1:
         from deepspeed_tpu import ops
         if layout == "zigzag":
             raise ValueError("layout='zigzag' is meaningless at sp=1 — the "
                              "caller permuted for a ring that doesn't exist")
-        return ops.causal_attention(q, k, v, causal=causal, impl="xla")
+        if inner == "flash":
+            # the flag asked for O(inputs) attention memory; honoring that at
+            # sp=1 means the registry flash kernel (impl=None lets the op
+            # registry pick Pallas where supported), NOT a silent degrade to
+            # dense XLA attention with its [B, H, T, T] logits
+            return ops.causal_attention(q, k, v, causal=causal, scale=scale,
+                                        impl=None)
+        return ops.causal_attention(q, k, v, causal=causal, scale=scale,
+                                    impl="xla")
     if q.shape[1] % sp:
         raise ValueError(f"seq len {q.shape[1]} not divisible by "
                          f"{axis}={sp}")
@@ -498,8 +510,6 @@ def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = True,
     zig = (layout == "zigzag"
            or (schedule == "zigzag" and causal and q.shape[1] % (2 * sp) == 0))
 
-    if inner not in ("einsum", "flash"):
-        raise ValueError(f"inner must be einsum|flash, got {inner!r}")
     if inner == "flash":
         c = q.shape[1] // (2 * sp)
         # importlib, NOT `from deepspeed_tpu.ops import flash_attention`:

@@ -56,6 +56,14 @@ class SpanTracer:
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._epoch_ns) / 1e3
 
+    def now_us(self) -> float:
+        """Current tracer-epoch timestamp — for callers that measure a span
+        themselves (e.g. the async checkpoint writer, whose end is observed
+        from a commit callback on another thread) and record() it after the
+        fact.  record()/span() append to a deque, so recording from a
+        background thread is safe."""
+        return self._now_us()
+
     @contextmanager
     def span(self, name: str, step: Optional[int] = None, **args):
         if not self.enabled:

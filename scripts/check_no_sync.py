@@ -1,23 +1,37 @@
 #!/usr/bin/env python
-"""Lint: no per-scalar device→host syncs on the engine step path.
+"""Lint: no undisclosed blocking host↔device syncs on the dispatch thread.
 
 Every ``float(...)`` / ``np.asarray(...)`` applied to a device value forces
 a device round trip; sprinkled through the hot step path they serialize
 dispatch against device completion (the bug class fixed by routing all step
-scalars through the single ``_fetch_metrics`` fetch).  This lint greps the
-step-path functions of ``deepspeed_tpu/engine.py`` for the pattern and
-fails on any occurrence that is not explicitly disclosed:
+scalars through the single ``_fetch_metrics`` fetch).  The asynchronous
+step pipeline (runtime/prefetch.py, checkpoint async writes) adds a second
+hazard class: the whole point of those subsystems is that blocking work
+happens on a WORKER thread, so a transfer or join sneaking back into the
+consumer surface silently reserializes the pipeline.
 
-- lines containing ``device_get`` are allowed (an explicit, visible host
-  fetch — the sanctioned way to cross the boundary);
-- lines carrying a ``# sync-ok`` comment are allowed (a reviewed,
-  intentional sync with its reason next to it);
-- the ``_fetch_metrics`` function body is the sanctioned fetch point and is
-  not scanned.
+Scan targets (each file gets the pattern matching its hazard class):
+
+- ``deepspeed_tpu/engine.py`` step-path functions — ``float(`` /
+  ``np.asarray(`` (per-scalar device syncs);
+- ``deepspeed_tpu/runtime/prefetch.py`` consumer surface (``__next__`` /
+  ``close``) — ``device_put`` / ``device_get`` / ``block_until_ready`` and
+  the scalar patterns (the worker body ``_run``/``_put`` is the ONE
+  sanctioned transfer site);
+- ``deepspeed_tpu/checkpoint/__init__.py`` ``save_train_state`` —
+  ``wait_until_finished`` / ``device_get`` / ``block_until_ready`` (the
+  background ``_finish`` closure is the sanctioned wait site).
+
+Allowed on any line: ``device_get`` in engine.py (an explicit, visible
+host fetch — the sanctioned way to cross the boundary there) and a
+``# sync-ok`` comment anywhere (a reviewed, intentional sync with its
+reason next to it).  Nested ``def``s inside a scanned function are skipped:
+in the engine they are jit-traced closures (trace-time, not per-step), in
+the checkpoint module they are the background worker bodies where blocking
+is the job.
 
 Grep-level by design: it cannot prove a value is device-resident, so it
-errs on the side of making every ``float(``/``np.asarray(`` in the step
-path either route through ``device_get`` or carry a visible annotation.
+errs on the side of making every match either disclosed or annotated.
 
 Exit status: 0 clean, 1 violations (listed), 2 usage/parse errors.
 Run directly or via the test suite (tests/test_health.py).
@@ -29,36 +43,57 @@ import ast
 import os
 import re
 import sys
-from typing import List, Optional, Tuple
+from typing import List, Optional, Set, Tuple
 
-ENGINE_PATH = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), os.pardir,
-    "deepspeed_tpu", "engine.py")
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+ENGINE_PATH = os.path.join(REPO, "deepspeed_tpu", "engine.py")
+PREFETCH_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime", "prefetch.py")
+CKPT_PATH = os.path.join(REPO, "deepspeed_tpu", "checkpoint", "__init__.py")
 
 # the engine's per-step hot path: batch in → dispatch → reporting
 STEP_PATH_FUNCS = {
     "train_batch",
     "_train_batch_offload",
     "_host_step",
+    "_join_host_step",
     "forward",
     "backward",
     "step",
     "_post_step_reporting",
     "_maybe_print",
     "_host_metrics",
+    "_form_batch",
 }
 
 # the single sanctioned device→host fetch point — not scanned
 SANCTIONED_FUNCS = {"_fetch_metrics"}
 
 SYNC_PATTERN = re.compile(r"\bfloat\(|\bnp\.asarray\(")
-ALLOW_PATTERN = re.compile(r"device_get|#\s*sync-ok")
+BLOCKING_PATTERN = re.compile(
+    r"device_put|device_get|block_until_ready"
+    r"|\bfloat\(|\bnp\.asarray\(")
+CKPT_PATTERN = re.compile(
+    r"wait_until_finished|device_get|block_until_ready")
+# engine.py: device_get is itself the sanctioned idiom; everywhere a
+# '# sync-ok' comment discloses a reviewed, intentional sync
+ENGINE_ALLOW = re.compile(r"device_get|#\s*sync-ok")
+ALLOW_PATTERN = re.compile(r"#\s*sync-ok")
+
+# (path, functions to scan, hazard pattern, allow pattern)
+SCAN_TARGETS = [
+    (ENGINE_PATH, STEP_PATH_FUNCS, SYNC_PATTERN, ENGINE_ALLOW),
+    (PREFETCH_PATH, {"__next__", "close"}, BLOCKING_PATTERN, ALLOW_PATTERN),
+    (CKPT_PATH, {"save_train_state"}, CKPT_PATTERN, ALLOW_PATTERN),
+]
 
 
-def _function_spans(tree: ast.Module) -> List[Tuple[str, int, int]]:
-    """Module-level functions and class methods ONLY — nested defs are the
-    jit-traced inner closures (e.g. train_batch inside _make_train_batch),
-    where a float(...) runs once at trace time and is not a per-step sync."""
+def _function_spans(tree: ast.Module) -> List[Tuple[str, int, int, Set[int]]]:
+    """Module-level functions and class methods ONLY, each with the line
+    set of its nested defs.  Nested defs are either jit-traced inner
+    closures (e.g. train_batch inside _make_train_batch — a float(...)
+    there runs once at trace time, not per step) or background worker
+    bodies (e.g. _finish inside save_train_state — blocking there is the
+    point), so their lines are excluded from the scan."""
     spans = []
     defs = list(tree.body)
     for node in tree.body:
@@ -66,24 +101,36 @@ def _function_spans(tree: ast.Module) -> List[Tuple[str, int, int]]:
             defs.extend(node.body)
     for node in defs:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            spans.append((node.name, node.lineno, node.end_lineno))
+            nested: Set[int] = set()
+            for sub in ast.walk(node):
+                if (sub is not node
+                        and isinstance(sub, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))):
+                    nested.update(range(sub.lineno, sub.end_lineno + 1))
+            spans.append((node.name, node.lineno, node.end_lineno, nested))
     return spans
 
 
-def check_file(path: str = ENGINE_PATH) -> List[str]:
+def check_file(path: str = ENGINE_PATH,
+               funcs: Optional[Set[str]] = None,
+               pattern: re.Pattern = SYNC_PATTERN,
+               allow: re.Pattern = ENGINE_ALLOW) -> List[str]:
+    funcs = STEP_PATH_FUNCS if funcs is None else funcs
     with open(path) as f:
         source = f.read()
     tree = ast.parse(source)
     lines = source.splitlines()
     violations = []
-    for name, start, end in _function_spans(tree):
-        if name not in STEP_PATH_FUNCS or name in SANCTIONED_FUNCS:
+    for name, start, end, nested in _function_spans(tree):
+        if name not in funcs or name in SANCTIONED_FUNCS:
             continue
         for lineno in range(start, end + 1):
+            if lineno in nested:
+                continue
             line = lines[lineno - 1]
             code = line.split("#", 1)[0]   # the pattern must be in CODE,
             # while the sync-ok disclosure lives in the comment part
-            if SYNC_PATTERN.search(code) and not ALLOW_PATTERN.search(line):
+            if pattern.search(code) and not allow.search(line):
                 violations.append(
                     f"{os.path.relpath(path)}:{lineno} in {name}(): "
                     f"{line.strip()}")
@@ -93,25 +140,36 @@ def check_file(path: str = ENGINE_PATH) -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     ap = argparse.ArgumentParser(
-        description="flag per-scalar device syncs on the engine step path")
-    ap.add_argument("path", nargs="?", default=ENGINE_PATH)
+        description="flag undisclosed blocking syncs on the dispatch "
+                    "thread (engine step path, prefetch consumer surface, "
+                    "async checkpoint writer)")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="scan ONE file with the engine step-path rules "
+                    "(default: scan all built-in targets)")
     args = ap.parse_args(argv)
-    try:
-        violations = check_file(args.path)
-    except (OSError, SyntaxError) as e:
-        print(f"check_no_sync: cannot scan {args.path}: {e}",
-              file=sys.stderr)
-        return 2
+    targets = (SCAN_TARGETS if args.path is None
+               else [(args.path, STEP_PATH_FUNCS, SYNC_PATTERN,
+                      ENGINE_ALLOW)])
+    violations = []
+    for path, funcs, pattern, allow in targets:
+        try:
+            violations.extend(check_file(path, funcs, pattern, allow))
+        except (OSError, SyntaxError) as e:
+            print(f"check_no_sync: cannot scan {path}: {e}",
+                  file=sys.stderr)
+            return 2
     if violations:
-        print("check_no_sync: device-sync hazards on the engine step path\n"
+        print("check_no_sync: blocking-sync hazards on the dispatch thread\n"
               "(route scalars through _fetch_metrics / an explicit "
-              "device_get, or annotate a reviewed sync with '# sync-ok'):",
+              "device_get, move transfers to the worker thread, or "
+              "annotate a reviewed sync with '# sync-ok'):",
               file=sys.stderr)
         for v in violations:
             print(f"  {v}", file=sys.stderr)
         return 1
-    print(f"check_no_sync: OK — step path of {os.path.relpath(args.path)} "
-          f"is free of undisclosed host syncs")
+    scanned = ", ".join(os.path.relpath(p) for p, _, _, _ in targets)
+    print(f"check_no_sync: OK — {scanned} free of undisclosed "
+          f"dispatch-thread syncs")
     return 0
 
 
