@@ -130,14 +130,41 @@ def _extra_points(GPTChunkedLoss, GPTConfig, initialize, out=None,
                     "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
                     "bf16": {"enabled": True},
                     "zero_optimization": {"stage": 3},
+                    # chunked ZeRO-3 collectives + scheduler flags; the
+                    # telemetry AOT analysis feeds the exposed-comms columns
+                    "overlap": {"enabled": True, "num_chunks": 4},
+                    "telemetry": {"enabled": True, "trace_enabled": False,
+                                  "snapshot_interval": 0},
                     "mesh": {"fsdp": -1, "dp": 1}, "steps_per_print": 0},
             example_batch={"input_ids": np.zeros((B, T), np.int32)})
-        dt = _measure(eng, {"input_ids": rng.integers(
-            0, 50304, (B, T)).astype(np.int32)})
+        batch = {"input_ids": rng.integers(
+            0, 50304, (B, T)).astype(np.int32)}
+        # the flagship leg already set collective_exposed_ratio{fn=
+        # train_batch} in the shared registry — clear it so a failed HLO
+        # walk on THIS leg reads as missing, not as the stage-2 figure
+        from deepspeed_tpu.telemetry.registry import default_registry
+        gauge = default_registry.gauge("collective_exposed_ratio")
+        gauge.clear()
+        dt = _measure(eng, batch)
         flops = train_flops_per_step(eng.num_parameters, cfg.num_layers,
                                      cfg.hidden_size, B, T)
         out["zero3_tokens_per_sec"] = round(B * T / dt, 1)
         out["zero3_mfu"] = round(flops / dt / peak_flops_per_chip(), 4)
+        ratio = None
+        for labels, value in gauge.samples():
+            if labels.get("fn") == "train_batch":
+                ratio = float(value)
+        if ratio is None:
+            out["zero3_comm_exposed_error"] = "exposed-ratio gauge not set"
+        else:
+            out["zero3_collective_exposed_ratio"] = round(ratio, 4)
+            try:
+                comms = eng.profile_comms(batch, iters=2)
+                comm_ms = sum(v["time_s"] for v in comms.values()) * 1000.0
+                out["zero3_comm_total_ms"] = round(comm_ms, 3)
+                out["zero3_comm_exposed_ms"] = round(comm_ms * ratio, 3)
+            except Exception as e:  # noqa: BLE001
+                out["zero3_comm_exposed_error"] = str(e)[:120]
         del eng
     except Exception as e:  # noqa: BLE001
         out["zero3_error"] = str(e)[:120]
@@ -180,6 +207,12 @@ def _scale_point(GPTChunkedLoss, GPTConfig, initialize):
                     "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
                     "bf16": {"enabled": True},
                     "zero_optimization": {"stage": 3},
+                    # the [overlap] target leg: chunked stage-3 collectives
+                    # + scheduler flags (no telemetry here — the AOT
+                    # compile-for-analysis would double this leg's multi-
+                    # minute compile; the gpt2s zero3 leg carries the
+                    # exposed-comms columns)
+                    "overlap": {"enabled": True, "num_chunks": 4},
                     "mesh": {"fsdp": -1, "dp": 1}, "steps_per_print": 0},
             example_batch={"input_ids": np.zeros((B, T), np.int32)})
         rng = np.random.default_rng(0)
@@ -190,6 +223,7 @@ def _scale_point(GPTChunkedLoss, GPTConfig, initialize):
         out["zero3_0p8b_tokens_per_sec"] = round(B * T / dt, 1)
         out["zero3_0p8b_mfu"] = round(flops / dt / peak_flops_per_chip(), 4)
         out["zero3_0p8b_params_m"] = round(eng.num_parameters / 1e6, 1)
+        out["zero3_0p8b_num_chunks"] = 4
         del eng
     except Exception as e:  # noqa: BLE001
         out["zero3_0p8b_error"] = str(e)[:160]
@@ -350,6 +384,10 @@ def run_bench():
                                                   "weight_decay": 0.01}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 2},
+        # overlap regime on for the sweep: latency-hiding scheduler +
+        # async-collective XLA flags (chunking is a stage-3 knob — inert
+        # here, live on the zero3 legs below)
+        "overlap": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
         # telemetry rides the flagship leg: comms-byte + memory columns for
@@ -456,9 +494,27 @@ def run_bench():
             "host_step_overlap_ratio", {}).get("samples", [])]
         if overlap:  # only present on a ZeRO-Offload overlap_step leg
             extra["host_step_overlap_ratio"] = round(float(overlap[-1]), 4)
+        # exposed-comms columns: the static exposed fraction from the
+        # compiled-HLO walk (collective_exposed_ratio gauge), converted to
+        # ms with the profiler-measured per-collective latency — the
+        # collective time NOT hidden under compute on this leg
+        ratio = [s["value"] for s in snap.get("gauges", {}).get(
+            "collective_exposed_ratio", {}).get("samples", [])
+            if s.get("labels", {}).get("fn") == "train_batch"]
+        if ratio:
+            extra["collective_exposed_ratio"] = round(float(ratio[-1]), 4)
         extra["telemetry_snapshot"] = snap_path
     except Exception as e:  # noqa: BLE001 — telemetry must not kill the bench
         extra["telemetry_error"] = str(e)[:120]
+    try:
+        comms = engine.profile_comms(batch, iters=2)
+        comm_ms = sum(v["time_s"] for v in comms.values()) * 1000.0
+        extra["comm_total_ms"] = round(comm_ms, 3)
+        if "collective_exposed_ratio" in extra:
+            extra["comm_exposed_ms"] = round(
+                comm_ms * extra["collective_exposed_ratio"], 3)
+    except Exception as e:  # noqa: BLE001 — profiling must not kill the bench
+        extra["comm_exposed_error"] = str(e)[:120]
     del engine
 
     def emit():

@@ -11,6 +11,29 @@ On TPU these are XLA collectives over named mesh axes.  Point-to-point send/recv
 All wrappers record trace-time metadata into the CommsLogger so a comms summary with
 op counts/volumes is available for any jitted step (reference: timed_op decorator,
 comm/comm.py:101).
+
+Byte-accounting convention (normalized round 8 — previously all_gather logged
+its pre-gather shard, reduce_scatter its full pre-scatter input, and broadcast
+its payload despite the select+psum lowering, so cross-op ratios compared
+apples to oranges): every ``_log`` records **wire bytes** — the bytes ONE
+participant sends over the interconnect per execution, under the standard
+ring algorithm (the algorithmic-bandwidth lower bound, matching the
+reference's ``calc_bw_log`` "algo bandwidth" convention).  With per-device
+payload B and axis size n:
+
+    all_reduce        2·B·(n−1)/n     (reduce-scatter + all-gather phases)
+    all_gather        B·(n−1)         (B = the local shard; output is n·B)
+    reduce_scatter    B·(n−1)/n       (B = the full pre-scatter input)
+    all_to_all        B·(n−1)/n       (keeps 1/n locally)
+    broadcast         B·(n−1)/n       (ring average; the select+psum lowering
+                                       XLA rewrites to a real broadcast)
+    ppermute / shift  B               (every listed source sends its block)
+
+n = 1 (or an unknown axis outside a binding context) logs 0 wire bytes with
+the call still counted.  The ``chunked`` flag tags collectives issued by the
+overlap machinery (runtime/zero.chunked_param_gather) with a ``_chunked``
+kind suffix so byte assertions can separate the explicit chunk train from
+XLA's implicit collectives.
 """
 
 from __future__ import annotations
@@ -31,12 +54,26 @@ def _nbytes(x) -> int:
     return int(x.size) * x.dtype.itemsize
 
 
-def _log(name: str, x, axis: AxisName):
-    nbytes = _nbytes(x)
-    comms_logger.record(name, nbytes, str(axis))
+def _axis_world(axis: AxisName) -> int:
+    """Static axis size at trace time; 0 when the axis isn't bound (wrapper
+    called outside shard_map — the wire cost is then unknowable here).
+    ``lax.psum(1, axis)`` folds to the axis size as a python int on every
+    jax this package supports (``lax.axis_size`` is newer-jax only)."""
+    try:
+        names = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+        n = lax.psum(1, names)
+        return int(n)
+    except (NameError, KeyError, TypeError, ValueError):
+        return 0
+
+
+def _log(name: str, wire_bytes: int, axis: AxisName, chunked: bool = False):
+    if chunked:
+        name = name + "_chunked"
+    comms_logger.record(name, wire_bytes, str(axis))
     # telemetry counter registry (telemetry/registry.py): same trace-time
     # semantics as the comms logger, but labeled + snapshot-exportable
-    record_collective(name, nbytes, str(axis))
+    record_collective(name, wire_bytes, str(axis))
 
 
 def get_world_size(axis: AxisName) -> int:
@@ -51,7 +88,8 @@ def get_rank(axis: AxisName):
 
 def all_reduce(x: jax.Array, axis: AxisName, op: str = "sum") -> jax.Array:
     """reference: deepspeed.comm.all_reduce (comm/comm.py:486)."""
-    _log("all_reduce", x, axis)
+    n = _axis_world(axis)
+    _log("all_reduce", 2 * _nbytes(x) * (n - 1) // n if n > 1 else 0, axis)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "mean":
@@ -64,20 +102,25 @@ def all_reduce(x: jax.Array, axis: AxisName, op: str = "sum") -> jax.Array:
 
 
 def all_gather(x: jax.Array, axis: AxisName, *, tiled: bool = True,
-               gather_dim: int = 0) -> jax.Array:
+               gather_dim: int = 0, chunked: bool = False) -> jax.Array:
     """reference: deepspeed.comm.all_gather_into_tensor (comm/comm.py:308).
 
     tiled=True concatenates along gather_dim (the flat-tensor allgather ZeRO uses);
-    tiled=False stacks a new leading axis.
+    tiled=False stacks a new leading axis.  ``chunked`` tags collectives
+    issued by the overlap chunking machinery (module docstring).
     """
-    _log("all_gather", x, axis)
+    n = _axis_world(axis)
+    _log("all_gather", _nbytes(x) * (n - 1) if n > 1 else 0, axis,
+         chunked=chunked)
     return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x: jax.Array, axis: AxisName, *, scatter_dim: int = 0,
-                   tiled: bool = True) -> jax.Array:
+                   tiled: bool = True, chunked: bool = False) -> jax.Array:
     """reference: deepspeed.comm.reduce_scatter_tensor (comm/comm.py:332)."""
-    _log("reduce_scatter", x, axis)
+    n = _axis_world(axis)
+    _log("reduce_scatter", _nbytes(x) * (n - 1) // n if n > 1 else 0, axis,
+         chunked=chunked)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
 
 
@@ -88,7 +131,8 @@ def all_to_all(x: jax.Array, axis: AxisName, *, split_dim: int,
     The workhorse of MoE dispatch (moe/sharded_moe.py:455 _AllToAll) and Ulysses
     sequence parallelism (sequence/layer.py:15 single_all_to_all).
     """
-    _log("all_to_all", x, axis)
+    n = _axis_world(axis)
+    _log("all_to_all", _nbytes(x) * (n - 1) // n if n > 1 else 0, axis)
     return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
                           tiled=True)
 
@@ -99,7 +143,7 @@ def permute(x: jax.Array, axis: AxisName, perm: Sequence[tuple]) -> jax.Array:
     reference: runtime/pipe/p2p.py send/recv between adjacent pipeline stages —
     here a single ppermute that XLA schedules on neighbor ICI links.
     """
-    _log("ppermute", x, axis)
+    _log("ppermute", _nbytes(x), axis)
     return lax.ppermute(x, axis, perm=list(perm))
 
 
@@ -115,7 +159,8 @@ def broadcast(x: jax.Array, axis: AxisName, root: int = 0) -> jax.Array:
 
     Implemented as select-root + psum (XLA lowers this to an efficient broadcast).
     """
-    _log("broadcast", x, axis)
+    n = _axis_world(axis)
+    _log("broadcast", _nbytes(x) * (n - 1) // n if n > 1 else 0, axis)
     idx = lax.axis_index(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
     return lax.psum(masked, axis)

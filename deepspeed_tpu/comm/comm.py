@@ -200,6 +200,115 @@ def hlo_collective_bytes(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+_COMPUTE_OP_RE = None
+_COLLECTIVE_RE = None
+
+
+def hlo_overlap_stats(hlo_text: str) -> Dict[str, object]:
+    """Structural compute–collective overlap evidence from compiled HLO.
+
+    Two independent signals, matching the two ways XLA can hide a
+    collective:
+
+    - **async pairs**: ``<kind>-start`` / ``<kind>-done`` split ops with
+      compute instructions scheduled between them — the latency-hiding
+      scheduler's output on TPU.  A pair with zero compute between start
+      and done is async in name only (still exposed).
+    - **interleaved chunk trains**: >= 2 same-kind collectives in one
+      computation with compute between consecutive ones — what the
+      explicit chunk decomposition (runtime/zero.chunked_param_gather,
+      ops/collective_matmul.py) produces even on backends that never
+      split ops (the CPU CI), and the structure the scheduler needs to
+      overlap on TPU.
+
+    Returns counts/bytes per signal plus ``exposed_ratio``: the
+    bytes-weighted fraction of collective payload on ops with NO overlap
+    evidence (sync AND not interleaved, or async with empty windows) —
+    the static stand-in for the profiler's exposed-comms time, exported
+    as the ``collective_exposed_ratio`` telemetry gauge.
+
+    Byte accounting: sync ops count their output payload (same line
+    ``hlo_collective_bytes`` reads); async pairs count the ``-done``
+    result payload, which is NOT the same number ``hlo_collective_bytes``
+    attributes to the pair (it reads the ``-start`` line's tuple —
+    operand buffers + result).  ``exposed_ratio`` is internally
+    consistent either way; do not difference this function's bytes
+    against ``hlo_collective_bytes`` on async-heavy traces.
+    """
+    import re
+    global _COMPUTE_OP_RE, _COLLECTIVE_RE
+    if _COLLECTIVE_RE is None:
+        _COLLECTIVE_RE = re.compile(
+            r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
+            r"(" + "|".join(_COLLECTIVE_KINDS) + r")(-start|-done)?\(")
+        _COMPUTE_OP_RE = re.compile(
+            r"=\s*(?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
+            r"(fusion|dot|convolution)\(")
+
+    def shape_bytes(shape_s: str) -> int:
+        if shape_s.startswith("("):
+            return sum(_shape_bytes(s) for s in
+                       re.findall(r"[a-z0-9]+\[[0-9,]*\]", shape_s))
+        return _shape_bytes(shape_s)
+
+    stats = {
+        "collectives": 0, "collective_bytes": 0,
+        "async_pairs": 0, "async_pairs_with_compute": 0,
+        "async_hidden_bytes": 0,
+        "sync_collectives": 0,
+        "interleaved": 0, "interleaved_bytes": 0,
+        "per_kind_interleaved": {},
+    }
+    exposed_bytes = 0
+    # per-computation state (a header line ending in '{' starts a new one)
+    pending: Dict[str, list] = {}
+    compute_seen = 0
+    last_kind_compute: Dict[str, int] = {}
+
+    for line in hlo_text.splitlines():
+        if line.rstrip().endswith("{"):
+            pending, compute_seen, last_kind_compute = {}, 0, {}
+            continue
+        if _COMPUTE_OP_RE.search(line):
+            compute_seen += 1
+            continue
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        shape_s, kind, phase = m.group(1), m.group(2), m.group(3)
+        if phase == "-start":
+            pending.setdefault(kind, []).append(compute_seen)
+            continue
+        nbytes = shape_bytes(shape_s)
+        stats["collectives"] += 1
+        stats["collective_bytes"] += nbytes
+        if phase == "-done":
+            starts = pending.get(kind)
+            between = compute_seen - starts.pop(0) if starts else 0
+            stats["async_pairs"] += 1
+            if between > 0:
+                stats["async_pairs_with_compute"] += 1
+                stats["async_hidden_bytes"] += nbytes
+            else:
+                exposed_bytes += nbytes
+        else:
+            stats["sync_collectives"] += 1
+            prev = last_kind_compute.get(kind)
+            if prev is not None and compute_seen > prev:
+                stats["interleaved"] += 1
+                stats["interleaved_bytes"] += nbytes
+                stats["per_kind_interleaved"][kind] = (
+                    stats["per_kind_interleaved"].get(kind, 0) + 1)
+            else:
+                exposed_bytes += nbytes
+        last_kind_compute[kind] = compute_seen
+    stats["exposed_bytes"] = exposed_bytes
+    stats["exposed_ratio"] = (
+        exposed_bytes / stats["collective_bytes"]
+        if stats["collective_bytes"] else 0.0)
+    return stats
+
+
 def profile_jitted(fn, *args, iters: int = 2) -> Dict[str, Dict[str, float]]:
     """Per-collective bytes + MEASURED on-device latency for one jitted
     callable, recorded into the comms logger so ``log_summary`` reports

@@ -124,6 +124,71 @@ class ZeroConfig(DeepSpeedConfigModel):
     sub_group_size: int = 1_000_000_000
 
 
+class OverlapConfig(DeepSpeedConfigModel):
+    """Device-side compute–collective overlap (T3, arXiv:2401.16677; The Big
+    Send-off, arXiv:2504.18658).  No single reference analog — the reference
+    hides ZeRO-3 gathers with its prefetch coordinator
+    (partitioned_param_coordinator.py); on TPU the same latency is hidden by
+    (a) XLA's latency-hiding scheduler + async-collective fusion, steered by
+    the flags this block composes (runtime/overlap.py — applied by the engine
+    BEFORE client/backend init, because XLA reads them once), (b) chunking
+    the ZeRO-3 flat param all-gather / grad reduce-scatter into
+    ``num_chunks`` per-layer-group collectives the scheduler can interleave
+    with neighboring matmuls (runtime/zero.chunked_param_gather), and (c)
+    explicit ``ppermute``-ring collective-matmul fusions on the TP
+    row/column-parallel matmuls (ops/collective_matmul.py).
+
+    Every trace records the scheduler regime it ran under: the resolved
+    block + effective XLA_FLAGS land in the telemetry snapshot, the
+    postmortem bundle, and ``python -m deepspeed_tpu`` (env_report).
+    """
+
+    enabled: bool = False
+    # ZeRO-3 collective chunking: the per-step param gather (and its
+    # transpose, the grad reduce-scatter) is decomposed into this many
+    # byte-balanced per-layer-group flat collectives; 1 = leave the gathers
+    # to XLA's per-consumer insertion (the seed behavior)
+    num_chunks: int = 1
+    # --xla_latency_hiding_scheduler_rerun=<n> (re-run the scheduler n extra
+    # times with relaxed memory limits when it failed to hide latency)
+    latency_hiding_scheduler: bool = True
+    scheduler_rerun: int = 1
+    # --xla_tpu_enable_async_collective_fusion* family: split collectives
+    # into start/done pairs and let compute schedule between them
+    async_collectives: bool = True
+    # --xla_tpu_scheduler_percent_shared_memory_limit=<pct>: how much memory
+    # headroom the latency-hiding scheduler may spend on in-flight
+    # collectives (100 = the compiler default envelope)
+    scheduler_memory_limit_pct: int = 100
+    # route the TP row-parallel matmuls (gpt.py MLP down-projection and
+    # attention output projection; linear.OptimizedLinear) through the
+    # explicit ppermute-ring collective-matmul fusions
+    collective_matmul: bool = False
+    # escape hatch: extra --xla_* flags appended verbatim (validated shape)
+    extra_xla_flags: list = Field(default_factory=list)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.num_chunks < 1:
+            raise ValueError(
+                f"overlap.num_chunks must be >= 1, got {self.num_chunks}")
+        if self.scheduler_rerun < 0:
+            raise ValueError(
+                f"overlap.scheduler_rerun must be >= 0, "
+                f"got {self.scheduler_rerun}")
+        if not 0 < self.scheduler_memory_limit_pct <= 1000:
+            raise ValueError(
+                f"overlap.scheduler_memory_limit_pct must be in (0, 1000], "
+                f"got {self.scheduler_memory_limit_pct}")
+        for f in self.extra_xla_flags:
+            if not (isinstance(f, str) and f.startswith("--xla")
+                    and "=" in f):
+                raise ValueError(
+                    f"overlap.extra_xla_flags entries must look like "
+                    f"'--xla_...=value', got {f!r}")
+        return self
+
+
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-specific: device mesh axis sizes (replaces reference mpu / groups.py).
 
@@ -419,6 +484,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     fp16: FP16Config = Field(default_factory=FP16Config)
     bf16: BF16Config = Field(default_factory=BF16Config)
     zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    overlap: OverlapConfig = Field(default_factory=OverlapConfig)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig)

@@ -93,6 +93,12 @@ class DeepSpeedTPUEngine:
                  lr_scheduler: Optional[Callable[[int], float]] = None,
                  client_optimizer: Optional[optax.GradientTransformation] = None):
         self.config = config
+        # overlap regime FIRST — XLA_FLAGS are parsed once at backend init,
+        # so the latency-hiding/async-collective flags must be exported
+        # before any jax backend touch (runtime/overlap.py warns when the
+        # backend beat us to it)
+        from deepspeed_tpu.runtime.overlap import apply_overlap_flags
+        apply_overlap_flags(config.overlap)
         comm.init_distributed()
         comm.comms_logger.configure(config.comms_logger.enabled,
                                     config.comms_logger.verbose)
@@ -285,6 +291,22 @@ class DeepSpeedTPUEngine:
             import dataclasses as _dc
             model = model.clone(cfg=_dc.replace(model.cfg,
                                                 act_quant_bits=act_bits))
+        # overlap.collective_matmul: route the model's TP row-parallel
+        # matmuls through the explicit ppermute-ring fusions
+        # (ops/collective_matmul.py) — ds_config is the single source of
+        # truth, like the random-LTD / activation-quant knobs above
+        if config.overlap.enabled and config.overlap.collective_matmul:
+            if (hasattr(model, "clone") and hasattr(model, "cfg")
+                    and hasattr(model.cfg, "tp_collective_matmul")):
+                if not getattr(model.cfg, "tp_collective_matmul"):
+                    import dataclasses as _dc
+                    model = model.clone(cfg=_dc.replace(
+                        model.cfg, tp_collective_matmul=True))
+            else:
+                logger.warning(
+                    "overlap.collective_matmul set but the model config has "
+                    "no tp_collective_matmul knob (models/gpt.py GPT) — the "
+                    "ring collective-matmul fusions are inert for this model")
         # progressive layer drop (reference engine.progressive_layer_drop
         # built at initialize() when the config block is enabled)
         pld_cfg = config.progressive_layer_drop
@@ -474,21 +496,46 @@ class DeepSpeedTPUEngine:
         self._qwz_dims = None
         if (config.zero_optimization.zero_quantized_weights
                 and self.zero_stage >= 3 and mesh.shape["fsdp"] > 1):
-            def fsdp_dim(sh):
-                # -1 sentinel = leaf not fsdp-sharded (None would vanish as an
-                # empty pytree under tree_map); dims co-sharded with another
-                # axis (tuple specs) keep the partitioner's implicit gather
-                for d, ax in enumerate(sh.spec):
-                    if ax == "fsdp":
-                        return d
-                return -1
-            self._qwz_dims = jax.tree_util.tree_map(fsdp_dim,
-                                                    self.param_shardings)
+            # -1 sentinel = leaf not fsdp-sharded; dims co-sharded with
+            # another axis (tuple specs) keep the partitioner's implicit
+            # gather (parallel/partition.py sharded_dim)
+            self._qwz_dims = partition.fsdp_shard_dims(self.param_shardings)
         elif (config.zero_optimization.zero_quantized_weights
               and self.zero_stage >= 3):
             logger.warning("zero_quantized_weights set but the fsdp mesh axis "
                            "is 1 — there is no weight all-gather to quantize; "
                            "flag is inert on this mesh")
+
+        # overlap.num_chunks: decompose the stage-3 param all-gather (and,
+        # via the transpose, the grad reduce-scatter) into per-layer-group
+        # chunks the latency-hiding scheduler can interleave with matmuls
+        # (runtime/zero.chunked_param_gather)
+        ov = config.overlap
+        self._gather_chunks = 0
+        if ov.enabled and ov.num_chunks > 1:
+            if self.zero_stage < 3 or mesh.shape["fsdp"] <= 1:
+                logger.warning(
+                    "overlap.num_chunks=%d set but there is no stage-3 "
+                    "param all-gather to chunk (stage %d, fsdp=%d) — "
+                    "chunking is inert on this config; the XLA scheduler "
+                    "flags still apply", ov.num_chunks, self.zero_stage,
+                    mesh.shape["fsdp"])
+            elif self._qwz_dims is not None:
+                raise ValueError(
+                    "overlap.num_chunks > 1 and zero_quantized_weights both "
+                    "take over the stage-3 param gather — chunking the int8 "
+                    "qwZ gather is not wired; pick one")
+            elif self._qgz_axis is not None:
+                raise NotImplementedError(
+                    "overlap.num_chunks > 1 with zero_quantized_gradients: "
+                    "the chunked-gather shard_map cannot nest inside the "
+                    "manual-dp qgZ gradient region")
+            else:
+                self._gather_chunks = int(ov.num_chunks)
+                log_dist(
+                    f"overlap: stage-3 param gather decomposed into "
+                    f"{self._gather_chunks} per-layer-group chunks over "
+                    f"'fsdp' ({mesh.shape['fsdp']} ways)", ranks=[0])
 
         # numerics health monitor (telemetry.health): per-group stats are
         # traced INTO the step programs, so the flags must exist before
@@ -817,6 +864,13 @@ class DeepSpeedTPUEngine:
                     return p
                 return quantized_weight_gather(p, mesh, "fsdp", d)
             params = jax.tree_util.tree_map(gather, params, self._qwz_dims)
+        if self._gather_chunks:
+            # overlap.num_chunks: explicit per-layer-group chunked gather
+            # replaces the partitioner's per-consumer all-gathers; its
+            # autodiff transpose is the chunked grad reduce-scatter
+            from deepspeed_tpu.runtime.zero import chunked_param_gather
+            params = chunked_param_gather(params, self.param_shardings,
+                                          self.mesh, self._gather_chunks)
         if self.pld is not None and step is not None:
             # theta is a pure function of the step — computed in-graph, so
             # PLD adds zero host↔device traffic (reference updates it on the

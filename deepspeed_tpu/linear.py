@@ -59,6 +59,13 @@ class OptimizedLinear(nn.Module):
     # logical axes for the base weight (partition.py DEFAULT_RULES map these
     # to mesh axes; "embed"/"mlp" gives the usual tp/fsdp placement)
     axis_names: Tuple[str, str] = ("embed", "mlp")
+    # route a ROW-parallel base matmul (input axis mapped to tp — e.g.
+    # axis_names=("mlp", "embed")) through the ppermute-ring fusion
+    # (ops/collective_matmul.py): the output all-reduce decomposes into
+    # chunk matmuls interleaved with neighbor hops.  Needs ``mesh``; inert
+    # for column-parallel placements (no boundary collective to fuse).
+    mesh: Optional[Any] = None
+    collective_matmul: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -78,7 +85,24 @@ class OptimizedLinear(nn.Module):
             w = w + jax.lax.stop_gradient(
                 quantize_dequantize(w, bits=qc.q_bits,
                                     block_size=qc.group_size) - w)
-        y = x.astype(self.dtype) @ w
+        ring = False
+        if self.collective_matmul and self.mesh is not None:
+            from deepspeed_tpu.parallel.partition import DEFAULT_RULES
+            tp = self.mesh.shape.get("tp", 1)
+            ring = (tp > 1
+                    and dict(DEFAULT_RULES).get(shard_axes[0]) == "tp")
+            if ring and (x.ndim != 3 or x.shape[1] % tp
+                         or self.input_dim % tp):
+                raise ValueError(
+                    f"collective_matmul row-parallel base needs [B, T, in] "
+                    f"input with T and in dividing tp={tp}, got x "
+                    f"{x.shape}, in={self.input_dim}")
+        if ring:
+            from deepspeed_tpu.ops import collective_matmul as cm_ops
+            y = cm_ops.row_parallel_matmul(x.astype(self.dtype), w,
+                                           self.mesh)
+        else:
+            y = x.astype(self.dtype) @ w
         if lc is not None and lc.lora_r > 0:
             a = self.param(
                 "lora_a",

@@ -75,6 +75,13 @@ class GPTConfig:
     # kernel selection (reference: replace_with_kernel_inject / DS_BUILD flags);
     # None = registry auto (pallas flash on TPU, XLA elsewhere)
     attn_impl: Optional[str] = None
+    # route the TP row-parallel matmuls (MLP down-projection, attention
+    # output projection) through the explicit ppermute-ring
+    # collective-matmul fusions (ops/collective_matmul.py) so the TP
+    # all-reduce overlaps the chunk matmuls; set by the engine from
+    # ``overlap.collective_matmul``.  Inert at tp=1; loud error on unwired
+    # combinations (sequence parallelism, non-dividing shapes).
+    tp_collective_matmul: bool = False
     # chunked unembed+CE (ops/cross_entropy.py); 0 = one-shot logits
     loss_chunk: int = 0
     # HF-architecture knobs (checkpoint/hf.py maps real configs onto these):
@@ -209,6 +216,30 @@ def _pin_activations(x, mesh, seq_parallel: bool):
             and x.shape[1] % sp == 0):
         spec[1] = "sp"
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def _collective_matmul_active(cfg, mesh, t: int, k: int,
+                              use_cache: bool = False) -> bool:
+    """Gate for routing a row-parallel matmul through the ring
+    collective-matmul fusion (ops/collective_matmul.py).  False when there
+    is nothing to fuse (flag off, no mesh, tp=1, or a decode/cache call
+    whose T=1 has no sequence to chunk); RAISES on combinations the fusion
+    is not wired for — an opt-in perf flag must not silently degrade."""
+    if not cfg.tp_collective_matmul or mesh is None or use_cache:
+        return False
+    tp = mesh.shape.get("tp", 1)
+    if tp <= 1:
+        return False
+    if cfg.sequence_parallel:
+        raise ValueError(
+            "tp_collective_matmul + sequence parallelism is not wired (the "
+            "sp attention paths own the sequence dim the ring would chunk)")
+    if t % tp or k % tp:
+        raise ValueError(
+            f"tp_collective_matmul: seq len {t} and contraction dim {k} "
+            f"must both divide tp={tp} (the ring chunks the sequence and "
+            f"shards the contraction)")
+    return True
 
 
 def _kernel_init():
@@ -409,8 +440,21 @@ class Attention(nn.Module):
                          (H,), c.param_dtype)
               if c.attn_out_bias else None)
 
+        cm_fused = _collective_matmul_active(c, self.mesh, T, nh * hd,
+                                             use_cache=use_cache)
+
         def out_proj(o):
-            y = jnp.einsum("btnd,ndh->bth", o, wo.astype(x.dtype))
+            if cm_fused:
+                # row-parallel over tp-sharded heads: the output all-reduce
+                # decomposed into ring chunk matmuls + neighbor hops
+                # (ops/collective_matmul.py row_parallel_matmul)
+                from deepspeed_tpu.ops import collective_matmul as cm_ops
+                Bo, To = o.shape[0], o.shape[1]
+                y = cm_ops.row_parallel_matmul(
+                    o.reshape(Bo, To, nh * hd),
+                    wo.astype(x.dtype).reshape(nh * hd, H), self.mesh)
+            else:
+                y = jnp.einsum("btnd,ndh->bth", o, wo.astype(x.dtype))
             return y if bo is None else y + bo.astype(x.dtype)
 
         q = jnp.einsum("bth,hnd->btnd", x, wq.astype(x.dtype))
@@ -549,9 +593,10 @@ class Attention(nn.Module):
 
 class MLP(nn.Module):
     cfg: GPTConfig
+    mesh: Optional[object] = None
 
     @nn.compact
-    def __call__(self, x, deterministic: bool):
+    def __call__(self, x, deterministic: bool, use_cache: bool = False):
         c = self.cfg
         if c.act_quant_bits:
             from deepspeed_tpu.compression.pruning import quant_act
@@ -573,7 +618,14 @@ class MLP(nn.Module):
             h = mlp_activation(c.activation)(h)
         if c.dropout > 0 and not deterministic:
             h = nn.Dropout(rate=c.dropout)(h, deterministic=False)
-        y = h @ wo.astype(x.dtype)
+        if _collective_matmul_active(c, self.mesh, x.shape[1], M,
+                                     use_cache=use_cache):
+            # row-parallel down-projection: the tp all-reduce decomposed
+            # into a ring of chunk matmuls + neighbor hops
+            from deepspeed_tpu.ops import collective_matmul as cm_ops
+            y = cm_ops.row_parallel_matmul(h, wo.astype(x.dtype), self.mesh)
+        else:
+            y = h @ wo.astype(x.dtype)
         if c.mlp_bias:
             y = y + self.param("bo", _part(nn.initializers.zeros, ("embed",)),
                                (H,), c.param_dtype).astype(x.dtype)
@@ -624,7 +676,9 @@ class Block(nn.Module):
                                              use_cache, kv_mask, start_index,
                                              kv_positions, window=window,
                                              fused_ok=fused_ok)
-            return (x + pld_gate(a) + pld_gate(MLP(c)(h_mlp, deterministic)),
+            return (x + pld_gate(a)
+                    + pld_gate(MLP(c, mesh=self.mesh)(h_mlp, deterministic,
+                                                      use_cache=use_cache)),
                     jnp.float32(0.0))
         x = x + pld_gate(
             Attention(c, mesh=self.mesh)(Norm(c)(x), positions,
@@ -654,7 +708,9 @@ class Block(nn.Module):
             x = x + moe_out
         else:
             aux = jnp.float32(0.0)
-            x = x + pld_gate(MLP(c)(Norm(c)(x), deterministic))
+            x = x + pld_gate(MLP(c, mesh=self.mesh)(Norm(c)(x),
+                                                    deterministic,
+                                                    use_cache=use_cache))
         return x, aux
 
 

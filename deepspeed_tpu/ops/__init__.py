@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.ops import registry
 from deepspeed_tpu.ops.cross_entropy import lm_cross_entropy, masked_nll_sum
-from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.flash_attention import (configure_flash_blocks,
+                                               flash_attention)
 from deepspeed_tpu.ops.norms import layer_norm, rms_norm
 from deepspeed_tpu.ops.registry import dispatch, list_ops, op_report, register_op
 
@@ -128,6 +129,12 @@ register_op("sparse_attention", xla=_sparse._sparse_xla,
             pallas=_sparse._sparse_pallas,
             supported=_sparse.block_sparse_supported)
 
+# ring collective-matmul fusions (registers all_gather_matmul /
+# matmul_reduce_scatter / row_parallel_matmul on import)
+from deepspeed_tpu.ops import collective_matmul  # noqa: E402
+from deepspeed_tpu.ops.collective_matmul import (  # noqa: E402
+    all_gather_matmul, matmul_reduce_scatter, row_parallel_matmul)
+
 
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
@@ -146,7 +153,10 @@ def causal_attention(q, k, v, *, causal: bool = True,
                     window=window, alibi_slopes=alibi_slopes, impl=impl)
 
 
-__all__ = ["causal_attention", "flash_attention", "paged_attention",
+__all__ = ["causal_attention", "flash_attention", "configure_flash_blocks",
+           "paged_attention",
            "ragged_prefill_attention", "evoformer_attention",
+           "all_gather_matmul", "matmul_reduce_scatter",
+           "row_parallel_matmul", "collective_matmul",
            "lm_cross_entropy", "masked_nll_sum", "rms_norm", "layer_norm",
            "op_report", "register_op", "dispatch", "list_ops", "registry"]

@@ -24,6 +24,7 @@ match the model code; kernels run on [B, N, T, D].
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -35,6 +36,70 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30  # finite "minus infinity": avoids inf-inf NaNs in rescaling
+
+# per-seq-len (bq, bk) overrides: the baked-in `_block_pair` table came from
+# ONE v5e sweep (B4·H12·D64) and the T=4096 regression (r05 MFU 0.425 vs
+# 0.50 dense) showed it does not transfer — so the table is overridable
+# without a code change: ``configure_flash_blocks({4096: (512, 1024)})`` or
+# env ``DSTPU_FLASH_BLOCKS="4096:512x1024,8192:512x1024"``.
+# scripts/sweep_flash_blocks.py measures candidates on the current hardware
+# and prints the winning env line.
+_BLOCK_OVERRIDES = None   # None = not yet resolved from env; {} = none
+
+
+def _parse_block_spec(spec: str):
+    """'4096:512x1024,8192:512' → {4096: (512, 1024), 8192: (512, 512)}."""
+    out = {}
+    for part in spec.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            t_s, blocks = part.split(":")
+            bq_s, _, bk_s = blocks.partition("x")
+            bq = int(bq_s)
+            bk = int(bk_s) if bk_s else bq
+            out[int(t_s)] = (bq, bk)
+        except ValueError as e:
+            raise ValueError(
+                f"bad flash block spec {part!r} (want 'T:BQxBK' or 'T:B'): "
+                f"{e}") from e
+    return out
+
+
+def _validate_blocks(overrides) -> dict:
+    mapping = {}
+    for t, pair in dict(overrides).items():
+        bq, bk = int(pair[0]), int(pair[1])
+        if int(t) < 8 or bq < 8 or bk < 8:
+            raise ValueError(
+                f"flash block override T={t}: ({bq}, {bk}) — seq len and "
+                f"blocks must be >= 8")
+        mapping[int(t)] = (bq, bk)
+    return mapping
+
+
+def configure_flash_blocks(overrides=None):
+    """Install (bq, bk) overrides keyed by sequence length; ``None`` resets
+    to the ``DSTPU_FLASH_BLOCKS`` env (or the built-in table when unset).
+    Divisibility is validated at use time (T is only known then); shape
+    sanity is validated here — on BOTH paths, so a typo'd env spec raises
+    a clear ValueError instead of a ZeroDivisionError inside kernel
+    tracing.  Returns the active mapping."""
+    global _BLOCK_OVERRIDES
+    if overrides is None:
+        env = os.environ.get("DSTPU_FLASH_BLOCKS", "")
+        overrides = _parse_block_spec(env) if env else {}
+    _BLOCK_OVERRIDES = _validate_blocks(overrides)
+    return dict(_BLOCK_OVERRIDES)
+
+
+def flash_block_overrides():
+    """The active override table (env resolved lazily on first use)."""
+    global _BLOCK_OVERRIDES
+    if _BLOCK_OVERRIDES is None:
+        configure_flash_blocks(None)
+    return _BLOCK_OVERRIDES
 
 
 def _block_sizes(t: int, prefer: int = DEFAULT_BLOCK_Q):
@@ -64,7 +129,21 @@ def _block_pair(t: int, d: int = 64, window=None):
     skipped), and head_dim > 128 stays square (the d-scaled q/k/v/acc
     tiles stack on the D-independent 4 MB fp32 score tile; the sweep only
     validated VMEM fit up to d=128, and an over-full tile is a hard
-    compile error, not a fallback)."""
+    compile error, not a fallback).
+
+    An entry in the override table (``configure_flash_blocks`` /
+    ``DSTPU_FLASH_BLOCKS``) wins over everything INCLUDING the gates — it
+    is an explicit hardware-tuned choice (scripts/sweep_flash_blocks.py);
+    only T-divisibility is still enforced (a non-dividing block is a wrong
+    grid, not a tuning choice)."""
+    ov = flash_block_overrides()
+    if t in ov:
+        bq, bk = ov[t]
+        if t % bq or t % bk:
+            raise ValueError(
+                f"flash block override for T={t}: ({bq}, {bk}) must divide "
+                f"the sequence length")
+        return bq, bk
     bq = _block_sizes(t)
     if window is not None or d > 128:
         return bq, bq

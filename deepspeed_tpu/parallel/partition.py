@@ -173,6 +173,74 @@ def state_leaf_shardings(abstract_params, mesh: Mesh, zero_stage: int,
     return jax.tree_util.tree_map(fn, abstract_params)
 
 
+def sharded_dim(spec: P, axis: str = "fsdp") -> int:
+    """Dim index a PartitionSpec shards over ``axis`` alone, or -1.
+
+    -1 sentinel (not None: None leaves vanish as empty pytrees under
+    tree_map) covers both unsharded leaves and dims co-sharded with another
+    axis (tuple specs) — those keep the partitioner's implicit handling.
+    Single source of truth for the qwZ quantized gather and the chunked
+    overlap gather (engine + runtime/zero.py)."""
+    for d, ax in enumerate(spec):
+        if ax == axis:
+            return d
+    return -1
+
+
+def fsdp_shard_dims(shardings, axis: str = "fsdp"):
+    """Per-leaf ``sharded_dim`` over a NamedSharding tree (the engine's
+    gather-planning view: which dim of each param the ZeRO-3 gather
+    reconstructs)."""
+    return jax.tree_util.tree_map(lambda sh: sharded_dim(sh.spec, axis),
+                                  shardings)
+
+
+def spec_without_axis(spec: P, axis: str) -> P:
+    """PartitionSpec with ``axis`` removed from every dim (the post-gather
+    layout of a chunk-gathered leaf: fsdp dropped, tp/ep kept)."""
+    out = []
+    for ax in spec:
+        axes = tuple(a for a in (ax if isinstance(ax, tuple) else (ax,))
+                     if a is not None and a != axis)
+        out.append(axes[0] if len(axes) == 1 else (axes or None))
+    return P(*out)
+
+
+def layer_groups(sizes: Sequence[int], num_groups: int) -> Tuple[Tuple[int, ...], ...]:
+    """Partition leaf indices 0..n-1 into ``num_groups`` CONTIGUOUS groups,
+    greedily balanced by byte size.  Contiguity matters: tree-flatten order
+    is roughly layer order for the models here, so each group is a "layer
+    group" whose gather the scheduler can interleave with the previous
+    group's matmuls (the reference's coalesced-subgroup gather,
+    partition_parameters.py all_gather_coalesced, as a static plan)."""
+    n = len(sizes)
+    num_groups = max(1, min(int(num_groups), n))
+    total = sum(sizes)
+    groups, cur, acc, closed = [], [], 0, 0
+    for i, s in enumerate(sizes):
+        cur.append(i)
+        acc += s
+        remaining_items = n - i - 1
+        remaining_slots = num_groups - len(groups) - 1
+        if remaining_slots <= 0:
+            continue
+        # dynamic target (bytes left / slots left incl. this one): a static
+        # total/num_groups target never closes early groups when the bytes
+        # are tail-skewed (e.g. a late wte embedding holding half the
+        # params would silently collapse everything into ONE group); the
+        # forced close guarantees every requested group materializes while
+        # enough items remain to fill the rest one-each
+        dyn_target = (total - closed) / (remaining_slots + 1)
+        if (remaining_items == remaining_slots
+                or (acc >= dyn_target and remaining_items >= remaining_slots)):
+            groups.append(tuple(cur))
+            closed += acc
+            cur, acc = [], 0
+    if cur:
+        groups.append(tuple(cur))
+    return tuple(groups)
+
+
 def opt_state_shardings(abstract_opt_state, abstract_params, mesh: Mesh,
                         zero_stage: int,
                         rules: Optional[Sequence[Tuple[str, Any]]] = None,

@@ -1,0 +1,146 @@
+"""Compute–collective overlap: the XLA scheduler-regime half.
+
+The ``overlap`` config block (config.py OverlapConfig) has three levers; this
+module owns the first — steering XLA's latency-hiding scheduler and
+async-collective fusion via ``XLA_FLAGS``.  The other two (chunked ZeRO-3
+collectives, ring collective-matmul fusions) live in runtime/zero.py and
+ops/collective_matmul.py.
+
+Reference parity: DeepSpeed hides ZeRO-3 gather latency with a Python-side
+prefetch coordinator (runtime/zero/partitioned_param_coordinator.py) and
+``overlap_comm`` bucketing (stage_1_and_2.py).  On TPU the machinery is the
+COMPILER's: XLA splits collectives into ``-start``/``-done`` pairs and its
+latency-hiding scheduler moves compute between them — but only under the
+right flags, and those flags are parsed ONCE, at backend initialization.
+Hence the contract here:
+
+- ``apply_overlap_flags(cfg)`` must run BEFORE the first jax backend touch
+  (the engine calls it first thing in ``__init__``, before
+  ``comm.init_distributed``; ``deepspeed_tpu.initialize`` reaches it through
+  engine construction).  If the backend is already up, the flags are still
+  exported (child processes, launcher re-exec inherit them) but this
+  process's compiles keep the old regime — a loud warning says so.
+- user-set flags win: a flag already present in ``XLA_FLAGS`` is never
+  overridden, only recorded.
+- the *effective* regime is observable everywhere: ``effective_xla_flags``
+  feeds env_report, the telemetry snapshot, and the postmortem bundle, so
+  every trace records the scheduler regime it ran under.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+# flags composed when overlap.enabled (TPU-backend names; harmless no-ops on
+# CPU where the CI runs — XLA ignores unknown-target flags it can't apply)
+_ASYNC_COLLECTIVE_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def compose_xla_flags(cfg) -> List[str]:
+    """The flag list the ``overlap`` block resolves to (pure function — the
+    validation/echo surface for tests, env_report and telemetry)."""
+    if not cfg.enabled:
+        return []
+    flags: List[str] = []
+    if cfg.async_collectives:
+        flags.extend(_ASYNC_COLLECTIVE_FLAGS)
+    if cfg.latency_hiding_scheduler:
+        flags.append("--xla_latency_hiding_scheduler_rerun="
+                     f"{int(cfg.scheduler_rerun)}")
+        flags.append("--xla_tpu_scheduler_percent_shared_memory_limit="
+                     f"{int(cfg.scheduler_memory_limit_pct)}")
+    flags.extend(cfg.extra_xla_flags)
+    return flags
+
+
+def _flag_name(flag: str) -> str:
+    return flag.split("=", 1)[0]
+
+
+def _backend_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+        return xla_bridge.backends_are_initialized()
+    except Exception:  # noqa: BLE001 — private API moved; assume the worst
+        return True
+
+
+def tpu_target() -> bool:
+    """Will this process run on a TPU backend?  Decided WITHOUT initializing
+    jax (that would freeze XLA_FLAGS): explicit JAX_PLATFORMS wins, else the
+    presence of a libtpu install.  Matters because XLA *aborts the process*
+    (parse_flags_from_env.cc FATAL) on flags its backend build doesn't know —
+    exporting --xla_tpu_* into a CPU run is a crash, not a no-op."""
+    plats = os.environ.get("JAX_PLATFORMS", "").lower()
+    if plats:
+        return "tpu" in plats or "axon" in plats
+    import importlib.util
+    try:
+        return (importlib.util.find_spec("libtpu") is not None
+                or importlib.util.find_spec("libtpu_nightly") is not None)
+    except (ImportError, ValueError):
+        return False
+
+
+def apply_overlap_flags(cfg) -> List[str]:
+    """Export the block's flags into ``os.environ['XLA_FLAGS']`` (skipping
+    any flag the user already set — their value wins) and return the list
+    actually added.
+
+    Off-TPU the flags are composed and RECORDED but never exported: this
+    jaxlib's CPU XLA hard-aborts on unknown flags, so the scheduler regime
+    is a TPU-launch property (the CPU CI still validates composition,
+    config plumbing and the echo surfaces).  Warns when the jax backend is
+    already initialized: XLA_FLAGS are read once, so this process's
+    compiles keep the regime they started with (spawned workers still
+    inherit the updated env)."""
+    flags = compose_xla_flags(cfg)
+    if not flags:
+        return []
+    if not tpu_target():
+        logger.info(
+            "overlap: not a TPU target — composed XLA flags recorded but "
+            "not exported (CPU XLA aborts on unknown flags): %s",
+            " ".join(flags))
+        return []
+    current = os.environ.get("XLA_FLAGS", "")
+    present = {_flag_name(tok) for tok in current.split()}
+    added = [f for f in flags if _flag_name(f) not in present]
+    if added:
+        os.environ["XLA_FLAGS"] = (current + " " + " ".join(added)).strip()
+        if _backend_initialized():
+            logger.warning(
+                "overlap: XLA_FLAGS updated AFTER jax backend init — the "
+                "latency-hiding/async-collective flags (%s) will not affect "
+                "this process's compiles; construct the engine before any "
+                "other jax use (or export them in the launcher) for them to "
+                "take effect", " ".join(_flag_name(f) for f in added))
+        else:
+            logger.info("overlap: applied XLA flags: %s", " ".join(added))
+    return added
+
+
+def effective_xla_flags() -> str:
+    """The XLA_FLAGS this process sees right now (what env_report, the
+    telemetry snapshot and the postmortem bundle record)."""
+    return os.environ.get("XLA_FLAGS", "")
+
+
+def overlap_snapshot(cfg) -> Dict[str, object]:
+    """JSON-stable record of the scheduler regime: the resolved ``overlap``
+    block, the flags it composes, and the effective env — embedded in every
+    telemetry snapshot and postmortem bundle so traces are attributable to
+    the regime they ran under."""
+    return {
+        "config": cfg.model_dump(),
+        "composed_flags": compose_xla_flags(cfg),
+        "effective_xla_flags": effective_xla_flags(),
+    }

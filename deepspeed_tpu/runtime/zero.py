@@ -1,4 +1,4 @@
-"""ZeRO semantics: master-weight optimizer wrapper + stage documentation.
+"""ZeRO semantics: master-weight optimizer wrapper + chunked stage-3 collectives.
 
 Reference parity map (see parallel/partition.py for the sharding half):
 
@@ -11,6 +11,13 @@ Reference parity map (see parallel/partition.py for the sharding half):
   XLA inserts psum-scatter when grads feed sharded state.
 - param all-gather (partition_parameters.py all_gather_coalesced) → XLA inserts
   all-gather per consumer at stage 3; overlap via the latency-hiding scheduler.
+- coalesced/overlapped gather (partitioned_param_coordinator.py prefetching,
+  all_gather_coalesced bucketing) → ``chunked_param_gather`` below: the
+  ``overlap.num_chunks`` config knob decomposes the per-step flat param
+  all-gather (and, through its autodiff transpose, the grad reduce-scatter)
+  into byte-balanced per-layer-group chunks so XLA's latency-hiding
+  scheduler can interleave chunk N's wire time with chunk N−1's matmuls
+  (T3, arXiv:2401.16677; The Big Send-off, arXiv:2504.18658).
 """
 
 from __future__ import annotations
@@ -20,6 +27,92 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import optax
+
+
+def _gather_group(leaves, dims, specs, mesh, axis, world):
+    """One layer group's gather: flatten each local shard, concatenate into
+    per-dtype flat buffers, all-gather each buffer ONCE over ``axis``, and
+    rebuild every leaf's global layout with pure data movement (exact).
+
+    The transpose of this program under autodiff is precisely the chunked
+    grad reduce-scatter: ``all_gather(tiled)`` transposes to ``psum_scatter``
+    of the flat buffer, so each layer group's gradients leave the backward
+    pass as one reduce-scatter the scheduler can overlap with the next
+    group's backward matmuls."""
+    from deepspeed_tpu.comm import collectives
+    from deepspeed_tpu.parallel.partition import spec_without_axis
+    from deepspeed_tpu.utils.compat import shard_map
+
+    in_specs = tuple(s.spec for s in specs)
+    out_specs = tuple(spec_without_axis(s.spec, axis) for s in specs)
+
+    def body(*locs):
+        # bucket by dtype: one flat buffer (= one collective) per dtype
+        buckets = {}
+        for i, x in enumerate(locs):
+            buckets.setdefault(x.dtype, []).append(i)
+        gathered = [None] * len(locs)
+        for dtype, idxs in buckets.items():
+            flat = (jnp.concatenate([locs[i].reshape(-1) for i in idxs])
+                    if len(idxs) > 1 else locs[idxs[0]].reshape(-1))
+            g = collectives.all_gather(flat, axis, gather_dim=0, tiled=True,
+                                       chunked=True)
+            g = g.reshape(world, flat.shape[0])
+            off = 0
+            for i in idxs:
+                x, d = locs[i], dims[i]
+                blk = jax.lax.slice_in_dim(g, off, off + x.size, axis=1)
+                blk = blk.reshape((world,) + x.shape)   # [world, *local]
+                blk = jnp.moveaxis(blk, 0, d)           # device axis → d
+                shape = list(x.shape)
+                shape[d] = shape[d] * world
+                gathered[i] = blk.reshape(shape)
+                off += x.size
+        return tuple(gathered)
+
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)(*leaves)
+
+
+def chunked_param_gather(params, shardings, mesh, num_chunks,
+                         axis: str = "fsdp"):
+    """Gather every ``axis``-sharded leaf of ``params`` explicitly, in
+    ``num_chunks`` byte-balanced per-layer-group flat collectives, instead
+    of leaving XLA to insert one implicit all-gather per consumer.
+
+    Leaves not sharded over ``axis`` alone (replicated, tp-only, or
+    co-sharded tuple specs) pass through untouched and keep the
+    partitioner's implicit handling.  Gathered leaves come back in their
+    post-gather layout (``axis`` dropped from the spec, other axes kept).
+    Forward is bitwise-exact vs the implicit gather (pure data movement);
+    the backward pass runs the transposed program — ``num_chunks``
+    per-layer-group flat reduce-scatters (tolerance-exact vs the implicit
+    reduce: summation order may differ).
+    """
+    from deepspeed_tpu.parallel.partition import layer_groups, sharded_dim
+    world = mesh.shape[axis]
+    if world <= 1 or num_chunks < 1:
+        return params
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    specs = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec"))
+    dims = [sharded_dim(sh.spec, axis) for sh in specs]
+    gather_idx = [i for i, (leaf, d) in enumerate(zip(leaves, dims))
+                  if d >= 0 and leaf.size > 0]
+    if not gather_idx:
+        return params
+    groups = layer_groups([leaves[i].size * leaves[i].dtype.itemsize
+                           for i in gather_idx], num_chunks)
+    out = list(leaves)
+    for grp in groups:
+        idxs = [gather_idx[j] for j in grp]
+        gathered = _gather_group([leaves[i] for i in idxs],
+                                 [dims[i] for i in idxs],
+                                 [specs[i] for i in idxs],
+                                 mesh, axis, world)
+        for i, g in zip(idxs, gathered):
+            out[i] = g
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 class MasterWeightsState(NamedTuple):

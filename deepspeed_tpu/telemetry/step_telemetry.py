@@ -141,7 +141,8 @@ class StepTelemetry:
         miss = self.watchdog.observe_signature(fn_name, sig, step)
         info = self._exec.setdefault(
             fn_name, {"signatures": 0, "executions": 0, "collectives": {},
-                      "cost_analysis": {}, "memory_analysis": {}})
+                      "overlap": {}, "cost_analysis": {},
+                      "memory_analysis": {}})
         if miss:
             info["signatures"] += 1
             collected = {}
@@ -192,6 +193,7 @@ class StepTelemetry:
         from deepspeed_tpu.telemetry.registry import \
             suppress_collective_recording
         info["collectives"] = {}
+        info["overlap"] = {}
         try:
             # the AOT lower() RETRACES the step — silence the wrapper-level
             # trace-time hooks so their byte counters don't double-count
@@ -202,7 +204,22 @@ class StepTelemetry:
                            f"failed: {e!r}")
             return {}
         try:
-            info["collectives"] = hlo_collective_bytes(compiled.as_text())
+            hlo_text = compiled.as_text()
+            info["collectives"] = hlo_collective_bytes(hlo_text)
+            # compute–collective overlap evidence (comm.hlo_overlap_stats):
+            # async start/done pairs with compute between them + interleaved
+            # chunk trains → the collective_exposed_ratio gauge, the static
+            # stand-in for profiler exposed-comms time (scripts/
+            # check_overlap.py runs the same walk standalone)
+            from deepspeed_tpu.comm.comm import hlo_overlap_stats
+            ov = hlo_overlap_stats(hlo_text)
+            info["overlap"] = ov
+            self.registry.gauge(
+                "collective_exposed_ratio",
+                "bytes-weighted fraction of compiled-HLO collective payload "
+                "with no overlap evidence (sync and not chunk-interleaved, "
+                "or async with an empty start/done window), per jitted "
+                "function").set(ov["exposed_ratio"], fn=fn_name)
         except Exception as e:  # noqa: BLE001
             logger.warning(f"telemetry: HLO collective walk of '{fn_name}' "
                            f"failed: {e!r}")
@@ -385,6 +402,15 @@ class StepTelemetry:
                     if k.startswith(("JAX_", "XLA_", "LIBTPU", "TPU_"))]
         for k in env_keys:
             lines.append(f"env {k}={os.environ[k]}")
+        # resolved overlap regime (config + composed flags): the postmortem
+        # must say which scheduler regime the crashed run compiled under
+        from deepspeed_tpu.runtime.overlap import compose_xla_flags
+        ocfg = self._config.overlap
+        for key, val in sorted(ocfg.model_dump().items()):
+            lines.append(f"overlap.{key}={val}")
+        composed = compose_xla_flags(ocfg)
+        lines.append("overlap.composed_xla_flags="
+                     + (" ".join(composed) if composed else "(none)"))
         with open(os.path.join(bundle_dir, "env.txt"), "w") as f:
             f.write("\n".join(lines) + "\n")
 
@@ -458,8 +484,15 @@ class StepTelemetry:
                            for rec in info["collectives"].values())
             executables[fn] = {**info,
                                "per_execution_collective_bytes": per_exec}
-        snap = self.exporter.snapshot(step=step,
-                                      extra={"executables": executables})
+        # every snapshot records the scheduler regime it ran under: the
+        # resolved overlap block + the XLA_FLAGS this process actually saw
+        # (runtime/overlap.py — satellite of the compute–collective
+        # overlap work; a trace without its regime is unattributable)
+        from deepspeed_tpu.runtime.overlap import overlap_snapshot
+        snap = self.exporter.snapshot(
+            step=step,
+            extra={"executables": executables,
+                   "env": overlap_snapshot(self._config.overlap)})
         if write and self._rank0:
             try:
                 self.exporter.write_json(self.snapshot_path, snap)

@@ -281,8 +281,11 @@ class TestWatchdog:
 class TestCollectiveCounters:
     def test_shard_map_counters_match_analytic(self, devices):
         """A jitted (pjit) step over a 2-device mesh: the wrapper-level
-        trace-time counters must carry exactly the analytic per-shard
-        payload bytes for each collective kind."""
+        trace-time counters must carry exactly the analytic WIRE bytes for
+        each collective kind (comm/collectives.py convention — per-
+        participant ring bytes; at n=2 both formulas below reduce to the
+        shard payload: all_reduce 2·B·(n−1)/n = B, all_gather
+        B·(n−1) = B)."""
         default_registry.reset()
         mesh = build_mesh(MeshSpec(dp=2, fsdp=1))
 
