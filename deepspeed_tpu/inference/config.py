@@ -15,6 +15,7 @@ from typing import Any, Dict, Literal, Optional, Union
 from pydantic import Field, model_validator
 
 from deepspeed_tpu.config import DeepSpeedConfigModel
+from deepspeed_tpu.telemetry.serving import ServingTelemetryConfig
 
 _DTYPE_ALIASES = {
     "fp32": "float32", "float": "float32", "float32": "float32",
@@ -93,6 +94,12 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     max_out_tokens: int = 1024
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
+    # request-level serving telemetry (telemetry/serving.py); the v1 engine
+    # records generate-call spans, e2e latency histograms, and token
+    # counters — TTFT/queue spans need the v2 scheduler's per-request
+    # lifecycle and stay v2-only (v1 generate is one fused program)
+    telemetry: ServingTelemetryConfig = Field(
+        default_factory=ServingTelemetryConfig)
     checkpoint: Optional[Union[str, Dict[str, Any]]] = None
     # accepted-for-parity, no-op on TPU: kernel selection is automatic (the op
     # registry picks Pallas on TPU), jit is the captured graph, and decode is

@@ -142,6 +142,12 @@ class InferenceEngine:
             lambda p, ids: self.module.apply({"params": mat(p)}, ids),
             in_shardings=(self.store_shardings, NamedSharding(mesh, P())))
         self._gen_cache = {}
+        # serving telemetry (telemetry/serving.py): the v1 generate loop is
+        # ONE fused XLA program (prefill + scan decode), so instrumentation
+        # is call-granular — generate spans, per-row e2e histograms, token
+        # counters.  Queue/TTFT decomposition lives in the v2 engine.
+        from deepspeed_tpu.telemetry.serving import ServingTelemetry
+        self.telemetry = ServingTelemetry(self.config.telemetry)
         log_dist(f"inference engine ready: params="
                  f"{self.num_parameters/1e6:.1f}M tp={mesh.shape['tp']} "
                  f"dtype={self.config.dtype}"
@@ -264,8 +270,28 @@ class InferenceEngine:
         if key not in self._gen_cache:
             self._gen_cache[key] = self._build_generate(
                 max_new_tokens, do_sample, top_k, eos, g.pad_token_id)
-        with self.mesh:
-            out = self._gen_cache[key](
-                self.params, ids, mask, jax.random.PRNGKey(seed),
-                jnp.float32(temperature), jnp.float32(top_p))
-        return np.asarray(out)
+        stel = self.telemetry
+        stel.dispatch("v1_generate")
+        t0 = stel.now()
+        with stel.span("v1_generate", batch=B, prompt_len=L,
+                       max_new_tokens=int(max_new_tokens)):
+            with self.mesh:
+                out = self._gen_cache[key](
+                    self.params, ids, mask, jax.random.PRNGKey(seed),
+                    jnp.float32(temperature), jnp.float32(top_p))
+            out = np.asarray(out)   # host materialization = completion
+        if stel.enabled:
+            dt_ms = (stel.now() - t0) * 1e3
+            n_prompt = (B * L if attention_mask is None
+                        else int(np.asarray(attention_mask).sum()))
+            stel.tokens("prefill", n_prompt)
+            stel.tokens("decode", B * int(max_new_tokens))
+            # call-granular latency: every row shares the fused program's
+            # wall time (there is no per-request queue in v1).  TPOT is NOT
+            # recorded here: prefill and decode are one fused program, so
+            # dt/max_new would fold prompt-length cost into a metric
+            # defined as inter-token decode latency — misleading next to
+            # the v2 numbers it would share a dashboard with.
+            for _ in range(B):
+                stel.h_e2e.observe(dt_ms)
+        return out

@@ -35,9 +35,13 @@ from deepspeed_tpu.inference.v2.model import (PagedKVCache,
                                               ragged_forward_sampled,
                                               ragged_forward_sampled_draft,
                                               speculative_burst,
-                                              speculative_burst_sampled)
+                                              speculative_burst_sampled,
+                                              speculative_draft_step,
+                                              speculative_verify_step)
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager, RaggedBatch,
                                                build_ragged_batch)
+from deepspeed_tpu.telemetry.serving import (ServingTelemetry,
+                                             ServingTelemetryConfig)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -68,6 +72,12 @@ class SpeculativeConfig(DeepSpeedConfigModel):
 
     gamma: int = 4              # draft tokens per verify
     outer_steps: int = 8        # draft+verify rounds fused per dispatch
+    # attribution mode: dispatch draft and verify as SEPARATE programs with
+    # a host fence between them, feeding the spec_draft_ms_total /
+    # spec_verify_ms_total counters — token-identical to the fused burst
+    # (same acceptance functions) but slower (2 dispatches + sync per outer
+    # step IS the measurement), so it's a profiling knob, not a serving mode
+    profile: bool = False
 
 
 class V2QuantConfig(DeepSpeedConfigModel):
@@ -98,6 +108,8 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     quant: V2QuantConfig = Field(default_factory=V2QuantConfig)
+    telemetry: ServingTelemetryConfig = Field(
+        default_factory=ServingTelemetryConfig)
 
     @classmethod
     def parse(cls, config):
@@ -143,6 +155,19 @@ class _Request:
     resume: bool = False
     # how many generated tokens have been folded into .prompt by preemptions
     folded: int = 0
+    # ---- serving-telemetry lifecycle (ServingTelemetry.now() seconds).
+    # Timestamps are taken when the relevant DISPATCH returns — with
+    # telemetry.stream_sync (the streaming-server mode) the dispatch is
+    # fenced first, so they reflect device completion; without it they
+    # reflect host submission (a lower bound, disclosed in the docs).
+    track: int = 0                         # trace tid for this request
+    t_arrival: Optional[float] = None
+    t_admit: Optional[float] = None
+    t_prefill_end: Optional[float] = None
+    t_first: Optional[float] = None        # first generated token
+    t_last: Optional[float] = None         # last generated token
+    preempts: int = 0
+    finished: bool = False                 # finish_request recorded
 
 
 class InferenceEngineV2:
@@ -372,9 +397,11 @@ class InferenceEngineV2:
         # recompute-preemption observability: how many victims were taken in
         # steady decode vs mid-(re-)prefill (the latter must keep fold state)
         self.preempt_stats = {"decode_ready": 0, "mid_prefill": 0}
-        # speculative observability: accepted tokens per (slot × outer step);
-        # tokens/outer_steps ≈ gamma+1 means the draft tracks the target
-        self.spec_stats = {"outer_steps": 0, "tokens": 0}
+        # request-level serving telemetry (telemetry/serving.py): lifecycle
+        # spans + TTFT/TPOT histograms + KV-pool gauges + speculative
+        # counters.  Engine-local registry by default so two engines in one
+        # process (the bench runs seven) never blend their series.
+        self.telemetry = ServingTelemetry(self.config.telemetry)
         self._block_size = eff_bs
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(self.params))
@@ -434,6 +461,7 @@ class InferenceEngineV2:
              if self.state.get(u) else -(-len(t) // self.state.block_size))
             for u, t in zip(uids, toks_np))
         if blocks_needed > self.state.allocator.free_blocks:
+            self.telemetry.alloc_failure("put")
             raise RuntimeError(
                 f"batch needs {blocks_needed} KV blocks but only "
                 f"{self.state.allocator.free_blocks} free; check query() first")
@@ -442,11 +470,15 @@ class InferenceEngineV2:
             seq = self.state.get(uid) or self.state.create(uid)
             self.state.ensure_blocks(seq, len(toks))
             schedule.append((seq, toks))
+        for _, toks in schedule:
+            self.telemetry.tokens("prefill" if len(toks) > 1 else "decode",
+                                  len(toks))
         rb = build_ragged_batch(schedule, self.state,
                                 sm.max_ragged_batch_size, sm.max_q_per_seq)
         logits = self._run(rb)
         for seq, toks in schedule:
             seq.seen_tokens += len(toks)
+        self.telemetry.kv_sample(self.state)
         return logits
 
     def _buckets(self, rb: RaggedBatch):
@@ -490,7 +522,12 @@ class InferenceEngineV2:
                  "token_dense_idx": rb.token_dense_idx[:nb],
                  "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len}
         batch = jax.tree_util.tree_map(jnp.asarray, batch)
-        logits, self.cache = self._steps[key](self.params, self.cache, batch)
+        self.telemetry.dispatch("mixed")
+        self.telemetry.padding_waste(rb.total_tokens, nb)
+        with self.telemetry.span("mixed_dispatch", tokens=rb.total_tokens,
+                                 bucket=nb, seqs=len(rb.logits_slots)):
+            logits, self.cache = self._steps[key](self.params, self.cache,
+                                                  batch)
         return logits
 
     def _run_decode(self, rb: RaggedBatch) -> "jax.Array":
@@ -514,7 +551,10 @@ class InferenceEngineV2:
         batch = jax.tree_util.tree_map(jnp.asarray, {
             "tokens": tokens, "active": active, "token_pos": token_pos,
             "block_table": rb.block_table})
-        logits, self.cache = self._steps[key](self.params, self.cache, batch)
+        self.telemetry.dispatch("decode")
+        with self.telemetry.span("decode_dispatch", seqs=rb.total_tokens):
+            logits, self.cache = self._steps[key](self.params, self.cache,
+                                                  batch)
         return logits
 
     def _sample_fn(self, gen):
@@ -556,7 +596,13 @@ class InferenceEngineV2:
         batch = jax.tree_util.tree_map(jnp.asarray, {
             "tokens0": tokens0, "from_device": from_device, "active": active,
             "pos0": pos0, "block_table": block_table})
-        if gen.do_sample:
+        stel = self.telemetry
+        profile = bool(self.config.speculative.profile)
+        t_begin = stel.now()
+        if profile:
+            toks_h, counts_h, prev, rng = self._run_spec_split(
+                batch, outer, gamma, gen, prev, rng)
+        elif gen.do_sample:
             key = ("spec_rs", outer, gamma, gen.top_k)
             if key not in self._steps:
                 self._steps[key] = jax.jit(
@@ -567,11 +613,17 @@ class InferenceEngineV2:
                                       gamma=gamma, steps=outer,
                                       top_k=gen.top_k, mesh=self.mesh),
                     donate_argnums=(2, 3))
-            toks, counts, prev, rng, self.cache, self.draft_cache = \
-                self._steps[key](self.params, self.draft_params, self.cache,
-                                 self.draft_cache, batch, prev, rng,
-                                 jnp.float32(gen.temperature),
-                                 jnp.float32(gen.top_p))
+            with stel.span("spec_dispatch", outer=outer, gamma=gamma,
+                           seqs=len(reqs)):
+                toks, counts, prev, rng, self.cache, self.draft_cache = \
+                    self._steps[key](self.params, self.draft_params,
+                                     self.cache, self.draft_cache, batch,
+                                     prev, rng, jnp.float32(gen.temperature),
+                                     jnp.float32(gen.top_p))
+            stel.dispatch("spec")
+            # the host cannot schedule past the burst without the counts —
+            # this is THE disclosed sync of the speculative path
+            toks_h, counts_h = jax.device_get([toks, counts])  # sync-ok
         else:
             key = ("spec", outer, gamma)
             if key not in self._steps:
@@ -583,14 +635,94 @@ class InferenceEngineV2:
                                       gamma=gamma, steps=outer,
                                       mesh=self.mesh),
                     donate_argnums=(2, 3))
-            toks, counts, prev, self.cache, self.draft_cache = \
-                self._steps[key](self.params, self.draft_params, self.cache,
-                                 self.draft_cache, batch, prev)
-        toks_h, counts_h = jax.device_get([toks, counts])
-        self.spec_stats["outer_steps"] += outer * len(reqs)
-        self.spec_stats["tokens"] += int(
-            counts_h[:, [self.state.get(r.uid).slot for r in reqs]].sum())
+            with stel.span("spec_dispatch", outer=outer, gamma=gamma,
+                           seqs=len(reqs)):
+                toks, counts, prev, self.cache, self.draft_cache = \
+                    self._steps[key](self.params, self.draft_params,
+                                     self.cache, self.draft_cache, batch,
+                                     prev)
+            stel.dispatch("spec")
+            toks_h, counts_h = jax.device_get([toks, counts])  # sync-ok
+        emitted = int(np.asarray(counts_h)[
+            :, [self.state.get(r.uid).slot for r in reqs]].sum())
+        # spec_burst_ms_total is FUSED-dispatch wall time by definition; a
+        # profiled run's fenced per-side times already land in
+        # spec_draft_ms_total/spec_verify_ms_total and must not be
+        # double-reported under the fused counter
+        stel.spec_burst(outer=outer, n_seqs=len(reqs), gamma=gamma,
+                        emitted=emitted,
+                        dur_ms=(0.0 if profile
+                                else (stel.now() - t_begin) * 1e3))
+        stel.tokens("spec", emitted)
         return np.asarray(toks_h), np.asarray(counts_h), prev, rng
+
+    def _run_spec_split(self, batch, outer: int, gamma: int, gen, prev, rng):
+        """Split-profile speculative driver (``speculative.profile``): each
+        outer step dispatches the draft program, fences, dispatches the
+        verify program, and syncs its counts — wall time on each side feeds
+        ``spec_draft_ms_total``/``spec_verify_ms_total``.  Token-identical
+        to the fused burst (same acceptance math, same cache choreography);
+        the per-step fences ARE the attribution measurement, so this mode
+        is strictly slower than fused and never the serving default.
+        Returns (toks_h [outer, gamma+1, S], counts_h [outer, S], prev',
+        rng')."""
+        stel = self.telemetry
+        sampled = bool(gen.do_sample)
+        dkey = ("spec_draft", gamma, sampled, gen.top_k)
+        vkey = ("spec_verify", gamma, sampled, gen.top_k)
+        if dkey not in self._steps:
+            self._steps[dkey] = jax.jit(
+                functools.partial(speculative_draft_step,
+                                  draft_cfg=self.draft_config,
+                                  block_size=self._block_size, gamma=gamma,
+                                  top_k=gen.top_k, sampled=sampled,
+                                  mesh=self.mesh),
+                donate_argnums=(1,))
+            self._steps[vkey] = jax.jit(
+                functools.partial(speculative_verify_step,
+                                  cfg=self.model_config,
+                                  block_size=self._block_size, gamma=gamma,
+                                  top_k=gen.top_k, sampled=sampled,
+                                  mesh=self.mesh),
+                donate_argnums=(1,))
+        temp = jnp.float32(gen.temperature)
+        top_p = jnp.float32(gen.top_p)
+        sub = {k: batch[k] for k in ("active", "block_table")}
+        pos = batch["pos0"]
+        tokens0, from_device = batch["tokens0"], batch["from_device"]
+        S = self.state.max_tracked_sequences
+        all_dev = jnp.ones(S, bool)
+        toks_list, counts_list = [], []
+        q = None
+        for k in range(outer):
+            step_b = {**sub, "tokens0": tokens0, "from_device": from_device}
+            t0 = stel.now()
+            with stel.span("spec_draft_dispatch", outer_index=k, gamma=gamma):
+                if sampled:
+                    d, q, self.draft_cache, rng = self._steps[dkey](
+                        self.draft_params, self.draft_cache, step_b, prev,
+                        pos, rng, temp, top_p)
+                else:
+                    d, self.draft_cache, rng = self._steps[dkey](
+                        self.draft_params, self.draft_cache, step_b, prev,
+                        pos, rng, temp, top_p)
+                jax.block_until_ready(d)      # sync-ok: the split IS the
+                #                               measurement (profile mode)
+            t1 = stel.now()
+            with stel.span("spec_verify_dispatch", outer_index=k,
+                           gamma=gamma):
+                emit, counts, prev, pos, rng, self.cache = self._steps[vkey](
+                    self.params, self.cache, step_b, d,
+                    q if sampled else d, prev, pos, rng, temp, top_p)
+                emit_h, counts_h = jax.device_get([emit, counts])  # sync-ok
+            stel.dispatch("spec_draft")
+            stel.dispatch("spec_verify")
+            stel.spec_profile((t1 - t0) * 1e3, (stel.now() - t1) * 1e3)
+            toks_list.append(np.asarray(emit_h).T)          # [gamma+1, S]
+            counts_list.append(np.asarray(counts_h))
+            # later outer steps seed from the device-resident prev
+            tokens0, from_device = tokens0, all_dev
+        return (np.stack(toks_list), np.stack(counts_list), prev, rng)
 
     def _run_burst(self, reqs, steps: int, gen, prev, rng):
         """Fused T-step decode over the running set: one device dispatch for
@@ -629,9 +761,13 @@ class InferenceEngineV2:
         batch = jax.tree_util.tree_map(jnp.asarray, {
             "tokens0": tokens0, "from_device": from_device, "active": active,
             "pos0": pos0, "block_table": block_table})
-        toks, prev, rng, self.cache = self._steps[key](
-            self.params, self.cache, batch, prev, rng,
-            jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+        self.telemetry.dispatch("burst")
+        with self.telemetry.span("burst_dispatch", steps=steps,
+                                 seqs=len(reqs)):
+            toks, prev, rng, self.cache = self._steps[key](
+                self.params, self.cache, batch, prev, rng,
+                jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+        self.telemetry.tokens("decode", steps * len(reqs))
         for r in reqs:
             self.state.get(r.uid).seen_tokens += steps
         return toks, prev, rng
@@ -685,10 +821,15 @@ class InferenceEngineV2:
                                           sample_fn=self._sample_fn(gen),
                                           mesh=self.mesh),
                         donate_argnums=(2, 3))
-                prev, rng, self.cache, self.draft_cache = self._steps[key](
-                    self.params, self.draft_params, self.cache,
-                    self.draft_cache, batch, prev, rng,
-                    jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+                self.telemetry.dispatch("decode")
+                with self.telemetry.span("decode_dispatch",
+                                         seqs=len(schedule), draft=True):
+                    prev, rng, self.cache, self.draft_cache = \
+                        self._steps[key](
+                            self.params, self.draft_params, self.cache,
+                            self.draft_cache, batch, prev, rng,
+                            jnp.float32(gen.temperature),
+                            jnp.float32(gen.top_p))
                 for seq, toks in schedule:
                     seq.seen_tokens += len(toks)
                 return prev, rng
@@ -710,6 +851,7 @@ class InferenceEngineV2:
                 fdev[i:i + len(toks)] = fd
                 i += len(toks)
             mb, nb = self._buckets(rb)
+            self.telemetry.padding_waste(rb.total_tokens, nb)
             batch = jax.tree_util.tree_map(jnp.asarray, {
                 "tokens": rb.tokens[:nb], "token_slot": rb.token_slot[:nb],
                 "token_pos": rb.token_pos[:nb],
@@ -732,10 +874,16 @@ class InferenceEngineV2:
                                           sample_fn=self._sample_fn(gen),
                                           mesh=self.mesh),
                         donate_argnums=(2, 3))
-                prev, rng, self.cache, self.draft_cache = self._steps[key](
-                    self.params, self.draft_params, self.cache,
-                    self.draft_cache, batch, prev, rng,
-                    jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+                self.telemetry.dispatch("mixed")
+                with self.telemetry.span("mixed_dispatch",
+                                         tokens=rb.total_tokens, bucket=nb,
+                                         seqs=len(schedule), draft=True):
+                    prev, rng, self.cache, self.draft_cache = \
+                        self._steps[key](
+                            self.params, self.draft_params, self.cache,
+                            self.draft_cache, batch, prev, rng,
+                            jnp.float32(gen.temperature),
+                            jnp.float32(gen.top_p))
                 for seq, toks in schedule:
                     seq.seen_tokens += len(toks)
                 return prev, rng
@@ -749,19 +897,29 @@ class InferenceEngineV2:
                                       sample_fn=self._sample_fn(gen),
                                       mesh=self.mesh),
                     donate_argnums=(1,))
-        prev, rng, self.cache = self._steps[key](
-            self.params, self.cache, batch, prev, rng,
-            jnp.float32(gen.temperature), jnp.float32(gen.top_p))
+        kind = "decode" if key[0] == "decode_s" else "mixed"
+        self.telemetry.dispatch(kind)
+        with self.telemetry.span(f"{kind}_dispatch", seqs=len(schedule)):
+            prev, rng, self.cache = self._steps[key](
+                self.params, self.cache, batch, prev, rng,
+                jnp.float32(gen.temperature), jnp.float32(gen.top_p))
         for seq, toks in schedule:
             seq.seen_tokens += len(toks)
         return prev, rng
 
     # ----------------------------------------- reference query()/can_schedule
     def query(self) -> Dict[str, int]:
-        """KV/slot headroom (reference engine_v2.query :158)."""
+        """KV/slot headroom (reference engine_v2.query :158).  Also refreshes
+        the KV-pool gauges (blocks used/free, internal fragmentation) so a
+        scheduler polling ``query()`` keeps the pool view fresh in the
+        telemetry snapshot for free."""
         sm = self.config.state_manager
+        self.telemetry.kv_sample(self.state)
+        used = (self.state.allocator.num_blocks
+                - self.state.allocator.free_blocks)
         return {
             "free_kv_blocks": self.state.allocator.free_blocks,
+            "used_kv_blocks": used,
             "free_sequence_slots": self.state.free_sequence_slots,
             "token_budget": sm.max_ragged_batch_size,
             "max_q_per_seq": sm.max_q_per_seq,
@@ -770,11 +928,15 @@ class InferenceEngineV2:
 
     def can_schedule(self, uids: Sequence[int],
                      lengths: Sequence[int]) -> bool:
-        """reference engine_v2.can_schedule :184."""
+        """reference engine_v2.can_schedule :184.  A rejection for want of
+        blocks or slots counts into ``kv_alloc_failures_total`` — the
+        overload signal an admission controller will key off."""
         sm = self.config.state_manager
         if sum(lengths) > sm.max_ragged_batch_size:
+            self.telemetry.alloc_failure("can_schedule")
             return False
         if len(uids) > sm.max_ragged_sequence_count:
+            self.telemetry.alloc_failure("can_schedule")
             return False
         blocks = slots = 0
         for uid, n in zip(uids, lengths):
@@ -784,8 +946,11 @@ class InferenceEngineV2:
                 blocks += -(-n // self.state.block_size)
             else:
                 blocks += seq.kv_blocks_needed(n, self.state.block_size)
-        return (blocks <= self.state.allocator.free_blocks
-                and slots <= self.state.free_sequence_slots)
+        ok = (blocks <= self.state.allocator.free_blocks
+              and slots <= self.state.free_sequence_slots)
+        if not ok:
+            self.telemetry.alloc_failure("can_schedule")
+        return ok
 
     def flush(self, uids: Sequence[int]) -> None:
         """reference engine_v2.flush :242."""
@@ -793,8 +958,35 @@ class InferenceEngineV2:
             self.state.flush(uid)
 
     # ------------------------------- continuous batching (Dynamic SplitFuse)
+    def _stream_fence(self, value) -> None:
+        """Streaming-latency mode (``telemetry.stream_sync`` / the
+        open-loop bench): block until the just-dispatched step's on-device
+        output exists, so the lifecycle timestamp taken next reflects
+        device completion — the point a real streaming server could emit
+        the token — instead of host submission.  Serializes the dispatch
+        chain by design; never on in the throughput path."""
+        jax.block_until_ready(value)    # sync-ok: opt-in streaming mode
+
+    def _finish_request(self, r: "_Request",
+                        outcome: str = "completed") -> None:
+        """Record one retired request into the serving telemetry (idempotent
+        — retirement is reachable from the spec, burst, step, and
+        materialize paths)."""
+        if r.finished:
+            return
+        r.finished = True
+        self.telemetry.finish_request(
+            uid=r.uid, track=r.track, t_arrival=r.t_arrival,
+            t_admit=r.t_admit, t_prefill_end=r.t_prefill_end,
+            t_first=r.t_first, t_last=r.t_last,
+            n_prompt=len(r.prompt) - r.folded,
+            n_generated=len(r.generated), preempts=r.preempts,
+            outcome=outcome)
+
     def generate(self, prompts: Sequence[np.ndarray],
                  max_new_tokens=32, seed: int = 0,
+                 arrival_times: Optional[Sequence[float]] = None,
+                 now_fn=None, stream: Optional[bool] = None,
                  **gen_overrides) -> List[np.ndarray]:
         """Serve a set of prompts to completion with continuous batching.
 
@@ -817,22 +1009,41 @@ class InferenceEngineV2:
 
         max_new_tokens: int, or one int per prompt (heterogeneous completion
         budgets — the FastGen effective-throughput workload shape).
+
+        arrival_times: open-loop mode — per-prompt arrival offsets in
+        seconds from call start (e.g. a seeded Poisson process from the
+        bench harness); requests only become admittable once their arrival
+        time passes, and queue-wait spans measure arrival → admission.
+        ``now_fn`` overrides the clock (deterministic tests — a fake clock
+        must advance or an idle open loop spins).  ``stream`` fences each
+        dispatch before timestamping (defaults to ``telemetry.stream_sync``)
+        so TTFT/TPOT histograms reflect device completion.
         """
         gen = self.config.generation.model_copy(update=gen_overrides)
         sm = self.config.state_manager
         S = self.state.max_tracked_sequences
+        stel = self.telemetry
+        now_fn = now_fn if now_fn is not None else stel.now
+        stream = stel.stream_sync if stream is None else bool(stream)
         if isinstance(max_new_tokens, (int, np.integer)):
             max_list = [int(max_new_tokens)] * len(prompts)
         else:
             max_list = [int(m) for m in max_new_tokens]
             if len(max_list) != len(prompts):
                 raise ValueError("max_new_tokens list must match prompts")
+        if (arrival_times is not None
+                and len(arrival_times) != len(prompts)):
+            raise ValueError("arrival_times must match prompts")
+        t_start = now_fn()
         waiting = [
             _Request(uid=-(i + 1), prompt=np.asarray(p, np.int32).reshape(-1),
                      max_new_tokens=m)
             for i, (p, m) in enumerate(zip(prompts, max_list))]
         pool_blocks = self.state.allocator.num_blocks
-        for r in waiting:
+        for i, r in enumerate(waiting):
+            r.track = stel.new_track(f"req {i}")
+            r.t_arrival = t_start + (float(arrival_times[i])
+                                     if arrival_times is not None else 0.0)
             if (len(r.prompt) + r.max_new_tokens
                     > self.model_config.max_seq_len):
                 raise ValueError(f"prompt {len(r.prompt)} + "
@@ -846,6 +1057,11 @@ class InferenceEngineV2:
                     f"(recompute-preemption cannot make a single sequence fit)")
         running: List[_Request] = []
         results: Dict[int, _Request] = {r.uid: r for r in waiting}
+        # open loop: requests enter the waiting queue at their arrival time
+        incoming: List[_Request] = []
+        if arrival_times is not None:
+            waiting.sort(key=lambda r: r.t_arrival)
+            incoming, waiting = waiting, []
 
         eos = gen.eos_token_id
         sync_interval = 16 if eos is not None else None
@@ -854,6 +1070,11 @@ class InferenceEngineV2:
         # device records: ("step", arr [S], [(uid, slot)]) or
         # ("burst", arr [T, S], [(uid, slot)], T) — fetched in ONE transfer
         records: List[tuple] = []
+        # requests retired while their tokens still sat in device records;
+        # telemetry-finished at the next materialize, when .generated is
+        # exact (a list, not a results.values() sweep — that would make
+        # generate O(requests²) at open-loop scale)
+        pending_finish: List[_Request] = []
         steps_since_sync = 0
 
         def _append(r: _Request, toks) -> None:
@@ -885,9 +1106,31 @@ class InferenceEngineV2:
                 if r.done:                      # EOS found on materialize
                     self.flush([r.uid])
                     running.remove(r)
+                    pending_finish.append(r)
+            # retired requests reach their final .generated here (their
+            # pending device records just resolved) — record them into the
+            # serving telemetry now, when the token count is exact
+            for r in pending_finish:
+                self._finish_request(r)
+            pending_finish.clear()
 
         burst_sizes = (64, 32, 16, 8)
-        while waiting or running:
+        while waiting or running or incoming:
+            now = now_fn()
+            while incoming and incoming[0].t_arrival <= now:
+                waiting.append(incoming.pop(0))
+            if not waiting and not running:
+                # open-loop idle: everything in flight is done and the next
+                # request hasn't arrived — flush pending records, then sleep
+                # to the next arrival (a fake now_fn just re-polls: it must
+                # advance on its own)
+                materialize()
+                if now_fn is stel.now:
+                    import time as _time
+                    _time.sleep(max(0.0, incoming[0].t_arrival - now_fn()))
+                continue
+            stel.kv_sample(self.state)
+            stel.occupancy(len(running), S)
             # ---- speculative draft-and-verify fast path: same eligibility
             # as the decode burst, preferred when a draft is loaded and
             # decoding is greedy.  Each outer step yields 1..gamma+1 tokens
@@ -927,6 +1170,7 @@ class InferenceEngineV2:
                              for r in running]
                     toks_h, counts_h, prev, rng = self._run_spec(
                         running, outer, sp.gamma, gen, prev, rng)
+                    tnow = now_fn()     # _run_spec synced: completion time
                     for r, (uid, sl) in zip(list(running), pairs):
                         total = int(counts_h[:, sl].sum())
                         self.state.get(uid).seen_tokens += total
@@ -936,10 +1180,15 @@ class InferenceEngineV2:
                             vals.extend(int(t) for t in toks_h[k, :c, sl])
                         _append(r, vals)
                         r.sampled += total
+                        if total:
+                            if r.t_first is None:
+                                r.t_first = tnow
+                            r.t_last = tnow
                         if r.done or r.sampled >= r.max_new_tokens:
                             r.done = True
                             self.flush([r.uid])
                             running.remove(r)
+                            self._finish_request(r)
                     continue
 
             # ---- decode-burst fast path: every running sequence is in pure
@@ -993,13 +1242,23 @@ class InferenceEngineV2:
                              for r in running]
                     toks, prev, rng = self._run_burst(running, T, gen,
                                                       prev, rng)
+                    if stream:
+                        self._stream_fence(prev)
+                    tnow = now_fn()
                     records.append(("burst", toks, pairs, T))
                     for r in list(running):
                         r.sampled += T
+                        if r.t_first is None:
+                            # first token mid-burst: stamped at burst end
+                            # (bursts only run once every slot is decode-
+                            # ready, so in practice t_first predates them)
+                            r.t_first = tnow
+                        r.t_last = tnow
                         if r.sampled >= r.max_new_tokens:
-                            r.done = True
-                            self.flush([r.uid])
-                            running.remove(r)
+                            r.done = True       # finish recorded at the
+                            self.flush([r.uid])  # next materialize (records
+                            running.remove(r)    # still hold its tokens)
+                            pending_finish.append(r)
                     steps_since_sync += T
                     if sync_interval and steps_since_sync >= sync_interval:
                         materialize()
@@ -1012,6 +1271,8 @@ class InferenceEngineV2:
             sched_fdev: List[bool] = []
             served_slots: List[int] = []
             sampled_now: List[_Request] = []
+            newly_ready: List[_Request] = []    # prefill completes this step
+            n_decode_toks = n_prefill_toks = 0
 
             # 1) running decodes: one token each (decode-priority keeps
             #    latency flat while prompts stream in)
@@ -1028,6 +1289,7 @@ class InferenceEngineV2:
                 # a decode that can't get a block defers to a later round
                 if (seq.kv_blocks_needed(1, self.state.block_size)
                         > self.state.allocator.free_blocks):
+                    stel.alloc_failure("decode")
                     continue
                 self.state.ensure_blocks(seq, 1)
                 sched_uids.append(r.uid)
@@ -1041,6 +1303,7 @@ class InferenceEngineV2:
                 served_slots.append(seq.slot)
                 sampled_now.append(r)
                 budget -= 1
+                n_decode_toks += 1
 
             # 2) prompt chunks fill the rest (running first, then admit new)
             for r in list(running):
@@ -1051,14 +1314,17 @@ class InferenceEngineV2:
                 chunk = min(len(seq.pending), sm.max_q_per_seq, budget)
                 need = seq.kv_blocks_needed(chunk, self.state.block_size)
                 if need > self.state.allocator.free_blocks:
+                    stel.alloc_failure("prompt_chunk")
                     continue
                 self.state.ensure_blocks(seq, chunk)
                 toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
                 sched_uids.append(r.uid)
                 sched_toks.append(toks)
                 sched_fdev.append(False)
+                n_prefill_toks += chunk
                 if not seq.in_flight:       # prompt complete -> decode next
                     r.decode_ready = True
+                    newly_ready.append(r)
                     if r.resume:
                         r.resume = False    # continuation token already held
                     else:
@@ -1072,18 +1338,23 @@ class InferenceEngineV2:
                 chunk = min(len(r.prompt), sm.max_q_per_seq, budget)
                 if (-(-chunk // self.state.block_size)
                         > self.state.allocator.free_blocks):
+                    stel.alloc_failure("admission")
                     break
                 waiting.pop(0)
                 seq = self.state.create(r.uid)
                 seq.pending = r.prompt
                 self.state.ensure_blocks(seq, chunk)
                 running.append(r)
+                if r.t_admit is None:
+                    r.t_admit = now_fn()
                 toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
                 sched_uids.append(r.uid)
                 sched_toks.append(toks)
                 sched_fdev.append(False)
+                n_prefill_toks += chunk
                 if not seq.in_flight:
                     r.decode_ready = True
+                    newly_ready.append(r)
                     if r.resume:
                         r.resume = False
                     else:
@@ -1102,8 +1373,11 @@ class InferenceEngineV2:
                     continue
                 if running:
                     victim = running.pop()
-                    self.preempt_stats["mid_prefill" if not victim.decode_ready
-                                       else "decode_ready"] += 1
+                    kind = ("mid_prefill" if not victim.decode_ready
+                            else "decode_ready")
+                    self.preempt_stats[kind] += 1
+                    stel.preemption(kind)
+                    victim.preempts += 1
                     if victim.decode_ready:
                         # fold generated-but-not-yet-refed tokens into the
                         # prompt exactly once (folded tracks prior
@@ -1131,16 +1405,27 @@ class InferenceEngineV2:
 
             pairs = [(r.uid, self.state.get(r.uid).slot)
                      for r in sampled_now]
+            stel.tokens("decode", n_decode_toks)
+            stel.tokens("prefill", n_prefill_toks)
             prev, rng = self._step_sampled(sched_uids, sched_toks, sched_fdev,
                                            served_slots, gen, prev, rng)
+            if stream:
+                self._stream_fence(prev)
+            tnow = now_fn()
+            for r in newly_ready:
+                r.t_prefill_end = tnow
             if pairs:
                 records.append(("step", prev, pairs))
             for r in sampled_now:
+                if r.t_first is None:
+                    r.t_first = tnow
+                r.t_last = tnow
                 r.sampled += 1
                 if r.sampled >= r.max_new_tokens:
-                    r.done = True
+                    r.done = True       # finish recorded at materialize
                     self.flush([r.uid])
                     running.remove(r)
+                    pending_finish.append(r)
             steps_since_sync += 1
             if sync_interval and steps_since_sync >= sync_interval:
                 materialize()

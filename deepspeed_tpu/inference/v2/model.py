@@ -874,17 +874,7 @@ def _speculative_burst_core(params, draft_params, cache: PagedKVCache,
             emit, counts = spec_accept(sub, jnp.stack(q_list, axis=1),
                                        xform(vlogits), d)
         else:
-            t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [S, g+1]
-            match = (d == t[:, :gamma])
-            n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                            axis=1)                             # 0..gamma
-            correction = jnp.take_along_axis(t, n_acc[:, None],
-                                             axis=1)[:, 0]
-            j_idx = jnp.arange(gamma + 1)[None]
-            emit = jnp.where(j_idx < n_acc[:, None],
-                             jnp.pad(d, ((0, 0), (0, 1))),
-                             correction[:, None])               # [S, g+1]
-            counts = n_acc + 1
+            emit, counts = _greedy_accept(vlogits, d, gamma)
         counts = jnp.where(active, counts, 0)
         last = jnp.take_along_axis(
             emit, jnp.maximum(counts - 1, 0)[:, None], axis=1)[:, 0]
@@ -919,6 +909,110 @@ def speculative_burst(params, draft_params, cache: PagedKVCache,
         None, None, cfg, draft_cfg, block_size=block_size, gamma=gamma,
         steps=steps, sampled=False, mesh=mesh)
     return toks, counts, prev, cache, draft_cache
+
+
+def _greedy_accept(vlogits, d, gamma: int):
+    """Greedy speculative acceptance: accept the longest prefix of draft
+    tokens matching the target argmax, then emit the target's token at the
+    stop position (the correction when rejected, the bonus when all gamma
+    accepted).  Shared by the fused burst and the split-profile verify
+    step so both modes apply bit-identical acceptance.
+    Returns (emit [S, gamma+1], counts [S] in 1..gamma+1)."""
+    t = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)      # [S, g+1]
+    match = (d == t[:, :gamma])
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                    axis=1)                                 # 0..gamma
+    correction = jnp.take_along_axis(t, n_acc[:, None], axis=1)[:, 0]
+    j_idx = jnp.arange(gamma + 1)[None]
+    emit = jnp.where(j_idx < n_acc[:, None],
+                     jnp.pad(d, ((0, 0), (0, 1))),
+                     correction[:, None])                   # [S, g+1]
+    return emit, n_acc + 1
+
+
+def speculative_draft_step(draft_params, draft_cache: PagedKVCache, batch,
+                           prev_tokens, pos, rng, temperature, top_p,
+                           draft_cfg: GPTConfig, *, block_size: int,
+                           gamma: int, top_k: int = 0, sampled: bool = False,
+                           mesh=None):
+    """The DRAFT half of one speculative outer step, as its own program —
+    the split-profile mode (``speculative.profile``) dispatches draft and
+    verify separately so the serving telemetry can attribute wall time to
+    each side (the fused burst is one opaque dispatch).  Identical
+    choreography to the draft loop inside ``_speculative_burst_core``:
+    gamma sequential draft decodes plus the extra ingest of d_gamma.
+
+    batch: tokens0/from_device/active/block_table as in the burst;
+    ``pos`` is threaded separately (the verify step advances it by the
+    acceptance count).  Returns greedy ``(d [S, gamma], draft_cache',
+    rng')`` or sampled ``(d, q_logits [S, gamma, V], draft_cache', rng')``.
+    """
+    dk, dv, dks, dvs = _flat_cache_views(draft_cache)
+    active = batch["active"]
+    bt = batch["block_table"]
+    if sampled:
+        from deepspeed_tpu.inference.engine import _sampling_logits
+        xform = functools.partial(_sampling_logits, temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
+    dtok = jnp.where(batch["from_device"], prev_tokens, batch["tokens0"])
+    dpos = pos
+    d_list, q_list = [], []
+    for j in range(gamma + 1):
+        dlogits, dk, dv, dks, dvs = _decode_core(
+            draft_params, dk, dv, dtok, active, dpos, bt, draft_cfg,
+            block_size, mesh=mesh, flat_ks=dks, flat_vs=dvs)
+        if j < gamma:
+            if sampled:
+                ql = xform(dlogits)
+                rng, sub = jax.random.split(rng)
+                dtok = jax.random.categorical(sub, ql, axis=-1).astype(
+                    jnp.int32)
+                q_list.append(ql)
+            else:
+                dtok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            d_list.append(dtok)
+        dpos = dpos + 1
+    d = jnp.stack(d_list, axis=1)                           # [S, gamma]
+    draft_cache = _rebuild_cache(draft_cache, dk, dv, dks, dvs)
+    if sampled:
+        return d, jnp.stack(q_list, axis=1), draft_cache, rng
+    return d, draft_cache, rng
+
+
+def speculative_verify_step(params, cache: PagedKVCache, batch, d, q_logits,
+                            prev_tokens, pos, rng, temperature, top_p,
+                            cfg: GPTConfig, *, block_size: int, gamma: int,
+                            top_k: int = 0, sampled: bool = False,
+                            mesh=None):
+    """The VERIFY half of one speculative outer step (split-profile mode):
+    one multi-token target forward over [seed, d_0..d_{gamma-1}] plus the
+    acceptance rule — ``_greedy_accept`` or ``spec_accept``, the SAME
+    functions the fused burst applies, so split mode is token-identical to
+    fused mode (pinned by tests).  ``q_logits`` is the draft's sampling
+    logits from ``speculative_draft_step`` (ignored when greedy).
+    Returns (emit [S, gamma+1], counts [S], prev', pos', rng', cache')."""
+    fk, fv, fks, fvs = _flat_cache_views(cache)
+    active = batch["active"]
+    seed = jnp.where(batch["from_device"], prev_tokens, batch["tokens0"])
+    ver_in = jnp.concatenate([seed[:, None], d], axis=1)    # [S, gamma+1]
+    vlogits, fk, fv, fks, fvs = _verify_core(
+        params, fk, fv, fks, fvs, ver_in, active, pos, batch["block_table"],
+        cfg, block_size, mesh=mesh)
+    if sampled:
+        from deepspeed_tpu.inference.engine import _sampling_logits
+        xform = functools.partial(_sampling_logits, temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
+        rng, sub = jax.random.split(rng)
+        emit, counts = spec_accept(sub, q_logits, xform(vlogits), d)
+    else:
+        emit, counts = _greedy_accept(vlogits, d, gamma)
+    counts = jnp.where(active, counts, 0)
+    last = jnp.take_along_axis(
+        emit, jnp.maximum(counts - 1, 0)[:, None], axis=1)[:, 0]
+    new_prev = jnp.where(active, last, prev_tokens)
+    new_pos = jnp.where(active, pos + counts, pos)
+    return (emit, counts, new_prev, new_pos, rng,
+            _rebuild_cache(cache, fk, fv, fks, fvs))
 
 
 def spec_accept(rng, q_logits, p_logits, d):

@@ -9,6 +9,11 @@ them per step and adds the TPU-specific hazards nothing else watches:
 - ``watchdog``       — jit recompile detection with leaf-level shape diffs
 - ``registry``       — labeled counter/gauge registries (collective bytes,
                        memory gauges, cache misses)
+- ``histogram``      — log-bucketed histograms with exact quantiles under
+                       a cap (serving latency percentiles)
+- ``serving``        — request-level serving telemetry facade (lifecycle
+                       spans, TTFT/TPOT histograms, KV-pool and
+                       speculative-decode instrumentation)
 - ``exporter``       — snapshot serialization: JSON, Prometheus text
                        exposition, MonitorMaster fan-out
 - ``health``         — in-graph per-module-group numerics stats (grad/param
@@ -28,9 +33,13 @@ from deepspeed_tpu.telemetry.flight_recorder import (FlightRecorder,
 from deepspeed_tpu.telemetry.health import (AnomalyDetector,
                                             compute_group_health,
                                             flatten_health, group_names)
+from deepspeed_tpu.telemetry.histogram import (DEFAULT_BUCKETS, Histogram,
+                                               log_buckets)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, MetricRegistry,
                                               default_registry,
                                               record_collective)
+from deepspeed_tpu.telemetry.serving import (ServingTelemetry,
+                                             ServingTelemetryConfig)
 from deepspeed_tpu.telemetry.step_telemetry import StepTelemetry
 from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
 from deepspeed_tpu.telemetry.watchdog import RecompileWatchdog, signature_of
@@ -38,14 +47,19 @@ from deepspeed_tpu.telemetry.watchdog import RecompileWatchdog, signature_of
 __all__ = [
     "AnomalyDetector",
     "Counter",
+    "DEFAULT_BUCKETS",
     "FlightRecorder",
     "Gauge",
+    "Histogram",
     "MetricRegistry",
     "RecompileWatchdog",
+    "ServingTelemetry",
+    "ServingTelemetryConfig",
     "SnapshotExporter",
     "SpanTracer",
     "StepTelemetry",
     "TraceEmitter",
+    "log_buckets",
     "compute_group_health",
     "default_registry",
     "flatten_health",

@@ -28,8 +28,25 @@ Event = Tuple[str, float, int]
 
 
 def _prom_escape(value: str) -> str:
+    """LABEL-VALUE escaping: backslash, double-quote, newline (the three
+    characters the exposition format's quoted label syntax reserves)."""
     return (value.replace("\\", r"\\").replace('"', r"\"")
             .replace("\n", r"\n"))
+
+
+def _help_escape(value: str) -> str:
+    """HELP-text escaping: only backslash and newline — quotes are legal
+    verbatim in HELP, and escaping them there renders a literal ``\\\"``
+    in every scrape UI."""
+    return value.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _le_label(bound: float) -> str:
+    """Canonical ``le`` rendering: integral bounds without a trailing .0
+    (Prometheus convention), +Inf for the open bucket."""
+    if math.isinf(bound):
+        return "+Inf"
+    return str(int(bound)) if bound == int(bound) else repr(bound)
 
 
 def _prom_name(namespace: str, name: str) -> str:
@@ -94,24 +111,47 @@ class SnapshotExporter:
     def prometheus_text(self, snap: Optional[dict] = None) -> str:
         snap = snap if snap is not None else self.snapshot()
         lines: List[str] = []
+
+        def header(pname: str, metric: dict, prom_type: str) -> None:
+            # HELP + TYPE for EVERY family (conformance: scrapers treat a
+            # family without TYPE as untyped; help falls back to the metric
+            # name so the line is never empty)
+            lines.append(f"# HELP {pname} "
+                         f"{_help_escape(metric.get('help') or pname)}")
+            lines.append(f"# TYPE {pname} {prom_type}")
+
+        def label_body(labels: dict, extra: str = "") -> str:
+            parts = [f'{k}="{_prom_escape(str(v))}"'
+                     for k, v in sorted(labels.items())]
+            if extra:
+                parts.append(extra)
+            return ("{" + ",".join(parts) + "}") if parts else ""
+
         for kind_key, prom_type in (("counters", "counter"),
                                     ("gauges", "gauge")):
             for name, metric in sorted(snap.get(kind_key, {}).items()):
                 pname = _prom_name(self.namespace, name)
-                if metric.get("help"):
-                    lines.append(f"# HELP {pname} "
-                                 f"{_prom_escape(metric['help'])}")
-                lines.append(f"# TYPE {pname} {prom_type}")
+                header(pname, metric, prom_type)
                 for s in metric["samples"]:
                     labels = s.get("labels") or {}
-                    if labels:
-                        body = ",".join(
-                            f'{k}="{_prom_escape(str(v))}"'
-                            for k, v in sorted(labels.items()))
-                        lines.append(
-                            f"{pname}{{{body}}} {_prom_value(s['value'])}")
-                    else:
-                        lines.append(f"{pname} {_prom_value(s['value'])}")
+                    lines.append(f"{pname}{label_body(labels)} "
+                                 f"{_prom_value(s['value'])}")
+        for name, metric in sorted(snap.get("histograms", {}).items()):
+            pname = _prom_name(self.namespace, name)
+            header(pname, metric, "histogram")
+            bounds = list(metric.get("buckets", [])) + [float("inf")]
+            for s in metric["samples"]:
+                labels = s.get("labels") or {}
+                cum = 0
+                for bound, c in zip(bounds, s["bucket_counts"]):
+                    cum += int(c)
+                    body = label_body(labels,
+                                      extra=f'le="{_le_label(bound)}"')
+                    lines.append(f"{pname}_bucket{body} {cum}")
+                lines.append(f"{pname}_sum{label_body(labels)} "
+                             f"{_prom_value(s['sum'])}")
+                lines.append(f"{pname}_count{label_body(labels)} "
+                             f"{int(s['count'])}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_prometheus(self, path: str,
@@ -144,4 +184,19 @@ class SnapshotExporter:
                         str(labels[k]) for k in sorted(labels)]
                     events.append(("/".join(parts), float(s["value"]),
                                    int(x)))
+        # histograms flatten to the scalar summaries monitors can plot
+        # (count + exact/interpolated percentiles; the full bucket vector
+        # stays in the Prometheus/JSON sinks)
+        for name, metric in sorted(snap.get("histograms", {}).items()):
+            for s in metric["samples"]:
+                labels = s.get("labels") or {}
+                lparts = [str(labels[k]) for k in sorted(labels)]
+                for field in ("count", "p50", "p99"):
+                    v = s.get(field)
+                    if v is None or (isinstance(v, float)
+                                     and math.isnan(v)):
+                        continue
+                    events.append(("/".join(
+                        [prefix, f"{name}_{field}"] + lparts),
+                        float(v), int(x)))
         return events

@@ -104,15 +104,46 @@ class MetricRegistry:
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get(Gauge, name, help)
 
+    def histogram(self, name: str, help: str = "", buckets=None):
+        """Get-or-create a Histogram (telemetry/histogram.py).  A repeat
+        call must not silently change the bucket ladder: cumulative
+        ``le`` series under two ladders cannot be merged, so a mismatch
+        raises."""
+        from deepspeed_tpu.telemetry.histogram import Histogram
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help, buckets=buckets)
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{m.kind}, requested histogram")
+            elif (buckets is not None
+                  and tuple(float(b) for b in buckets) != m.buckets):
+                raise ValueError(f"histogram {name!r} already registered "
+                                 f"with different buckets")
+            return m
+
     def metrics(self) -> List[_Metric]:
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]
 
     def snapshot(self) -> Dict[str, dict]:
         """{"counters": {name: {"help", "samples": [{"labels", "value"}]}},
-        "gauges": {...}} — the JSON-stable form exporter.py serializes."""
-        out = {"counters": {}, "gauges": {}}
+        "gauges": {...}, "histograms": {name: {"help", "buckets",
+        "samples": [{"labels", "count", "sum", "bucket_counts",
+        "p50"/"p90"/"p99"}]}}} — the JSON-stable form exporter.py
+        serializes."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
         for m in self.metrics():
+            if m.kind == "histogram":
+                out["histograms"][m.name] = {
+                    "help": m.help,
+                    "buckets": list(m.buckets),
+                    "samples": [{"labels": labels, **stats}
+                                for labels, stats in m.samples()],
+                }
+                continue
             bucket = out["counters" if m.kind == "counter" else "gauges"]
             bucket[m.name] = {
                 "help": m.help,

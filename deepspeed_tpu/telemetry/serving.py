@@ -1,0 +1,368 @@
+"""ServingTelemetry — request-level observability for the inference engines.
+
+PR 1 made the training loop observable; the serving path was blind: no
+spans, no counters, speculative stats in an ad-hoc dict.  This facade is
+the serving-side sibling of ``StepTelemetry``, built for the questions a
+serving operator actually asks:
+
+- **latency percentiles** (p50/p99 TTFT / TPOT / e2e) — histograms, because
+  a counter can only produce a mean and SLOs are percentiles;
+- **where a request's time went** — per-request lifecycle spans
+  (queue_wait → prefill → decode) on one Perfetto track per request,
+  next to the engine's dispatch spans on track 0;
+- **is the KV pool the bottleneck** — blocks used/free, internal
+  fragmentation of allocated pages, and allocation-failure counters per
+  decision site (the baseline a radix prefix cache has to beat);
+- **why is speculative decoding slow** — accepted/proposed tokens and
+  draft/verify wall-time counters replacing ``eng.spec_stats``.
+
+One instance per engine with its OWN ``MetricRegistry`` by default (two
+engines in one process — the bench runs seven — must not blend their
+accept ratios); pass ``registry=telemetry.default_registry`` to fold the
+serving series into the process-wide scrape instead.
+
+Timestamps: request lifecycle times are ``time.perf_counter()`` seconds
+(callers may substitute a fake clock for deterministic tests); spans
+convert through the tracer's epoch so request tracks line up with
+dispatch spans in one trace.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import nullcontext
+from typing import Dict, Optional
+
+from deepspeed_tpu.config import DeepSpeedConfigModel
+from deepspeed_tpu.telemetry.exporter import SnapshotExporter
+from deepspeed_tpu.telemetry.registry import MetricRegistry
+from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
+
+_NULL = nullcontext()
+
+
+class ServingTelemetryConfig(DeepSpeedConfigModel):
+    """``telemetry`` block of the inference engine configs.
+
+    ``enabled`` covers counters/gauges/histograms (a few dict updates and
+    ``perf_counter`` reads per DISPATCH, not per token — cheap enough to
+    default on).  ``trace_enabled`` adds span recording (bounded buffer).
+    ``stream_sync`` blocks on each dispatch's output before timestamping —
+    the streaming-server behavior that makes TTFT/TPOT reflect device
+    completion instead of host submission; it serializes the dispatch
+    pipeline, so it defaults off and the open-loop bench harness turns it
+    on explicitly."""
+
+    enabled: bool = True
+    trace_enabled: bool = True
+    max_trace_events: int = 100_000
+    stream_sync: bool = False
+
+
+class ServingTelemetry:
+    def __init__(self, config: Optional[ServingTelemetryConfig] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 pid: Optional[int] = None):
+        cfg = config or ServingTelemetryConfig()
+        self.config = cfg
+        self.enabled = bool(cfg.enabled)
+        self.stream_sync = bool(cfg.stream_sync)
+        self.registry = registry if registry is not None else MetricRegistry()
+        if pid is None:
+            import jax
+            pid = jax.process_index()
+        self.tracer = SpanTracer(
+            enabled=self.enabled and bool(cfg.trace_enabled), pid=pid,
+            max_events=int(cfg.max_trace_events))
+        self.emitter = TraceEmitter(process_name="deepspeed_tpu_serving")
+        self.exporter = SnapshotExporter(self.registry, self.tracer)
+        self._track_count = 0
+        # per-request summaries (bounded): histograms answer fleet-level
+        # percentile questions, but goodput ("which requests met BOTH their
+        # TTFT and TPOT SLOs, and how many tokens did those produce") needs
+        # per-request joint attainment — the bench reads this log
+        self.request_log: list = []
+        self.request_log_cap = 100_000
+        if not self.enabled:
+            return
+        reg = self.registry
+        # ---- registered eagerly: every metric carries its help text from
+        # the first scrape, and scripts/check_metrics.py sees the literals
+        self.h_ttft = reg.histogram(
+            "serving_ttft_ms", "request arrival to first generated token "
+            "(time-to-first-token), per completed request")
+        self.h_tpot = reg.histogram(
+            "serving_tpot_ms", "mean inter-token latency after the first "
+            "token (time-per-output-token), per completed request")
+        self.h_e2e = reg.histogram(
+            "serving_e2e_ms", "request arrival to completion, per request")
+        self.h_queue = reg.histogram(
+            "serving_queue_ms", "request arrival to admission (first "
+            "prompt chunk scheduled), per request")
+        self.h_prefill = reg.histogram(
+            "serving_prefill_ms", "admission to prefill complete (request "
+            "decode-ready), per request")
+        self.c_requests = reg.counter(
+            "serving_requests_total", "requests retired, per outcome")
+        self.c_tokens = reg.counter(
+            "serving_tokens_total", "tokens scheduled through the serving "
+            "engine, per phase (prefill / decode / spec)")
+        self.c_dispatch = reg.counter(
+            "serving_dispatches_total", "device dispatches issued by the "
+            "serving engine, per program kind")
+        self.c_preempt = reg.counter(
+            "serving_preemptions_total", "recompute-preemption victims "
+            "taken, per victim state (decode_ready / mid_prefill)")
+        self.g_occupancy = reg.gauge(
+            "serving_batch_occupancy", "running sequences / sequence slots "
+            "at the most recent dispatch")
+        self.g_padding = reg.gauge(
+            "serving_bucket_padding_waste", "dead fraction of the most "
+            "recent mixed forward's padded token bucket "
+            "((bucket - live tokens) / bucket)")
+        self.c_kv_fail = reg.counter(
+            "kv_alloc_failures_total", "KV block/slot requests the "
+            "allocator could not satisfy, per decision site")
+        self.g_kv_blocks = reg.gauge(
+            "kv_pool_blocks", "paged KV pool blocks, per state "
+            "(used / free)")
+        self.g_kv_frag = reg.gauge(
+            "kv_pool_fragmentation", "internal fragmentation of allocated "
+            "KV blocks: 1 - live tokens / (allocated blocks * block size)")
+        self.c_spec_outer = reg.counter(
+            "spec_outer_steps_total", "speculative draft-and-verify outer "
+            "steps executed, summed over sequences")
+        self.c_spec_proposed = reg.counter(
+            "spec_proposed_tokens_total", "draft tokens proposed to the "
+            "verify step (gamma per outer step per sequence)")
+        self.c_spec_accepted = reg.counter(
+            "spec_draft_accepted_tokens_total", "draft-proposed tokens the "
+            "verify step accepted (excludes the per-step bonus/correction "
+            "token)")
+        self.c_spec_emitted = reg.counter(
+            "spec_emitted_tokens_total", "tokens emitted by speculative "
+            "outer steps (accepted draft tokens + the bonus/correction "
+            "token each step)")
+        self.c_spec_ms = reg.counter(
+            "spec_burst_ms_total", "wall milliseconds spent in fused "
+            "speculative dispatches, including their host sync")
+        self.c_spec_draft_ms = reg.counter(
+            "spec_draft_ms_total", "wall milliseconds in draft-model "
+            "dispatches (speculative.profile split mode only)")
+        self.c_spec_verify_ms = reg.counter(
+            "spec_verify_ms_total", "wall milliseconds in verify "
+            "dispatches (speculative.profile split mode only)")
+        self.g_spec_ratio = reg.gauge(
+            "spec_accept_ratio", "cumulative draft-token acceptance: "
+            "accepted / proposed")
+
+    # ------------------------------------------------------------- clocks
+
+    @staticmethod
+    def now() -> float:
+        """Lifecycle clock (seconds).  One definition so engine timestamps
+        and histogram math never mix clock bases."""
+        return time.perf_counter()
+
+    def _trace_us(self, t_seconds: float) -> float:
+        """Map a lifecycle timestamp onto the tracer's microsecond epoch so
+        request tracks align with dispatch spans."""
+        return t_seconds * 1e9 / 1e3 - self.tracer._epoch_ns / 1e3
+
+    # -------------------------------------------------------------- spans
+
+    def span(self, name: str, **args):
+        if not self.tracer.enabled:
+            return _NULL
+        return self.tracer.span(name, **args)
+
+    # ---------------------------------------------------- request lifecycle
+
+    def new_track(self, label: str) -> int:
+        """Allocate a trace track (tid) for one request; tid 0 stays the
+        engine dispatch track.  Track NAMES are bounded by the event-buffer
+        size: a long-lived engine serves unboundedly many requests, and an
+        unbounded thread_names dict would leak ~100B per request forever
+        (the span deque itself is bounded) — requests past the bound still
+        get a tid, just no name metadata."""
+        self._track_count += 1
+        tid = self._track_count
+        if (self.tracer.enabled
+                and len(self.tracer.thread_names) < self.tracer.max_events):
+            self.tracer.set_thread_name(tid, label)
+        return tid
+
+    def finish_request(self, *, uid, track: int, t_arrival: float,
+                       t_admit: Optional[float],
+                       t_prefill_end: Optional[float],
+                       t_first: Optional[float], t_last: Optional[float],
+                       n_prompt: int, n_generated: int,
+                       preempts: int = 0, outcome: str = "completed") -> None:
+        """Record one retired request: latency histograms + the three
+        lifecycle spans on the request's own track.  Timestamps are
+        ``now()`` seconds; missing stages (a zero-token completion) are
+        skipped rather than guessed."""
+        if not self.enabled:
+            return
+        self.c_requests.inc(1, outcome=outcome)
+        t_done = t_last if t_last is not None else self.now()
+        rec = {"uid": uid, "outcome": outcome,
+               "prompt_tokens": int(n_prompt),
+               "generated_tokens": int(n_generated),
+               "preempts": int(preempts),
+               "e2e_ms": (t_done - t_arrival) * 1e3,
+               "ttft_ms": None, "tpot_ms": None}
+        self.h_e2e.observe(rec["e2e_ms"])
+        if t_admit is not None:
+            self.h_queue.observe((t_admit - t_arrival) * 1e3)
+            if t_prefill_end is not None:
+                self.h_prefill.observe((t_prefill_end - t_admit) * 1e3)
+        if t_first is not None:
+            rec["ttft_ms"] = (t_first - t_arrival) * 1e3
+            self.h_ttft.observe(rec["ttft_ms"])
+            if t_last is not None and n_generated > 1:
+                rec["tpot_ms"] = (t_last - t_first) * 1e3 / (n_generated - 1)
+                self.h_tpot.observe(rec["tpot_ms"])
+        if len(self.request_log) < self.request_log_cap:
+            self.request_log.append(rec)
+        if self.tracer.enabled:
+            args = {"uid": uid, "prompt_tokens": int(n_prompt),
+                    "generated_tokens": int(n_generated),
+                    "preempts": int(preempts), "outcome": outcome}
+            spans = [("queue_wait", t_arrival, t_admit),
+                     ("prefill", t_admit, t_prefill_end),
+                     ("decode", t_prefill_end, t_last)]
+            for name, a, b in spans:
+                if a is None or b is None or b < a:
+                    continue
+                self.tracer.record(name, self._trace_us(a), (b - a) * 1e6,
+                                   tid=track, cat="request", **args)
+
+    # ----------------------------------------------------------- counters
+
+    def dispatch(self, kind: str) -> None:
+        if self.enabled:
+            self.c_dispatch.inc(1, kind=kind)
+
+    def tokens(self, phase: str, n: int) -> None:
+        if self.enabled and n:
+            self.c_tokens.inc(n, phase=phase)
+
+    def preemption(self, kind: str) -> None:
+        if self.enabled:
+            self.c_preempt.inc(1, kind=kind)
+
+    def occupancy(self, running: int, slots: int) -> None:
+        if self.enabled and slots:
+            self.g_occupancy.set(running / slots)
+
+    def padding_waste(self, live_tokens: int, bucket: int) -> None:
+        if self.enabled and bucket:
+            self.g_padding.set((bucket - live_tokens) / bucket)
+
+    # ------------------------------------------------------------ KV pool
+
+    def alloc_failure(self, site: str, n: int = 1) -> None:
+        if self.enabled:
+            self.c_kv_fail.inc(n, site=site)
+
+    def kv_sample(self, state) -> None:
+        """Gauge the paged pool off a DSStateManager: used/free blocks and
+        internal fragmentation.  O(tracked sequences) — called once per
+        scheduler round, not per token."""
+        if not self.enabled:
+            return
+        free = state.allocator.free_blocks
+        total = state.allocator.num_blocks
+        used = total - free
+        self.g_kv_blocks.set(used, state="used")
+        self.g_kv_blocks.set(free, state="free")
+        alloc_tokens = 0
+        live_tokens = 0
+        for seq in state.tracked.values():
+            alloc_tokens += len(seq.blocks) * state.block_size
+            live_tokens += seq.seen_tokens
+        self.g_kv_frag.set(
+            1.0 - live_tokens / alloc_tokens if alloc_tokens else 0.0)
+
+    # -------------------------------------------------------- speculative
+
+    def spec_burst(self, *, outer: int, n_seqs: int, gamma: int,
+                   emitted: int, dur_ms: float) -> None:
+        """Account one fused speculative dispatch: ``emitted`` is the total
+        token count the burst produced (counts.sum over the served slots);
+        every outer step also emits exactly one non-draft bonus/correction
+        token, so draft-accepted = emitted - outer*n_seqs."""
+        if not self.enabled:
+            return
+        steps = outer * n_seqs
+        self.c_spec_outer.inc(steps)
+        self.c_spec_proposed.inc(steps * gamma)
+        self.c_spec_accepted.inc(max(0, emitted - steps))
+        self.c_spec_emitted.inc(emitted)
+        self.c_spec_ms.inc(dur_ms)
+        proposed = self.c_spec_proposed.value()
+        if proposed:
+            self.g_spec_ratio.set(
+                self.c_spec_accepted.value() / proposed)
+
+    def spec_profile(self, draft_ms: float, verify_ms: float) -> None:
+        if self.enabled:
+            self.c_spec_draft_ms.inc(draft_ms)
+            self.c_spec_verify_ms.inc(verify_ms)
+
+    def spec_summary(self) -> Dict[str, float]:
+        """The bench/test-facing read of the speculative counters (replaces
+        the old ``eng.spec_stats`` dict)."""
+        if not self.enabled:
+            return {}
+        proposed = self.c_spec_proposed.value()
+        outer = self.c_spec_outer.value()
+        return {
+            "outer_steps": outer,
+            "proposed": proposed,
+            "accepted": self.c_spec_accepted.value(),
+            "emitted": self.c_spec_emitted.value(),
+            "accept_ratio": (self.c_spec_accepted.value() / proposed
+                             if proposed else 0.0),
+            "emitted_per_outer": (self.c_spec_emitted.value() / outer
+                                  if outer else 0.0),
+            "burst_ms": self.c_spec_ms.value(),
+            "draft_ms": self.c_spec_draft_ms.value(),
+            "verify_ms": self.c_spec_verify_ms.value(),
+            "draft_dispatches": self.c_dispatch.value(kind="spec_draft"),
+            "verify_dispatches": self.c_dispatch.value(kind="spec_verify"),
+        }
+
+    # -------------------------------------------------------------- reads
+
+    def value(self, name: str, **labels) -> float:
+        m = self.registry._metrics.get(name)
+        return m.value(**labels) if m is not None else 0.0
+
+    def quantile(self, name: str, q: float, **labels) -> float:
+        m = self.registry._metrics.get(name)
+        if m is None or m.kind != "histogram":
+            return float("nan")
+        return m.quantile(q, **labels)
+
+    # ------------------------------------------------------------- export
+
+    def export(self, out_dir: str, extra: Optional[dict] = None) -> dict:
+        """Write snapshot.json + metrics.prom + trace.json under
+        ``out_dir`` and return the snapshot dict.  The trace is the
+        combined dispatch (tid 0) + per-request track view Perfetto
+        loads directly."""
+        if not self.enabled:
+            return {}
+        os.makedirs(out_dir, exist_ok=True)
+        snap = self.exporter.snapshot(extra=extra)
+        self.exporter.write_json(os.path.join(out_dir, "snapshot.json"),
+                                 snap)
+        self.exporter.write_prometheus(
+            os.path.join(out_dir, "metrics.prom"), snap)
+        if self.tracer.enabled and self.tracer.events:
+            self.emitter.write(os.path.join(out_dir, "trace.json"),
+                               self.tracer)
+        return snap

@@ -51,6 +51,10 @@ class SpanTracer:
         # most recent duration per phase — the flight recorder embeds this
         # in each step record without scanning the buffer
         self.last_dur_ms: Dict[str, float] = {}
+        # tid -> display name (Perfetto thread_name metadata): the serving
+        # layer maps each request onto its own tid so Perfetto renders one
+        # track per request (queue_wait / prefill / decode laid end to end)
+        self.thread_names: Dict[int, str] = {}
         self._epoch_ns = time.perf_counter_ns()
 
     def _now_us(self) -> float:
@@ -76,7 +80,8 @@ class SpanTracer:
             self.record(name, t0, self._now_us() - t0, step=step, **args)
 
     def record(self, name: str, ts_us: float, dur_us: float,
-               step: Optional[int] = None, **args) -> None:
+               step: Optional[int] = None, tid: int = 0,
+               cat: str = "host_phase", **args) -> None:
         if not self.enabled:
             return
         ev_args = dict(args)
@@ -85,9 +90,9 @@ class SpanTracer:
         if len(self.events) == self.max_events:
             self.dropped_events += 1
         self.events.append({
-            "name": name, "cat": "host_phase", "ph": "X",
+            "name": name, "cat": cat, "ph": "X",
             "ts": round(ts_us, 3), "dur": round(dur_us, 3),
-            "pid": self.pid, "tid": 0, "args": ev_args,
+            "pid": self.pid, "tid": int(tid), "args": ev_args,
         })
         self.total_recorded += 1
         agg = self._agg.setdefault(name, {"count": 0, "total_ms": 0.0,
@@ -98,6 +103,11 @@ class SpanTracer:
         if dur_ms > agg["max_ms"]:
             agg["max_ms"] = dur_ms
         self.last_dur_ms[name] = round(dur_ms, 3)
+
+    def set_thread_name(self, tid: int, name: str) -> None:
+        """Name a tid's track in the emitted trace (Perfetto thread_name
+        metadata) — the serving layer names each request's track."""
+        self.thread_names[int(tid)] = str(name)
 
     def summary(self) -> Dict[str, dict]:
         """Per-phase count / total / max / mean milliseconds — the compact
@@ -121,6 +131,7 @@ class SpanTracer:
         self.total_recorded = 0
         self._agg = {}
         self.last_dur_ms = {}
+        self.thread_names = {}
 
 
 class TraceEmitter:
@@ -139,6 +150,11 @@ class TraceEmitter:
             "name": "process_name", "ph": "M", "pid": tracer.pid, "tid": 0,
             "args": {"name": f"{self.process_name}/{tracer.pid}"},
         }]
+        for tid, tname in sorted(tracer.thread_names.items()):
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": tracer.pid,
+                "tid": tid, "args": {"name": tname},
+            })
         return {
             "traceEvents": meta + list(tracer.events),
             "displayTimeUnit": "ms",

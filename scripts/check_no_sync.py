@@ -20,7 +20,16 @@ Scan targets (each file gets the pattern matching its hazard class):
   sanctioned transfer site);
 - ``deepspeed_tpu/checkpoint/__init__.py`` ``save_train_state`` —
   ``wait_until_finished`` / ``device_get`` / ``block_until_ready`` (the
-  background ``_finish`` closure is the sanctioned wait site).
+  background ``_finish`` closure is the sanctioned wait site);
+- ``deepspeed_tpu/inference/v2/engine_v2.py`` serving decode loop
+  (``generate`` + the dispatch helpers) — ``device_get`` /
+  ``block_until_ready``: the whole design of the device-resident sampling
+  loop is that steady state chains async dispatches, so a transfer
+  creeping into the scheduler serializes serving; the speculative counts
+  sync, the opt-in streaming fence, and the split-profile fences are the
+  disclosed (``# sync-ok``) exceptions.  The host-side ``np.asarray``
+  batch staging there is NOT a sync (host numpy), so the scalar patterns
+  don't apply.
 
 Allowed on any line: ``device_get`` in engine.py (an explicit, visible
 host fetch — the sanctioned way to cross the boundary there) and a
@@ -49,6 +58,26 @@ REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 ENGINE_PATH = os.path.join(REPO, "deepspeed_tpu", "engine.py")
 PREFETCH_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime", "prefetch.py")
 CKPT_PATH = os.path.join(REPO, "deepspeed_tpu", "checkpoint", "__init__.py")
+SERVING_PATH = os.path.join(REPO, "deepspeed_tpu", "inference", "v2",
+                            "engine_v2.py")
+
+# the v2 serving hot loop: scheduler + every dispatch helper.  Nested defs
+# (materialize/_append inside generate) are the sanctioned bulk-fetch
+# sites and are skipped by the scanner's nested-def rule.
+SERVING_FUNCS = {
+    "generate",
+    "_run",
+    "_run_decode",
+    "_run_burst",
+    "_run_spec",
+    "_run_spec_split",
+    "_step_sampled",
+    "_stream_fence",
+    "_finish_request",
+}
+# (the serving target scans transfers only — TRANSFER_PATTERN below: the
+# loop stages host numpy arrays with np.asarray all over, which is not a
+# device sync, so the scalar patterns would drown the real hazard class)
 
 # the engine's per-step hot path: batch in → dispatch → reporting
 STEP_PATH_FUNCS = {
@@ -74,6 +103,7 @@ BLOCKING_PATTERN = re.compile(
     r"|\bfloat\(|\bnp\.asarray\(")
 CKPT_PATTERN = re.compile(
     r"wait_until_finished|device_get|block_until_ready")
+TRANSFER_PATTERN = re.compile(r"device_get|block_until_ready")
 # engine.py: device_get is itself the sanctioned idiom; everywhere a
 # '# sync-ok' comment discloses a reviewed, intentional sync
 ENGINE_ALLOW = re.compile(r"device_get|#\s*sync-ok")
@@ -84,6 +114,7 @@ SCAN_TARGETS = [
     (ENGINE_PATH, STEP_PATH_FUNCS, SYNC_PATTERN, ENGINE_ALLOW),
     (PREFETCH_PATH, {"__next__", "close"}, BLOCKING_PATTERN, ALLOW_PATTERN),
     (CKPT_PATH, {"save_train_state"}, CKPT_PATTERN, ALLOW_PATTERN),
+    (SERVING_PATH, SERVING_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
 ]
 
 

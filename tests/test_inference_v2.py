@@ -252,13 +252,16 @@ class TestSpeculative:
         got = spec.generate(prompts, max_new_tokens=18)
         for w, g in zip(want, got):
             np.testing.assert_array_equal(w, g)
-        st = spec.spec_stats
+        st = spec.telemetry.spec_summary()
         assert st["outer_steps"] > 0          # the spec path actually ran
         # identical weights: the draft should track the target closely
         # (decode vs verify run different-but-equivalent fp32 programs, so
         # rare near-tie divergence is tolerated)
         gamma = spec.config.speculative.gamma
-        assert st["tokens"] / st["outer_steps"] > 0.8 * (gamma + 1), st
+        assert st["emitted_per_outer"] > 0.8 * (gamma + 1), st
+        # proposed/accepted/emitted counters are mutually consistent
+        assert st["emitted"] == st["accepted"] + st["outer_steps"]
+        assert 0.0 <= st["accept_ratio"] <= 1.0
 
     def test_random_draft_still_exact(self, cfg, v2cfg, rng):
         prompts = [rng.integers(0, 97, (12 + i,)).astype(np.int32)
@@ -271,7 +274,7 @@ class TestSpeculative:
         got = spec.generate(prompts, max_new_tokens=15)
         for w, g in zip(want, got):
             np.testing.assert_array_equal(w, g)
-        assert spec.spec_stats["outer_steps"] > 0
+        assert spec.telemetry.spec_summary()["outer_steps"] > 0
 
     def test_eos_and_heterogeneous_budgets(self, cfg, v2cfg, rng):
         prompts = [rng.integers(0, 97, (11 + i,)).astype(np.int32)
@@ -332,7 +335,7 @@ class TestSpeculativeSampled:
                             temperature=1e-5)
         for w, g in zip(want, got):
             np.testing.assert_array_equal(w, g)
-        assert spec.spec_stats["outer_steps"] > 0
+        assert spec.telemetry.spec_summary()["outer_steps"] > 0
 
     def test_same_seed_reproduces(self, cfg, v2cfg, rng):
         prompts = [rng.integers(0, 97, (12 + i,)).astype(np.int32)

@@ -409,11 +409,21 @@ class TestEngineTelemetry:
         assert not engine.telemetry.tracer.events
 
     def test_checkpoint_span_recorded(self, tmp_path):
+        """The async-checkpoint split (PR 3) renamed the SAVE path's span to
+        checkpoint_snapshot + checkpoint_write (recorded at commit); only
+        the LOAD path still records checkpoint_io.  The old assertion
+        checked checkpoint_io after a save, which failed standalone on a
+        clean tree — assert what each path actually records, with no
+        dependence on test order."""
         default_registry.reset()
         engine = _engine(tmp_path)
         rng = np.random.default_rng(0)
         engine.train_batch(_batch(rng, engine.train_batch_size))
         engine.save_checkpoint(str(tmp_path / "ckpt"))
-        assert any(e["name"] == "checkpoint_io"
-                   for e in engine.telemetry.tracer.events)
+        names = [e["name"] for e in engine.telemetry.tracer.events]
+        assert "checkpoint_snapshot" in names
+        assert "checkpoint_write" in names    # blocking save commits inline
+        engine.load_checkpoint(str(tmp_path / "ckpt"))
+        names = [e["name"] for e in engine.telemetry.tracer.events]
+        assert "checkpoint_io" in names       # the load-path span
         default_registry.reset()
