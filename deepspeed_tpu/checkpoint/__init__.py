@@ -30,11 +30,29 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 LATEST_FILE = "latest"
+# newest COMPLETE universal export in a run dir (written post-commit by
+# export_universal; latest_universal falls back to a scan when absent)
+UNIVERSAL_LATEST_FILE = "latest_universal"
 # exists inside <tag>/ from before the first byte of an asynchronous write
 # until its commit — a crash mid-write leaves the marker behind, 'latest'
 # still points at the previous committed tag, and restore of the marked tag
 # fails loudly instead of loading a torn state
 IN_PROGRESS_FILE = ".in_progress"
+
+
+class CheckpointNotFound(FileNotFoundError):
+    """No checkpoint at the requested path/tag.  Replaces the grab-bag of
+    backend exceptions (orbax FileNotFoundError, KeyError on a missing
+    'latest', bare OSError) so elastic restart logic can catch ONE type and
+    fall back to the previous export / cold start."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint exists but must not be restored: its write never
+    committed (``.in_progress`` marker still present) or its payload is
+    torn/unreadable.  Restart logic treats this exactly like NotFound for
+    resume-source selection, but the distinct type keeps the operator
+    signal: data WAS lost here, look at the dead host."""
 
 
 def __getattr__(name):
@@ -45,6 +63,70 @@ def __getattr__(name):
         from deepspeed_tpu.checkpoint import universal
         return getattr(universal, name)
     raise AttributeError(name)
+
+
+def universal_complete(path: str) -> bool:
+    """A universal export is COMPLETE iff its meta.json landed and its
+    in-progress marker came off — the commit order export_universal
+    enforces.  Anything else (marker present, meta missing, not a dir) is
+    torn or foreign."""
+    return (os.path.isdir(os.path.join(path, "zero"))
+            and os.path.exists(os.path.join(path, "meta.json"))
+            and not os.path.exists(os.path.join(path, IN_PROGRESS_FILE)))
+
+
+def _universal_step(path: str) -> Optional[int]:
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            return int(json.load(f).get("step", -1))
+    except (OSError, ValueError):
+        return None
+
+
+def universal_candidates(run_dir: str) -> list:
+    """Every COMPLETE universal export under ``run_dir`` (plus the
+    ``latest_universal`` pointer's target, which may live outside it),
+    newest ``meta.json`` step first.  The pointer is a candidate, never an
+    authority: a host that died BETWEEN the export commit and the pointer
+    move leaves a stale pointer, and the newest complete DATA must still
+    win (chaos leg: fault at ``universal.pre_pointer``).  Torn exports
+    (in-progress marker, missing meta) never qualify.  Resume logic walks
+    this list so a corrupt-but-committed newest export degrades to the one
+    before it instead of crash-looping."""
+    candidates = []
+    ptr = os.path.join(run_dir, UNIVERSAL_LATEST_FILE)
+    if os.path.exists(ptr):
+        with open(ptr) as f:
+            cand = f.read().strip()
+        if cand and not os.path.isabs(cand):
+            cand = os.path.join(run_dir, cand)
+        if cand:
+            candidates.append(cand)
+    if os.path.isdir(run_dir):
+        candidates.extend(os.path.join(run_dir, name)
+                          for name in sorted(os.listdir(run_dir)))
+    scored = {}
+    seen = set()
+    for d in candidates:
+        key = os.path.abspath(d)
+        if key in seen:
+            continue
+        seen.add(key)
+        if not universal_complete(d):
+            continue
+        step = _universal_step(d)
+        if step is not None:
+            scored[d] = step
+    return sorted(scored, key=lambda d: scored[d], reverse=True)
+
+
+def latest_universal(run_dir: str) -> Optional[str]:
+    """Path of the newest COMPLETE universal export under ``run_dir``, or
+    None — the head of :func:`universal_candidates`.  This is the library
+    home of the scan the elastic worker contract requires (previously
+    hand-rolled in tests/elastic_train_script.py)."""
+    cands = universal_candidates(run_dir)
+    return cands[0] if cands else None
 
 # one long-lived async checkpointer (orbax guidance; a fresh instance per save
 # would serialize on its own setup) + a waiter thread for deferred metadata
@@ -116,7 +198,7 @@ def commit_latest(save_dir: str, tag: str) -> None:
 def check_not_in_progress(load_dir: str, tag: str) -> None:
     """Refuse to restore a tag whose async write never committed."""
     if in_progress(load_dir, tag):
-        raise RuntimeError(
+        raise CheckpointCorrupt(
             f"checkpoint {os.path.join(load_dir, tag)} carries "
             f"{IN_PROGRESS_FILE}: its async write never committed (crash "
             f"mid-write) — the state under it may be torn.  Load the "
@@ -198,6 +280,10 @@ def restore_train_state(load_dir: str, tag: str, shardings, like_state
     wait_pending()                       # a racing async save must commit
     check_not_in_progress(load_dir, tag)
     path = _ckpt_path(load_dir, tag)
+    if not os.path.isdir(path):
+        raise CheckpointNotFound(
+            f"no checkpoint state under {os.path.join(load_dir, tag)} "
+            f"(expected {path})")
     abstract = jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         like_state, shardings)

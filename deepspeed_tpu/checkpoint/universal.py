@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -36,6 +37,60 @@ import numpy as np
 
 FORMAT = "deepspeed_tpu_universal/1"
 _FRAGMENT_KEYS = ("fp32", "exp_avg", "exp_avg_sq")
+
+
+# ---------------------------------------------------------------------------
+# crash-safe commit protocol (same ordering as the orbax tag commit in
+# checkpoint/__init__.py): .in_progress marker → every fragment byte + meta
+# durable → marker off → 'latest_universal' pointer moves.  A death at any
+# point leaves either a torn export that load_universal REFUSES (marker
+# present / meta missing) and latest_universal() skips, or a committed
+# export the pointer may trail — the previous complete export resumes
+# either way.
+# ---------------------------------------------------------------------------
+
+def _begin_export(out_dir: str) -> str:
+    from deepspeed_tpu.checkpoint import IN_PROGRESS_FILE
+    from deepspeed_tpu.runtime import faults
+    os.makedirs(out_dir, exist_ok=True)
+    marker = os.path.join(out_dir, IN_PROGRESS_FILE)
+    with open(marker, "w") as f:
+        f.write(str(time.time()))
+    faults.fire("universal.pre_fragments", out_dir=out_dir)
+    return marker
+
+
+def _commit_export(out_dir: str, marker: str,
+                   run_dir: Optional[str] = None) -> str:
+    from deepspeed_tpu.checkpoint import UNIVERSAL_LATEST_FILE
+    from deepspeed_tpu.runtime import faults
+    faults.fire("universal.pre_commit", out_dir=out_dir)
+    os.remove(marker)                    # data durable → marker off
+    if run_dir:
+        faults.fire("universal.pre_pointer", out_dir=out_dir)
+        ptr = os.path.join(run_dir, UNIVERSAL_LATEST_FILE)
+        rel = os.path.relpath(os.path.abspath(out_dir),
+                              os.path.abspath(run_dir))
+        target = out_dir if rel.startswith(os.pardir) else rel
+        with open(ptr + ".tmp", "w") as f:
+            f.write(target)
+        os.replace(ptr + ".tmp", ptr)    # pointer moves last, atomically
+    return out_dir
+
+
+def _write_meta_json(out_dir: str, step: int, manifest: dict,
+                     layout: Optional[dict]) -> None:
+    from deepspeed_tpu.runtime import faults
+    faults.fire("universal.pre_meta", out_dir=out_dir)
+    meta = {"format": FORMAT, "step": int(step), "params": manifest}
+    if layout:
+        # logical layout metadata: how the SOURCE engine laid these params
+        # out (pipeline stages, zero stage, mesh) — restore-time relayout
+        # (checkpoint/reshard.py) keys on it.  Fragments on disk are always
+        # in the LOGICAL (per-layer, unstacked) namespace.
+        meta["layout"] = layout
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
 
 
 # ---------------------------------------------------------------------------
@@ -143,53 +198,86 @@ def _unflatten_params(flat: Dict[str, Any]) -> Dict[str, Any]:
 # export
 # ---------------------------------------------------------------------------
 
-def export_universal(state, out_dir: str, *, step: Optional[int] = None
-                     ) -> str:
-    """Write a TrainState (or any (params, opt_state) carrier) as universal
-    fp32 fragments.
-
-    state: engine ``TrainState`` (device or host arrays).  Master weights are
-    taken from the optimizer's ``MasterWeightsState`` when present (true fp32
-    masters, reference _create_fp32_partitions), else params are upcast.
-    """
-    params = state.params
+def state_fragments(state) -> Dict[str, Dict[str, np.ndarray]]:
+    """The in-memory form of a universal checkpoint: {dotted_path: {fp32,
+    exp_avg?, exp_avg_sq?}} host numpy fragments pulled from a TrainState
+    (or any (params, opt_state) carrier).  Master weights come from the
+    optimizer's ``MasterWeightsState`` when present (true fp32 masters,
+    reference _create_fp32_partitions), else params are upcast."""
+    flat = _flatten_params(state.params)
     opt_state = state.opt_state
-    flat = _flatten_params(params)
-    paths = list(flat)
-
     masters = _master_states(opt_state)
     master_flat = _flatten_params(masters[0]["master"]) if masters else flat
     adams = _adam_states(opt_state)
     mu_flat = _flatten_params(adams[0]["mu"]) if adams else None
     nu_flat = _flatten_params(adams[0]["nu"]) if adams else None
 
-    zdir = os.path.join(out_dir, "zero")
-    os.makedirs(zdir, exist_ok=True)
-    manifest = {}
-    for p in paths:
-        d = os.path.join(zdir, p)
-        os.makedirs(d, exist_ok=True)
+    frags: Dict[str, Dict[str, np.ndarray]] = {}
+    for p in flat:
         w = np.asarray(jax.device_get(master_flat[p]))
         # bf16 needs the explicit dtype compare — numpy's kind for ml_dtypes
         # bfloat16 is not "f"
         if w.dtype != np.float32 and (w.dtype.kind == "f"
                                       or w.dtype == jax.numpy.bfloat16):
             w = w.astype(np.float32)
-        np.save(os.path.join(d, "fp32.npy"), w)
+        entry = {"fp32": w}
         if mu_flat is not None:
-            np.save(os.path.join(d, "exp_avg.npy"),
-                    np.asarray(jax.device_get(mu_flat[p]), np.float32))
-            np.save(os.path.join(d, "exp_avg_sq.npy"),
-                    np.asarray(jax.device_get(nu_flat[p]), np.float32))
-        manifest[p] = {"shape": list(w.shape), "dtype": str(w.dtype),
-                       "has_moments": mu_flat is not None}
+            entry["exp_avg"] = np.asarray(jax.device_get(mu_flat[p]),
+                                          np.float32)
+            entry["exp_avg_sq"] = np.asarray(jax.device_get(nu_flat[p]),
+                                             np.float32)
+        frags[p] = entry
+    return frags
 
+
+def write_fragments(frags: Dict[str, Dict[str, np.ndarray]], out_dir: str,
+                    *, step: int, layout: Optional[dict] = None,
+                    run_dir: Optional[str] = None) -> str:
+    """Write fragments to disk under the crash-safe commit protocol
+    (marker → fragments + meta durable → marker off → pointer)."""
+    from deepspeed_tpu.runtime import faults
+    marker = _begin_export(out_dir)
+    zdir = os.path.join(out_dir, "zero")
+    os.makedirs(zdir, exist_ok=True)
+    manifest = {}
+    half = len(frags) // 2
+    for i, p in enumerate(sorted(frags)):
+        if i == half:
+            faults.fire("universal.mid_fragments", out_dir=out_dir)
+        entry = frags[p]
+        d = os.path.join(zdir, p)
+        os.makedirs(d, exist_ok=True)
+        for key in _FRAGMENT_KEYS:
+            if key in entry:
+                np.save(os.path.join(d, key + ".npy"),
+                        np.asarray(entry[key]))
+        w = np.asarray(entry["fp32"])
+        manifest[p] = {"shape": list(w.shape), "dtype": str(w.dtype),
+                       "has_moments": "exp_avg" in entry}
+    _write_meta_json(out_dir, step, manifest, layout)
+    return _commit_export(out_dir, marker, run_dir)
+
+
+def export_universal(state, out_dir: str, *, step: Optional[int] = None,
+                     layout: Optional[dict] = None,
+                     run_dir: Optional[str] = None) -> str:
+    """Write a TrainState (or any (params, opt_state) carrier) as universal
+    fp32 fragments under the crash-safe commit protocol.
+
+    ``layout`` (checkpoint/reshard.py layout descriptor) converts the
+    source engine's physical parameter layout (e.g. pipeline-stacked
+    leaves) into the LOGICAL per-layer namespace before writing, and is
+    recorded in meta.json.  ``run_dir`` additionally moves the
+    ``latest_universal`` pointer post-commit, making this export the
+    fleet's newest COMPLETE resume source."""
     if step is None:
         step = int(jax.device_get(state.step)) if hasattr(state, "step") else 0
-    with open(os.path.join(out_dir, "meta.json"), "w") as f:
-        json.dump({"format": FORMAT, "step": int(step),
-                   "params": manifest}, f, indent=1)
-    return out_dir
+    frags = state_fragments(state)
+    if layout is not None:
+        from deepspeed_tpu.checkpoint import reshard
+        frags = reshard.to_logical(frags, layout)
+    return write_fragments(frags, out_dir, step=int(step), layout=layout,
+                           run_dir=run_dir)
 
 
 # ---------------------------------------------------------------------------
@@ -199,9 +287,14 @@ def export_universal(state, out_dir: str, *, step: Optional[int] = None
 def _read_fragment(d: str, key: str):
     """Read one tensor fragment — native ``.npy``, or reference-style torch
     ``.pt`` (checkpoint/ds_to_universal.py writes fp32.pt/exp_avg.pt/...)."""
+    from deepspeed_tpu.checkpoint import CheckpointCorrupt
     npy = os.path.join(d, key + ".npy")
     if os.path.exists(npy):
-        return np.load(npy)
+        try:
+            return np.load(npy)
+        except (OSError, ValueError, EOFError) as e:
+            raise CheckpointCorrupt(
+                f"{npy}: unreadable fragment ({e}) — torn write?") from e
     pt = os.path.join(d, key + ".pt")
     if os.path.exists(pt):
         import torch
@@ -215,11 +308,29 @@ def load_universal(universal_dir: str,
                    ) -> Tuple[Dict[str, Dict[str, np.ndarray]], dict]:
     """Read a universal dir → ({dotted_path: {fp32, exp_avg?, exp_avg_sq?}},
     meta).  ``name_map`` renames fragment dirs (e.g. torch module names from a
-    reference-produced checkpoint → flax paths); returning None skips one."""
+    reference-produced checkpoint → flax paths); returning None skips one.
+
+    Raises :class:`~deepspeed_tpu.checkpoint.CheckpointNotFound` when the
+    dir is not a universal checkpoint, and
+    :class:`~deepspeed_tpu.checkpoint.CheckpointCorrupt` when it is one
+    whose export never committed (in-progress marker still present) or
+    whose fragments are torn — a crashed writer must never be mistaken for
+    a resume source."""
+    from deepspeed_tpu.checkpoint import (IN_PROGRESS_FILE, CheckpointCorrupt,
+                                          CheckpointNotFound)
+    if not os.path.isdir(universal_dir):
+        raise CheckpointNotFound(
+            f"{universal_dir}: no such universal checkpoint dir")
+    if os.path.exists(os.path.join(universal_dir, IN_PROGRESS_FILE)):
+        raise CheckpointCorrupt(
+            f"{universal_dir} carries {IN_PROGRESS_FILE}: its export never "
+            f"committed (writer died mid-export) — fragments may be torn.  "
+            f"Resume from the previous complete export "
+            f"(checkpoint.latest_universal skips this one).")
     zdir = os.path.join(universal_dir, "zero")
     if not os.path.isdir(zdir):
-        raise FileNotFoundError(f"{universal_dir}: no zero/ fragment dir "
-                                "(not a universal checkpoint)")
+        raise CheckpointNotFound(f"{universal_dir}: no zero/ fragment dir "
+                                 "(not a universal checkpoint)")
     frags: Dict[str, Dict[str, np.ndarray]] = {}
     for name in sorted(os.listdir(zdir)):
         d = os.path.join(zdir, name)
@@ -234,7 +345,8 @@ def load_universal(universal_dir: str,
             if arr is not None:
                 entry[key] = arr
         if "fp32" not in entry:
-            raise FileNotFoundError(f"{d}: no fp32 fragment (.npy or .pt)")
+            raise CheckpointCorrupt(
+                f"{d}: no fp32 fragment (.npy or .pt) — torn export?")
         frags[path] = entry
     meta = {}
     mpath = os.path.join(universal_dir, "meta.json")
@@ -310,41 +422,33 @@ def apply_universal(state, frags: Dict[str, Dict[str, np.ndarray]],
 
 
 def export_universal_offload(params, offload_opt, out_dir: str, *,
-                             step: int = 0) -> str:
+                             step: int = 0, layout: Optional[dict] = None,
+                             run_dir: Optional[str] = None) -> str:
     """Export when the masters/moments live host-side in the ZeRO-Offload
     optimizer (runtime/offload.py OffloadAdam) — the reference's
     ds_to_universal likewise pulls fp32 state out of the swap tier."""
     flat = _flatten_params(params)
     sd = offload_opt.state_dict()
-    zdir = os.path.join(out_dir, "zero")
-    os.makedirs(zdir, exist_ok=True)
-    manifest = {}
+    frags: Dict[str, Dict[str, np.ndarray]] = {}
     for path, leaf in flat.items():
         key = path.replace(".", "/")         # offload keys are "/"-joined
-        d = os.path.join(zdir, path)
-        os.makedirs(d, exist_ok=True)
         shape = np.asarray(leaf).shape
         if f"{key}::master" in sd:
-            np.save(os.path.join(d, "fp32.npy"),
-                    np.asarray(sd[f"{key}::master"],
-                               np.float32).reshape(shape))
-            np.save(os.path.join(d, "exp_avg.npy"),
-                    np.asarray(sd[f"{key}::m"], np.float32).reshape(shape))
-            np.save(os.path.join(d, "exp_avg_sq.npy"),
-                    np.asarray(sd[f"{key}::v"], np.float32).reshape(shape))
-            has_m = True
-            saved_dtype = "float32"
+            frags[path] = {
+                "fp32": np.asarray(sd[f"{key}::master"],
+                                   np.float32).reshape(shape),
+                "exp_avg": np.asarray(sd[f"{key}::m"],
+                                      np.float32).reshape(shape),
+                "exp_avg_sq": np.asarray(sd[f"{key}::v"],
+                                         np.float32).reshape(shape),
+            }
         else:                                 # non-trainable leaf
-            arr = np.asarray(leaf)
-            np.save(os.path.join(d, "fp32.npy"), arr)
-            has_m = False
-            saved_dtype = str(arr.dtype)
-        manifest[path] = {"shape": list(shape), "dtype": saved_dtype,
-                          "has_moments": has_m}
-    with open(os.path.join(out_dir, "meta.json"), "w") as f:
-        json.dump({"format": FORMAT, "step": int(step),
-                   "params": manifest}, f, indent=1)
-    return out_dir
+            frags[path] = {"fp32": np.asarray(leaf)}
+    if layout is not None:
+        from deepspeed_tpu.checkpoint import reshard
+        frags = reshard.to_logical(frags, layout)
+    return write_fragments(frags, out_dir, step=int(step), layout=layout,
+                           run_dir=run_dir)
 
 
 def offload_state_dict_from_fragments(params,

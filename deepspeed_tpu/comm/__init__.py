@@ -31,9 +31,12 @@ from deepspeed_tpu.comm.comm import (
     comms_logger,
     get_comms_logger,
     hlo_collective_bytes,
+    host_rank,
+    host_world_size,
     init_distributed,
     is_initialized,
     profile_jitted,
+    sim_fleet,
 )
 
 __all__ = [
@@ -46,8 +49,11 @@ __all__ = [
     "barrier",
     "get_rank",
     "get_world_size",
+    "host_rank",
+    "host_world_size",
     "init_distributed",
     "is_initialized",
+    "sim_fleet",
     "comms_logger",
     "profile_jitted",
     "hlo_collective_bytes",

@@ -28,6 +28,38 @@ from deepspeed_tpu.utils.logging import logger
 
 _initialized = False
 _init_lock = threading.Lock()
+# simulated-fleet identity (launcher --sim_hosts / elastic agent spawn env):
+# (rank, world) when this process is one "host" of a local CPU simulation,
+# else None
+_sim_identity: Optional[tuple] = None
+
+
+def sim_fleet() -> bool:
+    """True when this process is one simulated host of a local CPU fleet
+    (DSTPU_SIM_FLEET spawn env).  The CPU backend has no cross-process
+    collectives ("Multiprocess computations aren't implemented on the CPU
+    backend"), so sim hosts are INDEPENDENT single-process JAX runtimes:
+    each owns only its local virtual devices, and fleet-level identity
+    comes from :func:`host_rank`/:func:`host_world_size` instead of
+    ``jax.process_index``/``process_count``.  Real DCN/TPU fleets never set
+    the sim env and go through ``jax.distributed`` below."""
+    return _sim_identity is not None
+
+
+def host_rank() -> int:
+    """This host's rank in the fleet: the simulated rank under the sim
+    launcher, ``jax.process_index()`` otherwise."""
+    if _sim_identity is not None:
+        return _sim_identity[0]
+    return jax.process_index()
+
+
+def host_world_size() -> int:
+    """Number of hosts in the fleet: the simulated world under the sim
+    launcher, ``jax.process_count()`` otherwise."""
+    if _sim_identity is not None:
+        return _sim_identity[1]
+    return jax.process_count()
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -41,10 +73,24 @@ def init_distributed(coordinator_address: Optional[str] = None,
     jax.distributed.initialize autodetects coordinator/rank from the TPU metadata
     server; on CPU fleets the caller passes them explicitly (or sets
     JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+    Simulated fleets (``DSTPU_SIM_FLEET`` — the launcher's ``--sim_hosts``
+    path and the elastic agent) skip ``jax.distributed`` entirely: the CPU
+    backend cannot run cross-process computations, so each simulated host
+    stays a single-process runtime and only records its logical
+    (rank, world) for :func:`host_rank`/:func:`host_world_size`.
     """
-    global _initialized
+    global _initialized, _sim_identity
     with _init_lock:
         if _initialized:
+            return
+        if os.environ.get("DSTPU_SIM_FLEET"):
+            _sim_identity = (int(os.environ.get("DSTPU_SIM_RANK", "0")),
+                             int(os.environ.get("DSTPU_SIM_WORLD", "1")))
+            _initialized = True
+            logger.info("simulated fleet: host %d / %d (single-process "
+                        "jax; no cross-process collectives on CPU)",
+                        *_sim_identity)
             return
         # launcher-exported rendezvous env (launcher/runner.py) — read it
         # explicitly rather than trusting jax's own env discovery

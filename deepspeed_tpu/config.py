@@ -463,6 +463,19 @@ class ElasticityJSONConfig(DeepSpeedConfigModel):
     version: float = 0.2
 
 
+class ResilienceConfig(DeepSpeedConfigModel):
+    """Preemption-tolerant operation (runtime/resilience.py; no reference
+    analog — the reference's elasticity runtime assumes a full restart
+    recompiles from scratch).  ``compilation_cache_dir`` points jax's
+    persistent compilation cache at a shared path so a replacement host
+    rebuilds its step programs from cache instead of recompiling;
+    ``aot_warmup`` replays the drained host's executable fingerprints
+    through an AOT compile pass on resume.  See docs/resilience.md."""
+
+    compilation_cache_dir: str = ""     # "" = persistent cache off
+    aot_warmup: bool = True
+
+
 class GradientCompressionConfig(DeepSpeedConfigModel):
     """DCN-tier gradient compression (replaces reference 1-bit optimizers'
     error-feedback compression, runtime/fp16/onebit/ — see SURVEY.md: pointless over
@@ -510,6 +523,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
         default_factory=GradientCompressionConfig)
     elasticity: ElasticityJSONConfig = Field(
         default_factory=ElasticityJSONConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     aio: AIOConfig = Field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0

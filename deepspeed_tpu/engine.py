@@ -100,6 +100,15 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.runtime.overlap import apply_overlap_flags
         apply_overlap_flags(config.overlap)
         comm.init_distributed()
+        if config.resilience.compilation_cache_dir:
+            # persistent XLA compilation cache: a replacement host rebuilds
+            # its step programs from disk instead of recompiling for
+            # minutes (runtime/resilience.py; the config is read at first
+            # COMPILE, so after distributed init is early enough — and the
+            # CPU-unsafe gate needs the resolved backend)
+            from deepspeed_tpu.runtime.resilience import \
+                enable_compilation_cache
+            enable_compilation_cache(config.resilience.compilation_cache_dir)
         comm.comms_logger.configure(config.comms_logger.enabled,
                                     config.comms_logger.verbose)
         warn_inert_config(config)
@@ -1848,10 +1857,16 @@ class DeepSpeedTPUEngine:
 
         if backlog is not None and async_save:
             backlog.set(1)
+        from deepspeed_tpu.checkpoint import reshard
         with tel.span("checkpoint_snapshot", step=step, tag=tag, op="save"):
             save_train_state(save_dir, tag, self.state,
-                             client_state=dict(client_state or {},
-                                               global_steps=self.global_steps),
+                             client_state=dict(
+                                 client_state or {},
+                                 global_steps=self.global_steps,
+                                 # physical layout descriptor: a different
+                                 # topology restoring this tag keys its
+                                 # resharding transform on it
+                                 layout=reshard.engine_layout(self)),
                              block=not async_save, on_commit=on_commit,
                              pre_commit=pre_commit)
         if self.telemetry.enabled and self.telemetry.snapshot_interval:
@@ -1862,6 +1877,30 @@ class DeepSpeedTPUEngine:
                 step=self.global_steps,
                 samples=self.global_steps * int(self.config.train_batch_size))
         return tag
+
+    def drain(self, run_dir: str, *, reason: str = "preemption",
+              out_dir: Optional[str] = None) -> Optional[str]:
+        """Graceful drain on a preemption notice (runtime/resilience.py):
+        fence the overlapped host step and any in-flight async checkpoint,
+        commit a final universal export (+ executable fingerprints) under
+        ``run_dir``, and record ``preemptions_total{reason}`` + the
+        ``drain`` span.  Call from the step loop after
+        ``PreemptionHandler.requested`` turns true; then exit with
+        ``resilience.EXIT_DRAINED``."""
+        from deepspeed_tpu.runtime import resilience
+        return resilience.drain(self, run_dir, reason=reason,
+                                out_dir=out_dir)
+
+    def resume_from_latest(self, run_dir: str,
+                           warmup: Optional[bool] = None) -> Optional[str]:
+        """Resume from the newest COMPLETE universal export under
+        ``run_dir`` (``checkpoint.latest_universal``), AOT-warming the step
+        programs from the drained host's fingerprints when
+        ``resilience.aot_warmup`` is on.  Returns the export path, or None
+        on a cold start.  Records ``restarts_total``, the
+        ``time_to_resume_ms`` histogram, and the ``resume`` span."""
+        from deepspeed_tpu.runtime import resilience
+        return resilience.resume(self, run_dir, warmup=warmup)
 
     def wait_for_checkpoint(self) -> None:
         """Fence for the async checkpoint pipeline: block until any
@@ -1897,32 +1936,39 @@ class DeepSpeedTPUEngine:
             safetensors.numpy.save_file(flat, path)
         return path
 
-    def export_universal_checkpoint(self, out_dir: str) -> str:
+    def export_universal_checkpoint(self, out_dir: str, *,
+                                    run_dir: Optional[str] = None) -> str:
         """reference checkpoint/ds_to_universal.py: dump per-parameter fp32
-        fragments (+ Adam moments) in a framework-neutral layout any topology
-        or toolchain can ingest."""
+        fragments (+ Adam moments) in a framework-neutral LOGICAL layout any
+        topology or toolchain can ingest (pipeline-stacked leaves are
+        unstacked to per-layer fragments — checkpoint/reshard.py).  Written
+        under the crash-safe commit protocol; ``run_dir`` additionally moves
+        the ``latest_universal`` pointer post-commit so elastic workers find
+        this export via ``checkpoint.latest_universal(run_dir)``."""
+        from deepspeed_tpu.checkpoint import reshard
         from deepspeed_tpu.checkpoint import universal as _u
         self._join_host_step()
+        layout = reshard.engine_layout(self)
         if self.offloading:
             return _u.export_universal_offload(
                 jax.device_get(self.state.params), self.offload_opt, out_dir,
-                step=self.global_steps)
-        return _u.export_universal(jax.device_get(self.state), out_dir)
+                step=self.global_steps, layout=layout, run_dir=run_dir)
+        # step = global_steps (train_batch count), not state.step: an
+        # overflow-skipped update leaves state.step behind, and the resume
+        # contract (loss logs, TOTAL_STEPS loops) counts batches
+        return _u.export_universal(jax.device_get(self.state), out_dir,
+                                   step=self.global_steps, layout=layout,
+                                   run_dir=run_dir)
 
-    def load_universal_checkpoint(self, universal_dir: str, *,
-                                  strict: bool = True) -> dict:
-        """reference checkpoint/universal_checkpoint.py
-        load_hp_checkpoint_state: install fp32 fragments into this engine's
-        params / masters / Adam moments regardless of the mesh, ZeRO stage,
-        or framework that produced them (torch ``fp32.pt`` fragments load
-        too)."""
+    def _install_fragments(self, frags, step: int, *,
+                           strict: bool = True) -> None:
+        """Install TARGET-layout fragments into this engine's params /
+        masters / Adam moments and re-place them onto the mesh (the
+        device_put against ``state_shardings`` IS the resharding: any
+        dp/fsdp/pp/tp placement follows from the specs alone)."""
         from deepspeed_tpu.checkpoint.universal import (
-            apply_universal, load_universal,
-            offload_state_dict_from_fragments)
-        self._join_host_step()   # an in-flight update must not overwrite
-        frags, meta = load_universal(universal_dir)
+            apply_universal, offload_state_dict_from_fragments)
         host = jax.device_get(self.state)
-        step = int(meta.get("step", int(np.asarray(host.step))))
         new = apply_universal(host, frags, strict=strict, step=step)
         new = new._replace(step=jnp.asarray(step, np.asarray(host.step).dtype))
         self.state = jax.tree_util.tree_map(
@@ -1933,28 +1979,98 @@ class DeepSpeedTPUEngine:
             sd = offload_state_dict_from_fragments(host.params, frags, step)
             if len(sd) > 1:
                 self.offload_opt.load_state_dict(sd)
+
+    def load_universal_checkpoint(self, universal_dir: str, *,
+                                  strict: bool = True) -> dict:
+        """reference checkpoint/universal_checkpoint.py
+        load_hp_checkpoint_state: install fp32 fragments into this engine's
+        params / masters / Adam moments regardless of the mesh, ZeRO stage,
+        physical layout (pipeline stage-stacking included), or framework
+        that produced them (torch ``fp32.pt`` fragments load too)."""
+        from deepspeed_tpu.checkpoint import reshard
+        from deepspeed_tpu.checkpoint.universal import load_universal
+        self._join_host_step()   # an in-flight update must not overwrite
+        frags, meta = load_universal(universal_dir)
+        frags = reshard.relayout(frags, meta.get("layout"),
+                                 reshard.engine_layout(self))
+        step = meta.get("step")
+        if step is None:
+            step = int(np.asarray(jax.device_get(self.state.step)))
+        self._install_fragments(frags, int(step), strict=strict)
         return meta
 
+    def _load_cross_topology(self, load_dir: str, tag: str, cause) -> dict:
+        """Resharding-restore fallback for load_checkpoint: when the saved
+        pytree STRUCTURE does not match this engine (different physical
+        layout — e.g. a plain dp/fsdp checkpoint restoring into a
+        pipeline-stacked engine — or a different optimizer-state shape
+        across ZeRO stages), reduce the tag to LOGICAL universal fragments
+        and re-lay them out for this engine (checkpoint/reshard.py; per
+        arXiv:2004.13336 this is a sharding-spec transform, not a
+        checkpoint-format special case)."""
+        import json as _json
+        import os
+
+        from deepspeed_tpu.checkpoint import reshard
+        cs_path = os.path.join(load_dir, tag, "client_state.json")
+        client_state = {}
+        if os.path.exists(cs_path):
+            with open(cs_path) as f:
+                client_state = _json.load(f)
+        log_dist(f"load_checkpoint: structured restore of '{tag}' does not "
+                 f"match this engine ({cause}); falling back to the "
+                 f"cross-topology resharding restore", ranks=[0])
+        frags = reshard.fragments_from_orbax(load_dir, tag)
+        frags = reshard.relayout(frags, client_state.get("layout"),
+                                 reshard.engine_layout(self))
+        self._install_fragments(frags, int(client_state.get(
+            "global_steps", 0)))
+        return client_state
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
-        """reference engine.load_checkpoint (engine.py:2710); resharding on load
-        comes free from named shardings (the reference needs universal-checkpoint
-        machinery for that)."""
-        from deepspeed_tpu.checkpoint import latest_tag, restore_train_state
+        """reference engine.load_checkpoint (engine.py:2710).  Mesh
+        resharding on load comes free from named shardings; a STRUCTURAL
+        mismatch (pipeline stacking, cross-stage optimizer shape) falls
+        back to the logical-fragment resharding transform
+        (_load_cross_topology).  Raises ``checkpoint.CheckpointNotFound`` /
+        ``checkpoint.CheckpointCorrupt`` instead of backend-dependent
+        errors."""
+        from deepspeed_tpu.checkpoint import (latest_tag,
+                                              restore_train_state,
+                                              wait_pending)
         self._join_host_step()   # an in-flight update must not overwrite
+        # surface a failed async write NOW: a lost checkpoint must never be
+        # misread as a layout mismatch by the fallback below
+        wait_pending()
         tag = tag or latest_tag(load_dir)
         if tag is None:
             return None, {}
+        structured = True
         with self.telemetry.span("checkpoint_io", step=self.global_steps,
                                  tag=tag, op="load"):
-            self.state, client_state = restore_train_state(
-                load_dir, tag, self.state_shardings, self.state)
+            try:
+                self.state, client_state = restore_train_state(
+                    load_dir, tag, self.state_shardings, self.state)
+            except (ValueError, TypeError, KeyError) as e:
+                # orbax reports a saved-vs-target pytree STRUCTURE mismatch
+                # with these; missing/torn tags raise the typed
+                # CheckpointNotFound/CheckpointCorrupt and propagate —
+                # resharding cannot help those
+                client_state = self._load_cross_topology(load_dir, tag, e)
+                structured = False
         self.global_steps = int(client_state.get("global_steps", 0))
         self._reset_host_metrics_cache()
-        if self.offloading:
+        if self.offloading and structured:
+            # same-layout restore: host optimizer state rides the npz
+            # sidecar.  The cross-topology fallback already installed
+            # masters/moments from the LOGICAL fragments (relayouted for
+            # this engine) — the source-physical npz must not clobber them,
+            # and a non-offload source has no npz at all.
             import os
             p = os.path.join(load_dir, tag, "offload_state.npz")
             if not os.path.exists(p):
-                raise FileNotFoundError(
+                from deepspeed_tpu.checkpoint import CheckpointCorrupt
+                raise CheckpointCorrupt(
                     f"offload checkpoint missing {p}; this checkpoint was "
                     f"saved without offload_optimizer")
             with np.load(p) as sd:

@@ -9,24 +9,34 @@ TPU-native shape: one worker process per host (SPMD owns the devices), so the
 agent is a HOST-level supervisor:
 
 1. solve the batch geometry for the current host count,
-2. launch one worker per host with the JAX rendezvous env + the solved
+2. launch one worker per host with the fleet-identity env + the solved
    ``DSTPU_ELASTIC_*`` batch overrides,
-3. poll liveness; on a worker death (or a generation timeout) SIGKILL the
+3. poll liveness; on an ABRUPT worker death (host loss) SIGKILL the
    survivors (they are blocked in collectives — reference: the agent tears
-   the whole group down the same way),
-4. drop the lost host, re-solve, bump the rendezvous port, and relaunch;
-   workers resume from the latest *universal checkpoint* (the cross-topology
-   format — checkpoint/universal.py) so training continues at the new world
-   size with loss continuity.
+   the whole group down the same way); a worker exiting
+   ``resilience.EXIT_DRAINED`` drained gracefully on a preemption notice
+   and leaves the membership without taking the group down abruptly,
+4. drop the lost/preempted hosts, re-solve the batch geometry under the
+   ``elasticity.py`` valid-count constraints, back off (bounded, growing
+   per consecutive restart), and relaunch; workers resume from the newest
+   COMPLETE universal export (``checkpoint.latest_universal(run_dir)`` —
+   the crash-safe commit protocol guarantees a torn export is never picked)
+   so training continues at the new world size with loss continuity.
 
 Worker contract (what the training script must do to be elastic):
 - read ``DSTPU_ELASTIC_BATCH`` / ``DSTPU_ELASTIC_MICRO`` for the batch triad,
-- on start, load the latest universal checkpoint from the run dir if present,
-- export a universal checkpoint periodically (rank 0),
+- on start, ``engine.resume_from_latest(DSTPU_RUN_DIR)``,
+- export a universal checkpoint periodically (host 0,
+  ``export_universal_checkpoint(dir, run_dir=...)``),
+- install a ``resilience.PreemptionHandler``; on a notice, drain
+  (``engine.drain(run_dir)``) and exit ``resilience.EXIT_DRAINED``,
 - exit 0 when done.
 
-``--sim_hosts`` mode launches local CPU-mesh processes (the test path); a
-real DCN fleet swaps the Popen for the launcher's ssh commands.
+``--sim_hosts`` mode launches local single-process CPU workers (the test
+path — the CPU backend has no cross-process collectives, so each simulated
+host computes independently and reads its fleet identity from
+``DSTPU_SIM_*``); a real DCN fleet swaps the Popen for the launcher's ssh
+commands and the JAX rendezvous env.
 """
 
 from __future__ import annotations
@@ -40,6 +50,7 @@ import time
 from typing import Dict, List, Optional
 
 from deepspeed_tpu.elasticity import ElasticityConfig, compute_elastic_config
+from deepspeed_tpu.runtime.resilience import EXIT_DRAINED
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -50,6 +61,8 @@ class ElasticAgent:
                  base_port: int = 29821, min_hosts: int = 1,
                  max_restarts: int = 3, poll_interval: float = 0.25,
                  gen_timeout: Optional[float] = None,
+                 restart_backoff: float = 0.2,
+                 max_backoff: float = 5.0,
                  extra_env: Optional[Dict[str, str]] = None):
         self.script = script
         self.script_args = list(script_args or [])
@@ -57,27 +70,35 @@ class ElasticAgent:
         self.cfg = elastic_config
         self.run_dir = run_dir
         self.devices_per_host = devices_per_host
-        self.base_port = base_port
+        self.base_port = base_port           # legacy knob (rendezvous-era)
         self.min_hosts = min_hosts
         self.max_restarts = max_restarts
         self.poll_interval = poll_interval
         self.gen_timeout = gen_timeout
+        self.restart_backoff = restart_backoff
+        self.max_backoff = max_backoff
         self.extra_env = dict(extra_env or {})
         os.makedirs(run_dir, exist_ok=True)
         self.history: List[dict] = []
+        self.preemptions = 0
+        self.host_losses = 0
 
     # ---------------------------------------------------------------- spawn
-    def _spawn(self, world: int, port: int, restarts: int,
+    def _spawn(self, world: int, restarts: int,
                batch: int, micro: Optional[int]) -> List[subprocess.Popen]:
         procs = []
         for rank in range(world):
             env = dict(os.environ)
+            env.pop("JAX_COORDINATOR_ADDRESS", None)
             env.update(self.extra_env)
             env.update({
                 "JAX_PLATFORMS": "cpu",
-                "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
-                "JAX_NUM_PROCESSES": str(world),
-                "JAX_PROCESS_ID": str(rank),
+                # single-process-per-host simulation: fleet identity via
+                # DSTPU_SIM_* (comm.host_rank) — the CPU backend cannot run
+                # cross-process collectives, so no jax.distributed here
+                "DSTPU_SIM_FLEET": "1",
+                "DSTPU_SIM_RANK": str(rank),
+                "DSTPU_SIM_WORLD": str(world),
                 "XLA_FLAGS": (env.get("XLA_FLAGS", "")
                               + f" --xla_force_host_platform_device_count="
                               f"{self.devices_per_host}").strip(),
@@ -93,6 +114,8 @@ class ElasticAgent:
     def _write_status(self, **kw) -> None:
         state = dict(kw)
         state["history"] = self.history
+        state["preemptions"] = self.preemptions
+        state["host_losses"] = self.host_losses
         tmp = os.path.join(self.run_dir, "agent_status.json.tmp")
         with open(tmp, "w") as f:
             json.dump(state, f)
@@ -114,6 +137,13 @@ class ElasticAgent:
             except subprocess.TimeoutExpired:
                 pass
 
+    def preempt(self, procs: List[subprocess.Popen], rank: int) -> None:
+        """Deliver a preemption notice (SIGTERM) to one worker — the fault
+        path chaos tests drive; the worker's PreemptionHandler drains and
+        exits EXIT_DRAINED."""
+        if procs[rank].poll() is None:
+            procs[rank].send_signal(signal.SIGTERM)
+
     # ------------------------------------------------------------------ run
     def run(self) -> int:
         world = self.n_hosts
@@ -121,23 +151,25 @@ class ElasticAgent:
         while True:
             chips = world * self.devices_per_host
             batch, valid_dp, micro = compute_elastic_config(self.cfg, chips)
-            port = self.base_port + restarts
             gen = {"world": world, "batch": batch, "micro": micro,
-                   "restarts": restarts, "port": port}
+                   "restarts": restarts}
             logger.info(f"elastic agent: generation {restarts}: "
                         f"world={world} batch={batch} micro={micro}")
-            procs = self._spawn(world, port, restarts, batch, micro)
+            procs = self._spawn(world, restarts, batch, micro)
             gen["pids"] = [p.pid for p in procs]
             self.history.append(gen)
             self._write_status(phase="running", **gen)
 
             t0 = time.time()
-            failed = None
+            crashed: Optional[List[int]] = None
+            drained: List[int] = []
             while True:
                 codes = [p.poll() for p in procs]
-                if any(c is not None and c != 0 for c in codes):
-                    failed = [i for i, c in enumerate(codes)
-                              if c is not None and c != 0]
+                crashed = [i for i, c in enumerate(codes)
+                           if c not in (None, 0, EXIT_DRAINED)]
+                drained = [i for i, c in enumerate(codes)
+                           if c == EXIT_DRAINED]
+                if crashed or drained:
                     break
                 if all(c == 0 for c in codes):
                     self._write_status(phase="done", **gen)
@@ -146,17 +178,53 @@ class ElasticAgent:
                         and time.time() - t0 > self.gen_timeout):
                     logger.warning("elastic agent: generation timed out — "
                                    "restarting at the same world size")
-                    failed = []
                     break
                 time.sleep(self.poll_interval)
 
+            if drained and not crashed:
+                # graceful preemption(s): give OTHER notified workers a
+                # beat to finish their drains (they are writing final
+                # exports).  Survivors that got no notice keep training and
+                # never exit — so stop as soon as the exit set stabilizes
+                # (no new exit for a few polls), not after a fixed stall.
+                deadline = time.time() + 60
+                settle = max(1.0, 4 * self.poll_interval)
+                last_change = time.time()
+                exited = sum(c is not None
+                             for c in (p.poll() for p in procs))
+                while time.time() < deadline:
+                    codes = [p.poll() for p in procs]
+                    now_exited = sum(c is not None for c in codes)
+                    if now_exited == len(procs):
+                        break
+                    if now_exited != exited:
+                        exited, last_change = now_exited, time.time()
+                    elif time.time() - last_change > settle:
+                        break
+                    time.sleep(self.poll_interval)
+                # re-book from the FINAL exit codes: a worker that crashed
+                # during the drain window is a host loss, not a graceful
+                # departure
+                codes = [p.poll() for p in procs]
+                crashed = [i for i, c in enumerate(codes)
+                           if c not in (None, 0, EXIT_DRAINED)]
+                drained = [i for i, c in enumerate(codes)
+                           if c == EXIT_DRAINED]
             self._kill_all(procs)
-            lost = max(1, len(failed)) if failed is not None and failed else 0
-            if failed:  # real deaths: those hosts leave the membership
-                world -= lost
+            lost = len(set((crashed or []) + drained))
+            if crashed:
+                self.host_losses += len(crashed)
                 logger.warning(
-                    f"elastic agent: worker(s) {failed} died — membership "
-                    f"change to world={world}")
+                    f"elastic agent: worker(s) {crashed} died — membership "
+                    f"change to world={world - lost}")
+            if drained:
+                self.preemptions += len(drained)
+                logger.info(
+                    f"elastic agent: worker(s) {drained} drained on "
+                    f"preemption — membership change to world={world - lost}")
+            world -= lost
+            gen["crashed"] = crashed or []
+            gen["drained"] = drained
             restarts += 1
             if world < self.min_hosts:
                 self._write_status(phase="failed", reason="below min_hosts",
@@ -166,6 +234,11 @@ class ElasticAgent:
                 self._write_status(phase="failed", reason="max_restarts",
                                    **gen)
                 return 1
+            # bounded exponential backoff between generations: a
+            # crash-looping worker must not spin the fleet
+            backoff = min(self.restart_backoff * (2 ** (restarts - 1)),
+                          self.max_backoff)
+            time.sleep(backoff)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

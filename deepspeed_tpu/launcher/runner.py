@@ -79,18 +79,26 @@ def ssh_commands(pool: Dict[str, int], coordinator: str, script: str,
 
 
 def _run_sim(args, script_args: List[str]) -> int:
-    """K local processes, each a JAX process with a virtual CPU mesh — the
-    2-process dryrun path (reference --force_multi local resource pool)."""
+    """K local "host" processes, each a SINGLE-process JAX runtime with its
+    own virtual CPU mesh (reference --force_multi local resource pool).
+
+    The CPU backend cannot execute cross-process computations
+    ("Multiprocess computations aren't implemented on the CPU backend"),
+    so the sim does NOT wire the jax.distributed rendezvous: each host gets
+    its fleet identity via the ``DSTPU_SIM_*`` env
+    (``comm.host_rank``/``host_world_size``) and computes independently on
+    its local devices.  Real DCN fleets go through ``ssh_commands`` with
+    the JAX rendezvous env instead."""
     n = args.sim_hosts
-    port = args.sim_port
     procs: List[subprocess.Popen] = []
     for rank in range(n):
         env = dict(os.environ)
+        env.pop("JAX_COORDINATOR_ADDRESS", None)
         env.update({
             "JAX_PLATFORMS": "cpu",
-            "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
-            "JAX_NUM_PROCESSES": str(n),
-            "JAX_PROCESS_ID": str(rank),
+            "DSTPU_SIM_FLEET": "1",
+            "DSTPU_SIM_RANK": str(rank),
+            "DSTPU_SIM_WORLD": str(n),
             "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
                           f" --xla_force_host_platform_device_count="
                           f"{args.devices_per_host}").strip(),

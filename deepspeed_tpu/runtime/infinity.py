@@ -962,7 +962,8 @@ class InfinityEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         import json
 
-        from deepspeed_tpu.checkpoint import check_not_in_progress
+        from deepspeed_tpu.checkpoint import (CheckpointNotFound,
+                                              check_not_in_progress)
         self.wait_for_checkpoint()       # a racing async save must commit
         if tag is None:
             latest = os.path.join(load_dir, "latest")
@@ -970,8 +971,11 @@ class InfinityEngine:
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
-        check_not_in_progress(load_dir, tag)
+        check_not_in_progress(load_dir, tag)   # torn → CheckpointCorrupt
         out = os.path.join(load_dir, tag)
+        if not os.path.exists(os.path.join(out, "offload_state.npz")):
+            raise CheckpointNotFound(
+                f"no Infinity checkpoint state under {out}")
         with np.load(os.path.join(out, "offload_state.npz")) as sd:
             self.offload_opt.load_state_dict(dict(sd))
         # re-derive compute params from the restored masters
@@ -997,11 +1001,12 @@ class InfinityEngine:
                          else jnp.asarray(data))
         return tag, client_state
 
-    def export_universal_checkpoint(self, out_dir: str) -> str:
+    def export_universal_checkpoint(self, out_dir: str, *,
+                                    run_dir: Optional[str] = None) -> str:
         from deepspeed_tpu.checkpoint import universal as _u
         return _u.export_universal_offload(
             self._assemble_host_tree(), self.offload_opt, out_dir,
-            step=self.global_steps)
+            step=self.global_steps, run_dir=run_dir)
 
     def save_16bit_model(self, save_dir: str,
                          filename: str = "model_states.safetensors") -> str:

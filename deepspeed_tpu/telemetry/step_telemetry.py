@@ -126,14 +126,19 @@ class StepTelemetry:
     # --------------------------------------------------------- dispatch
 
     def before_dispatch(self, fn_name: str, args_tree, step: int,
-                        lower: Optional[Callable] = None) -> bool:
+                        lower: Optional[Callable] = None,
+                        count_execution: bool = True) -> bool:
         """Watchdog-observe one jitted dispatch.  Returns True on a
         signature miss (== an XLA compile).  On a miss, ``lower`` (a thunk
         returning ``jitted.lower(*args)``) is used — when hlo_stats is on —
         to pull collective bytes and cost/memory figures out of the compiled
         program; every call then bumps the per-execution HLO byte counters
         by the figures of THE SIGNATURE BEING DISPATCHED (shape buckets of
-        one function keep distinct per-step byte costs)."""
+        one function keep distinct per-step byte costs).
+        ``count_execution=False`` (the resume AOT warmup) registers the
+        signature and runs the compile analysis WITHOUT booking an
+        execution — the program never actually dispatched, so the
+        per-execution byte counters must not move."""
         if not self.enabled:
             return False
         from deepspeed_tpu.telemetry.watchdog import signature_of
@@ -153,6 +158,8 @@ class StepTelemetry:
             # analysis failure the bucket counts NOTHING rather than
             # inheriting another signature's bytes
             self._sig_stats[(fn_name, sig)] = dict(collected)
+        if not count_execution:
+            return miss
         info["executions"] += 1
         collectives = self._sig_stats.get((fn_name, sig), {})
         if collectives:

@@ -30,6 +30,12 @@ Scan targets (each file gets the pattern matching its hazard class):
   disclosed (``# sync-ok``) exceptions.  The host-side ``np.asarray``
   batch staging there is NOT a sync (host numpy), so the scalar patterns
   don't apply.
+- ``deepspeed_tpu/runtime/resilience.py`` drain/resume path (``drain`` /
+  ``resume`` / ``warm_resume``) — the worker fences (``_join_host_step``,
+  ``wait_for_checkpoint``) and AOT ``.compile()`` waits ARE the point of a
+  drain/warmup, but each must be a disclosed ``# sync-ok`` site: an
+  undisclosed fence creeping in here silently stretches the preemption
+  window (the time between the notice and the final committed export).
 
 Allowed on any line: ``device_get`` in engine.py (an explicit, visible
 host fetch — the sanctioned way to cross the boundary there) and a
@@ -60,6 +66,8 @@ PREFETCH_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime", "prefetch.py")
 CKPT_PATH = os.path.join(REPO, "deepspeed_tpu", "checkpoint", "__init__.py")
 SERVING_PATH = os.path.join(REPO, "deepspeed_tpu", "inference", "v2",
                             "engine_v2.py")
+RESILIENCE_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
+                               "resilience.py")
 
 # the v2 serving hot loop: scheduler + every dispatch helper.  Nested defs
 # (materialize/_append inside generate) are the sanctioned bulk-fetch
@@ -104,6 +112,10 @@ BLOCKING_PATTERN = re.compile(
 CKPT_PATTERN = re.compile(
     r"wait_until_finished|device_get|block_until_ready")
 TRANSFER_PATTERN = re.compile(r"device_get|block_until_ready")
+# drain/resume: every fence class that can stretch the preemption window
+RESILIENCE_PATTERN = re.compile(
+    r"wait_for_checkpoint|_join_host_step|wait_until_finished"
+    r"|device_get|block_until_ready|\.compile\(")
 # engine.py: device_get is itself the sanctioned idiom; everywhere a
 # '# sync-ok' comment discloses a reviewed, intentional sync
 ENGINE_ALLOW = re.compile(r"device_get|#\s*sync-ok")
@@ -115,6 +127,8 @@ SCAN_TARGETS = [
     (PREFETCH_PATH, {"__next__", "close"}, BLOCKING_PATTERN, ALLOW_PATTERN),
     (CKPT_PATH, {"save_train_state"}, CKPT_PATTERN, ALLOW_PATTERN),
     (SERVING_PATH, SERVING_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
+    (RESILIENCE_PATH, {"drain", "resume", "warm_resume"},
+     RESILIENCE_PATTERN, ALLOW_PATTERN),
 ]
 
 

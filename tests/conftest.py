@@ -37,6 +37,9 @@ QUICK_MODULES = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "quick: fast core-correctness tier (pytest -m quick)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (-m 'not slow'); true "
+        "multi-host / long-wall-clock legs")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,10 +1,12 @@
 """Worker script for the launcher test: trains the tiny GPT over a
-2-process × 4-virtual-device CPU fleet (reference pattern: the
+2-host × 4-virtual-device simulated CPU fleet (reference pattern: the
 tests/unit/common.py DistributedTest worker body).
 
-Launched by ``python -m deepspeed_tpu.launcher --sim_hosts 2`` — rendezvous
-env comes from the launcher; each process feeds its process-LOCAL batch rows
-(engine._shard_batch assembles the global array)."""
+Launched by ``python -m deepspeed_tpu.launcher --sim_hosts 2`` — each host
+is a SINGLE-process JAX runtime (the CPU backend has no cross-process
+collectives) whose fleet identity comes from ``comm.host_rank()`` /
+``host_world_size()``; each host trains on its process-LOCAL slice of the
+data pool over its own dp mesh, and host 0 checkpoints."""
 
 import os
 import sys
@@ -24,12 +26,14 @@ from deepspeed_tpu.models import GPT, GPTConfig  # noqa: E402
 def main():
     out_dir = sys.argv[1]
     deepspeed_tpu.comm.init_distributed()
-    assert jax.process_count() == 2, jax.process_count()
-    rank = jax.process_index()
+    rank = deepspeed_tpu.comm.host_rank()
+    world = deepspeed_tpu.comm.host_world_size()
+    assert world == 2, world
+    assert deepspeed_tpu.comm.sim_fleet()
 
     cfg = GPTConfig.tiny(vocab_size=128, max_seq_len=32)
     config = {
-        "train_batch_size": 16,          # 8 local rows per process
+        "train_batch_size": 8,           # this host's 8 rows over 4 devices
         "train_micro_batch_size_per_gpu": 2,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
         "mesh": {"dp": -1},
@@ -37,7 +41,7 @@ def main():
     }
     rng = np.random.default_rng(0)      # same pool on both hosts...
     pool = rng.integers(0, 128, size=(16, 32)).astype(np.int32)
-    local = pool[rank * 8:(rank + 1) * 8]   # ...each host feeds ITS slice
+    local = pool[rank * 8:(rank + 1) * 8]   # ...each host trains ITS slice
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=GPT(cfg), config=config,
         example_batch={"input_ids": local})
@@ -46,10 +50,12 @@ def main():
               for _ in range(20)]
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
-    # checkpointing is COLLECTIVE under multi-process (orbax barriers +
-    # per-process shard writes): every rank calls save/load
-    tag = engine.save_checkpoint(os.path.join(out_dir, "ckpt"))
-    engine.load_checkpoint(os.path.join(out_dir, "ckpt"), tag)
+    # host 0 owns the shared checkpoint dir (sim hosts are independent
+    # runtimes, so the save is NOT collective here; on a real fleet every
+    # process participates in the orbax save)
+    if rank == 0:
+        tag = engine.save_checkpoint(os.path.join(out_dir, "ckpt"))
+        engine.load_checkpoint(os.path.join(out_dir, "ckpt"), tag)
     with open(os.path.join(out_dir, f"rank{rank}.ok"), "w") as f:
         f.write(f"{losses[0]} {losses[-1]}")
 

@@ -1,0 +1,380 @@
+"""Chaos suite — deterministic fault injection across the resilience
+lifecycle (runtime/faults.py driving the drain → export → restore path).
+
+Reference analog: the reference's elasticity/checkpoint tests kill
+torch.multiprocessing workers and truncate files by hand; here the
+injection sites are part of the library surface, so these tests drive the
+SAME durability-ordering code the fleet runs.  Everything here is
+CPU-fast and in-process where the on-disk outcome is identical (an ``exc``
+fault leaves exactly the bytes a SIGKILL at that site would); the one true
+process-death leg rides the elastic-agent suite (test_elastic_agent.py,
+DSTPU_FAULTS host_loss)."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import (CheckpointCorrupt, CheckpointNotFound,
+                                      latest_universal, universal_complete)
+from deepspeed_tpu.checkpoint.universal import load_universal
+from deepspeed_tpu.models import GPT, GPTConfig
+from deepspeed_tpu.runtime import faults
+
+VOCAB, SEQ = 64, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _build(telemetry=False, stage=2, mesh_kw=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "bf16": {"enabled": True},
+        "mesh": mesh_kw or {"dp": -1},
+        "steps_per_print": 0,
+    }
+    if telemetry:
+        cfg["telemetry"] = {"enabled": True, "snapshot_interval": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ)),
+        config=cfg,
+        example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+    return engine
+
+
+def _batch(engine, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    return {"input_ids": pool[rng.integers(
+        0, 8, size=(engine.train_batch_size,))]}
+
+
+@pytest.fixture(scope="module")
+def engine(devices):
+    return _build(telemetry=True)
+
+
+class TestFaultInjector:
+    def test_spec_parsing_and_determinism(self):
+        inj = faults.FaultInjector()
+        inj.configure("exc@a.b, sleep@c:0.02, exc@d*2, exc@e+2")
+        assert inj.armed("a.b") == 1
+        assert inj.armed("d") == 2
+        with pytest.raises(faults.InjectedFault):
+            inj.fire("a.b")
+        inj.fire("a.b")                  # one-shot: disarmed after tripping
+        assert inj.fired("a.b") == 1
+        t0 = time.perf_counter()
+        inj.fire("c")
+        assert time.perf_counter() - t0 >= 0.02
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault):
+                inj.fire("d")
+        inj.fire("d")
+        # +after: the first two firings pass, the third trips
+        inj.fire("e")
+        inj.fire("e")
+        with pytest.raises(faults.InjectedFault):
+            inj.fire("e")
+
+    def test_bad_specs_raise(self):
+        inj = faults.FaultInjector()
+        with pytest.raises(ValueError, match="kind@site"):
+            inj.configure("no-site-separator")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            inj.inject("x", "explode")
+
+    def test_unarmed_site_is_noop(self):
+        faults.fire("never.armed")       # must not raise
+
+
+class TestTornUniversalExport:
+    """Satellite: torn-universal refusal + the newest-COMPLETE scan."""
+
+    def test_torn_write_refused_and_skipped(self, engine, tmp_path):
+        run_dir = str(tmp_path)
+        engine.train_batch(_batch(engine))
+        step = engine.global_steps
+        good = engine.export_universal_checkpoint(
+            os.path.join(run_dir, f"universal_{step}"), run_dir=run_dir)
+        assert universal_complete(good)
+        assert latest_universal(run_dir) == good
+
+        engine.train_batch(_batch(engine, seed=1))
+        torn = os.path.join(run_dir, f"universal_{engine.global_steps}")
+        faults.inject("universal.mid_fragments", "exc")
+        with pytest.raises(faults.InjectedFault):
+            engine.export_universal_checkpoint(torn, run_dir=run_dir)
+        # the torn export refuses restore with the TYPED error...
+        with pytest.raises(CheckpointCorrupt, match="never\\s+committed"):
+            load_universal(torn)
+        # ...and the newest-COMPLETE scan never selects it
+        assert latest_universal(run_dir) == good
+
+    @pytest.mark.parametrize("site", ["universal.pre_fragments",
+                                      "universal.pre_meta",
+                                      "universal.pre_commit"])
+    def test_fault_before_commit_leaves_previous_export(self, engine,
+                                                        tmp_path, site):
+        run_dir = str(tmp_path)
+        good = engine.export_universal_checkpoint(
+            os.path.join(run_dir, "universal_a"), run_dir=run_dir)
+        faults.inject(site, "exc")
+        with pytest.raises(faults.InjectedFault):
+            engine.export_universal_checkpoint(
+                os.path.join(run_dir, "universal_b"), run_dir=run_dir)
+        assert latest_universal(run_dir) == good
+        frags, meta = load_universal(latest_universal(run_dir))
+        assert frags                     # previous export fully loadable
+
+    def test_fault_after_commit_is_still_newest(self, engine, tmp_path):
+        """A death BETWEEN the commit (marker off) and the pointer move
+        loses only the pointer: the scan fallback still finds the new
+        export."""
+        run_dir = str(tmp_path)
+        engine.export_universal_checkpoint(
+            os.path.join(run_dir, "universal_a"), run_dir=run_dir)
+        engine.train_batch(_batch(engine, seed=9))   # newer step to commit
+        faults.inject("universal.pre_pointer", "exc")
+        new = os.path.join(run_dir, f"universal_{engine.global_steps}")
+        with pytest.raises(faults.InjectedFault):
+            engine.export_universal_checkpoint(new, run_dir=run_dir)
+        assert universal_complete(new)   # data committed before the fault
+        # pointer is stale (still the old export) — the scan wins
+        assert latest_universal(run_dir) == new
+
+    def test_truncated_fragment_is_corrupt(self, engine, tmp_path):
+        run_dir = str(tmp_path)
+        d = engine.export_universal_checkpoint(
+            os.path.join(run_dir, "universal_t"), run_dir=run_dir)
+        frag = None
+        for root, _, files in os.walk(os.path.join(d, "zero")):
+            for f in files:
+                if f == "fp32.npy":
+                    frag = os.path.join(root, f)
+                    break
+            if frag:
+                break
+        with open(frag, "r+b") as f:
+            f.truncate(8)                # tear the payload, keep the file
+        with pytest.raises(CheckpointCorrupt, match="unreadable|torn"):
+            load_universal(d)
+
+    def test_slow_commit_race_reads_previous(self, engine, tmp_path):
+        """A reader scanning while a commit is stretched out must see the
+        PREVIOUS complete export, never the half-committed one."""
+        run_dir = str(tmp_path)
+        good = engine.export_universal_checkpoint(
+            os.path.join(run_dir, "universal_a"), run_dir=run_dir)
+        faults.inject("universal.pre_commit", "sleep", arg=0.5)
+        seen = {}
+
+        def exporter():
+            engine.export_universal_checkpoint(
+                os.path.join(run_dir, "universal_b"), run_dir=run_dir)
+        t = threading.Thread(target=exporter)
+        t.start()
+        time.sleep(0.15)                 # mid-commit window
+        seen["during"] = latest_universal(run_dir)
+        t.join()
+        seen["after"] = latest_universal(run_dir)
+        assert seen["during"] == good
+        assert seen["after"] == os.path.join(run_dir, "universal_b")
+
+
+class TestTypedErrors:
+    """Satellite: missing/torn checkpoints raise CheckpointNotFound /
+    CheckpointCorrupt instead of backend-dependent exceptions."""
+
+    def test_universal_not_found(self, tmp_path):
+        with pytest.raises(CheckpointNotFound):
+            load_universal(str(tmp_path / "nope"))
+        (tmp_path / "not_universal").mkdir()
+        with pytest.raises(CheckpointNotFound, match="zero/"):
+            load_universal(str(tmp_path / "not_universal"))
+
+    def test_orbax_missing_tag(self, engine, tmp_path):
+        engine.save_checkpoint(str(tmp_path), tag="exists")
+        with pytest.raises(CheckpointNotFound):
+            engine.load_checkpoint(str(tmp_path), "missing_tag")
+
+    def test_orbax_torn_tag_refused(self, engine, tmp_path):
+        tag = engine.save_checkpoint(str(tmp_path))
+        # a crash mid-async-write leaves the in-progress marker behind
+        from deepspeed_tpu.checkpoint import IN_PROGRESS_FILE
+        with open(os.path.join(str(tmp_path), tag, IN_PROGRESS_FILE),
+                  "w") as f:
+            f.write("torn")
+        with pytest.raises(CheckpointCorrupt, match="never committed"):
+            engine.load_checkpoint(str(tmp_path), tag)
+
+    def test_latest_universal_empty_dir(self, tmp_path):
+        assert latest_universal(str(tmp_path)) is None
+        assert latest_universal(str(tmp_path / "missing")) is None
+
+
+class TestDrainLifecycle:
+    """Tentpole: a fault at EVERY drain phase still leaves a loadable
+    newest export (the resume source can regress to the previous step but
+    can never be torn)."""
+
+    DRAIN_SITES = ["drain.begin", "drain.pre_checkpoint_fence",
+                   "drain.pre_export", "universal.mid_fragments",
+                   "universal.pre_meta", "universal.pre_commit",
+                   "universal.pre_pointer", "drain.post_export"]
+
+    @pytest.mark.parametrize("site", DRAIN_SITES)
+    def test_fault_at_drain_phase_preserves_resume_source(self, engine,
+                                                          tmp_path, site):
+        run_dir = str(tmp_path)
+        engine.train_batch(_batch(engine, seed=2))
+        baseline = engine.export_universal_checkpoint(
+            os.path.join(run_dir, f"universal_{engine.global_steps}"),
+            run_dir=run_dir)
+        baseline_step = engine.global_steps
+        engine.train_batch(_batch(engine, seed=3))
+        faults.inject(site, "exc")
+        with pytest.raises(faults.InjectedFault):
+            engine.drain(run_dir, reason="chaos")
+        src = latest_universal(run_dir)
+        assert src is not None, f"{site}: no loadable export left"
+        frags, meta = load_universal(src)   # loadable, not torn
+        # a fault before the drain-export commit leaves the baseline; one
+        # after the commit leaves the (newer) drain export — both are
+        # legitimate resume sources, torn is the only illegal outcome
+        assert meta["step"] in (baseline_step, engine.global_steps)
+        if site in ("universal.pre_pointer", "drain.post_export"):
+            assert meta["step"] == engine.global_steps
+        else:
+            assert src == baseline
+
+    def test_clean_drain_commits_fingerprints_and_counters(self, engine,
+                                                           tmp_path):
+        from deepspeed_tpu.runtime.resilience import FINGERPRINTS_FILE
+        run_dir = str(tmp_path)
+        e = engine
+        path = e.drain(run_dir, reason="manual")
+        assert universal_complete(path)
+        assert latest_universal(run_dir) == path
+        assert os.path.exists(os.path.join(run_dir, FINGERPRINTS_FILE))
+        snap = e.telemetry.export(write=False)
+        blob = json.dumps(snap)
+        assert "preemptions_total" in blob and '"manual"' in blob
+
+
+class TestFastResume:
+    """Tentpole: warm resume compiles ZERO new executables (recompile
+    watchdog) and emits time_to_resume_ms."""
+
+    def test_warm_resume_zero_new_executables(self, engine, tmp_path):
+        run_dir = str(tmp_path)
+        e1 = engine                      # same config as a fresh _build
+        e1.train_batch(_batch(e1, seed=41))
+        e1.drain(run_dir, reason="sigterm")
+
+        e2 = _build(telemetry=True)
+        src = e2.resume_from_latest(run_dir)
+        assert src is not None and e2.global_steps == e1.global_steps
+        wd = e2.telemetry.watchdog
+        misses_before = wd.misses("train_batch")
+        assert misses_before >= 1        # the AOT warmup registered it
+        e2.train_batch(_batch(e2, seed=7))
+        assert wd.misses("train_batch") == misses_before, \
+            "warm resume must compile 0 new executables"
+        assert wd.warnings_emitted == 0
+        snap = e2.telemetry.export(write=False)
+        blob = json.dumps(snap)
+        assert "time_to_resume_ms" in blob and "restarts_total" in blob
+
+    def test_resume_cold_start_returns_none(self, engine, tmp_path):
+        before = engine.global_steps
+        assert engine.resume_from_latest(str(tmp_path)) is None
+        assert engine.global_steps == before
+
+    def test_cpu_gates_persistent_cache(self, tmp_path):
+        """On the CPU backend the persistent cache must stay OFF (this
+        jaxlib double-frees deserialized aliased executables) while the
+        knob is still accepted — the same record-but-gate pattern as the
+        overlap XLA flags."""
+        from deepspeed_tpu.runtime import resilience
+        before = jax.config.jax_compilation_cache_dir
+        resilience.enable_compilation_cache(str(tmp_path / "cache"))
+        assert jax.config.jax_compilation_cache_dir == before
+        assert not os.path.exists(str(tmp_path / "cache"))
+
+    def test_preemption_handler_flag_file_and_manual(self, tmp_path):
+        from deepspeed_tpu.runtime.resilience import PreemptionHandler
+        flag = str(tmp_path / "preempt.flag")
+        h = PreemptionHandler(signals=(), flag_file=flag)
+        assert not h.requested
+        with open(flag, "w") as f:
+            f.write("now")
+        assert h.requested and h.reason == "flag_file"
+        h2 = PreemptionHandler(signals=())
+        h2.request("manual")
+        assert h2.requested and h2.reason == "manual"
+
+    def test_resume_falls_back_past_corrupt_export(self, engine, tmp_path):
+        """A committed-LOOKING export with torn fragment bytes (power loss
+        the marker protocol couldn't see) must not crash-loop resume: the
+        previous complete export wins."""
+        run_dir = str(tmp_path)
+        good = engine.export_universal_checkpoint(
+            os.path.join(run_dir, f"universal_{engine.global_steps}"),
+            run_dir=run_dir)
+        good_step = engine.global_steps
+        engine.train_batch(_batch(engine, seed=51))
+        newer = engine.export_universal_checkpoint(
+            os.path.join(run_dir, f"universal_{engine.global_steps}"),
+            run_dir=run_dir)
+        frag = next(os.path.join(r, f) for r, _, fs in
+                    os.walk(os.path.join(newer, "zero"))
+                    for f in fs if f == "fp32.npy")
+        with open(frag, "r+b") as f:
+            f.truncate(8)                # torn bytes, marker already off
+        src = engine.resume_from_latest(run_dir, warmup=False)
+        assert src == good
+        assert engine.global_steps == good_step
+
+    def test_drain_reuses_committed_same_step_export(self, engine,
+                                                     tmp_path):
+        """Drain right after the worker contract's per-step export must NOT
+        re-open the committed dir (re-marking durable data in-progress): it
+        reuses it — asserted by arming a fault that would trip any fresh
+        export."""
+        run_dir = str(tmp_path)
+        engine.train_batch(_batch(engine, seed=52))
+        committed = engine.export_universal_checkpoint(
+            os.path.join(run_dir, f"universal_{engine.global_steps}"),
+            run_dir=run_dir)
+        faults.inject("universal.pre_fragments", "exc")
+        path = engine.drain(run_dir, reason="manual")
+        assert path == committed         # no fresh export ran
+        assert universal_complete(path)
+        assert faults.injector.fired("universal.pre_fragments") == 0
+
+    def test_fingerprints_roundtrip(self, engine, tmp_path):
+        from deepspeed_tpu.runtime.resilience import (load_fingerprints,
+                                                      save_fingerprints)
+        p = save_fingerprints(engine, str(tmp_path / "fp.json"))
+        manifest = load_fingerprints(p)
+        assert "train_batch" in manifest
+        sigs = manifest["train_batch"]
+        assert sigs and all(len(leaf) == 3 for sig in sigs for leaf in sig)
+        with pytest.raises(ValueError, match="fingerprints"):
+            bad = str(tmp_path / "bad.json")
+            with open(bad, "w") as f:
+                json.dump({"format": "other"}, f)
+            load_fingerprints(bad)
