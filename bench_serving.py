@@ -157,6 +157,91 @@ def run_open_loop(cfg, params, prompts, budgets, rate, slo_ttft_ms,
     }
 
 
+def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
+                    kill_at=None, block_size=64, seed=11):
+    """Multi-replica chaos leg ([serving_fleet]): N supervised v2 replicas
+    behind the fleet router serve the open-loop Poisson workload, and a
+    replica is killed mid-load via ``runtime/faults.py``
+    (``exc@replica.mid_decode``) with respawn DISABLED — goodput must
+    degrade gracefully toward (N-1)/N of the healthy fleet, not cliff to
+    zero, and every request must complete exactly once (the killed
+    replica's in-flight requests migrate to survivors token-exact).
+
+    Emits ``goodput_before_kill`` (completed tokens/s up to the kill),
+    ``recovery_ms`` (kill to the first post-kill completion),
+    ``goodput_after_kill`` (completed tokens/s AFTER recovery — the
+    acceptance window: the migrated requests' re-prefill/recompile stall
+    is the recovery cost, measured separately by ``recovery_ms``), and
+    ``requests_migrated``."""
+    import threading
+
+    from deepspeed_tpu.runtime import faults
+    from deepspeed_tpu.serving import ServingFleet
+
+    ecfg = {"state_manager": {
+        "max_tracked_sequences": SLOTS,
+        "max_ragged_batch_size": TOKEN_BUDGET,
+        "max_ragged_sequence_count": SLOTS,
+        "max_q_per_seq": 512,
+        "kv_block_size": block_size},
+        "generation": {"do_sample": False}}
+    fleet = ServingFleet(cfg, engine_config=ecfg, params=params,
+                         config={"num_replicas": int(replicas),
+                                 "respawn": False,
+                                 "heartbeat_deadline_s": 120.0,
+                                 "router": {"max_retries": int(replicas)
+                                            + 1}})
+    arr_rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(arr_rng.exponential(1.0 / rate,
+                                             size=len(prompts)))
+    if kill_at is None:
+        # mid-load by construction: ~35% into the arrival process
+        kill_at = 0.35 * float(arrivals[-1])
+    timer = threading.Timer(
+        kill_at, lambda: faults.inject("replica.mid_decode", "exc"))
+    try:
+        # one warm pass compiles the SHARED step cache for every replica
+        fleet.serve(prompts, max_new_tokens=budgets, max_wall_s=1800)
+        t0 = fleet.clock()
+        timer.start()
+        outs = fleet.serve(prompts, max_new_tokens=budgets,
+                           arrival_times=arrivals, max_wall_s=1800)
+        t_end = fleet.clock()
+    finally:
+        timer.cancel()
+        faults.reset()      # never leak an unconsumed kill into later legs
+        fleet.shutdown()
+    assert all(o is not None for o in outs), "fleet lost a request"
+    reg = fleet.registry._metrics
+    t_kill = t0 + kill_at
+    log = fleet.request_log
+    before = [r for r in log if r["t_done"] <= t_kill]
+    first_after = min((r["t_done"] for r in log if r["t_done"] > t_kill),
+                      default=None)
+    # recovered window: from the first post-kill completion to the end
+    after = ([r for r in log if r["t_done"] >= first_after]
+             if first_after is not None else [])
+    after_window = (max(t_end - first_after, 1e-3)
+                    if first_after is not None else 1.0)
+    deaths = reg["fleet_replica_deaths_total"].value(reason="replica_death")
+    return {
+        "fleet_replicas": int(replicas),
+        "fleet_kill_at_s": round(float(kill_at), 3),
+        "fleet_replica_deaths": deaths,
+        "goodput_before_kill": round(
+            sum(r["generated_tokens"] for r in before) / max(kill_at, 1e-9),
+            1),
+        "goodput_after_kill": round(
+            sum(r["generated_tokens"] for r in after) / after_window, 1),
+        "recovery_ms": (round((first_after - t_kill) * 1e3, 1)
+                        if first_after is not None else None),
+        "requests_migrated": reg["requests_migrated_total"].value(),
+        "fleet_router_retries": sum(
+            v for _, v in reg["router_retries_total"].samples()),
+        "fleet_requests_completed": len(log),
+    }
+
+
 def run_v1(cfg, params, prompts, budgets):
     """Static batching: arrival-order batches of SLOTS at FIXED shapes —
     prompts padded to the workload max, every sequence decoded for the
@@ -394,6 +479,13 @@ def parse_args(argv=None):
                     help="goodput SLO: max time-per-output-token")
     ap.add_argument("--telemetry-out", default="./telemetry/serving_bench",
                     help="directory for the serving snapshot/trace export")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size for the multi-replica chaos leg "
+                         "(0/1 skips the leg)")
+    ap.add_argument("--kill-replica-at", type=float, default=None,
+                    help="seconds into the fleet leg's open-loop run to "
+                         "kill one replica via runtime/faults.py "
+                         "(default: ~35%% into the arrival process)")
     return ap.parse_args(argv)
 
 
@@ -475,6 +567,14 @@ def main(argv=None):
     open_loop = leg("open_loop", lambda: run_open_loop(
         cfg, params, prompts, budgets, rate, args.slo_ttft_ms,
         args.slo_tpot_ms, args.telemetry_out)) or {}
+    # multi-replica chaos leg: same open-loop workload through the fleet
+    # router, one replica killed mid-load (no respawn) — goodput must
+    # degrade toward (N-1)/N, not cliff, with zero lost/duplicated requests
+    fleet_leg = {}
+    if args.replicas >= 2:
+        fleet_leg = leg("fleet_chaos", lambda: run_fleet_chaos(
+            cfg, params, prompts, budgets, rate, args.replicas,
+            kill_at=args.kill_replica_at)) or {}
 
     extra = {"static_batch_tokens_per_sec": round(v1_tps, 1),
              "telemetry_off_tokens_per_sec": round(v2_notel_tps, 1),
@@ -492,6 +592,7 @@ def main(argv=None):
              "model": ("llama-style 2L/128H (smoke)" if smoke
                        else "llama-style 12L/1024H GQA4, bf16")}
     extra.update(open_loop)
+    extra.update(fleet_leg)
     try:
         extra.update(spec_leg(smoke=smoke))
     except Exception as e:  # noqa: BLE001 — the leg must not kill the bench

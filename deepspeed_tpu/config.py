@@ -30,6 +30,19 @@ class DeepSpeedConfigModel(BaseModel):
     model_config = ConfigDict(extra="allow", validate_assignment=True,
                               arbitrary_types_allowed=True, populate_by_name=True)
 
+    @classmethod
+    def parse(cls, config):
+        """None → defaults, an instance → itself, anything else (dict)
+        validated.  The one accept-a-loose-config entry point, so
+        subsystem configs (fleet, ragged engine, ...) don't each grow a
+        divergent copy; subclasses override to add coercions (e.g. the
+        ragged engine's dtype aliasing)."""
+        if config is None:
+            return cls()
+        if isinstance(config, cls):
+            return config
+        return cls.model_validate(config)
+
 
 AutoInt = Union[Literal["auto"], int]
 AutoFloat = Union[Literal["auto"], float]

@@ -5,6 +5,7 @@ put/query/flush engine with a Dynamic SplitFuse generate driver (engine_v2.py).
 """
 
 from deepspeed_tpu.inference.v2.engine_v2 import (DSStateManagerConfig,
+                                                  EngineDrained,
                                                   InferenceEngineV2,
                                                   RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.model import PagedKVCache, ragged_forward
@@ -14,6 +15,7 @@ from deepspeed_tpu.inference.v2.ragged import (BlockedAllocator,
                                                build_ragged_batch)
 
 __all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig",
-           "DSStateManagerConfig", "PagedKVCache", "ragged_forward",
+           "DSStateManagerConfig", "EngineDrained",
+           "PagedKVCache", "ragged_forward",
            "DSStateManager", "BlockedAllocator", "SequenceDescriptor",
            "RaggedBatch", "build_ragged_batch"]

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -40,9 +41,18 @@ from deepspeed_tpu.inference.v2.model import (PagedKVCache,
                                               speculative_verify_step)
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager, RaggedBatch,
                                                build_ragged_batch)
+from deepspeed_tpu.runtime import faults
 from deepspeed_tpu.telemetry.serving import (ServingTelemetry,
                                              ServingTelemetryConfig)
 from deepspeed_tpu.utils.logging import log_dist
+
+
+class EngineDrained(RuntimeError):
+    """``generate()`` stopped at a drain request (``request_drain()``):
+    device records were materialized, live sequences flushed, and the
+    not-yet-finished requests are waiting in ``export_pending_requests()``
+    — the serving-side half of the PR-6 drain contract (stop admission,
+    finish or migrate in-flight work)."""
 
 
 class DSStateManagerConfig(DeepSpeedConfigModel):
@@ -175,7 +185,9 @@ class InferenceEngineV2:
     fresh init for testing).  See reference engine_v2.py:30."""
 
     def __init__(self, model, config=None, params=None, seed: int = 0,
-                 mesh=None, draft_model=None, draft_params=None):
+                 mesh=None, draft_model=None, draft_params=None,
+                 steps_cache: Optional[Dict[Any, Any]] = None,
+                 telemetry_registry=None):
         from deepspeed_tpu.models.gpt import GPTConfig, GPTLogits
         from deepspeed_tpu.parallel.metadata import unbox
         from deepspeed_tpu.checkpoint.hf import (is_hf_model_dir,
@@ -392,16 +404,42 @@ class InferenceEngineV2:
         # jitted step per (Qmax, KVblocks) bucket: a decode-only step runs a
         # Q=1 program and short sequences gather few KV blocks — the static-
         # shape analog of the reference's atom decomposition (atom_builder);
-        # buckets are powers of two so the compile cache stays small
-        self._steps: Dict[Any, Any] = {}
+        # buckets are powers of two so the compile cache stays small.
+        # ``steps_cache`` lets identically-configured engines SHARE the
+        # compiled set (serving/fleet.py: N replicas compile once, and a
+        # respawned replica fast-resumes against the survivors' warm cache
+        # — the serving analog of PR 6's persistent compilation cache).
+        # The per-program keys encode only SCHEDULE shapes (bucket widths,
+        # burst length), while the compiled fns close over the model
+        # config / block size / mesh via functools.partial — so a shared
+        # dict is namespaced by a config fingerprint: two differently-
+        # configured engines handed the same cache get disjoint sub-caches
+        # instead of silently dispatching each other's programs.
+        if steps_cache is not None:
+            fp = repr((model_cfg, eff_bs, self.config.dtype,
+                       self.draft_config,
+                       tuple(sorted(self.mesh.shape.items()))
+                       if self.mesh is not None else None,
+                       qc.enabled, qc.bits, qc.group_size))
+            self._steps: Dict[Any, Any] = steps_cache.setdefault(fp, {})
+        else:
+            self._steps = {}
         # recompute-preemption observability: how many victims were taken in
         # steady decode vs mid-(re-)prefill (the latter must keep fold state)
         self.preempt_stats = {"decode_ready": 0, "mid_prefill": 0}
         # request-level serving telemetry (telemetry/serving.py): lifecycle
         # spans + TTFT/TPOT histograms + KV-pool gauges + speculative
         # counters.  Engine-local registry by default so two engines in one
-        # process (the bench runs seven) never blend their series.
-        self.telemetry = ServingTelemetry(self.config.telemetry)
+        # process (the bench runs seven) never blend their series; the fleet
+        # passes a shared registry + a per-replica label instead.
+        self.telemetry = ServingTelemetry(self.config.telemetry,
+                                          registry=telemetry_registry)
+        # ---- fleet hooks (serving/fleet.py): a supervised replica can be
+        # asked to drain (stop serving, export in-flight requests) and
+        # reports liveness through heartbeat_fn each scheduler round
+        self._drain_requested = threading.Event()
+        self._serve_ctx: Optional[Dict[str, Any]] = None
+        self.heartbeat_fn = None
         self._block_size = eff_bs
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(self.params))
@@ -983,6 +1021,65 @@ class InferenceEngineV2:
             n_generated=len(r.generated), preempts=r.preempts,
             outcome=outcome)
 
+    # --------------------------------------- fleet drain/migration hooks
+    def request_drain(self) -> None:
+        """Ask a running ``generate()`` to stop at its next scheduler round
+        (serving drain: stop admission, materialize device records, flush
+        sequences, raise :class:`EngineDrained`).  Safe cross-thread — the
+        fleet supervisor calls it from the dispatcher while the replica
+        worker is inside ``generate``.  Latched until :meth:`clear_drain`."""
+        self._drain_requested.set()
+
+    def clear_drain(self) -> None:
+        """Re-arm serving after a drain (a drained replica returning to the
+        pool must not abort its next ``generate`` on the stale latch)."""
+        self._drain_requested.clear()
+
+    def export_pending_requests(self):
+        """The requeue half of request migration: after ``generate()``
+        stopped early — :class:`EngineDrained`, an injected replica death
+        (``replica.mid_decode``), or any mid-serve exception — returns
+        ``(completed, pending)``:
+
+        - ``completed``: {prompt index -> np.int32 generated tokens} for
+          requests that finished before the stop (nothing a survivor needs
+          to redo — "no lost requests");
+        - ``pending``: migration records ``{index, prompt, generated,
+          max_new_tokens}`` where ``prompt`` is the original context plus
+          every host-known generated token (folded exactly like
+          recompute-preemption) and ``max_new_tokens`` is the REMAINING
+          budget — a survivor replica re-prefills the folded prompt and
+          greedy decoding continues token-exact; the final output is
+          ``generated + survivor_output``.
+
+        Host-state only — never touches the device — so it is safe on a
+        dead replica: tokens sampled on device after the last materialize
+        are simply recomputed by the survivor.  Idempotent until the next
+        ``generate()`` resets the serve context."""
+        ctx = self._serve_ctx
+        if ctx is None:
+            return {}, []
+        completed: Dict[int, np.ndarray] = {}
+        pending: List[Dict[str, Any]] = []
+        for uid, r in ctx["results"].items():
+            idx = -uid - 1
+            gen = list(r.generated)
+            if r.finished or (r.done and (r.eos_hit
+                                          or len(gen) >= r.max_new_tokens)):
+                # retired with its host token list final (EOS found at a
+                # materialize, or budget reached and materialized)
+                completed[idx] = np.asarray(gen, np.int32)
+                continue
+            prompt = r.prompt                 # includes prior preempt folds
+            tail = gen[r.folded:]             # host-known, not yet folded
+            if tail:
+                prompt = np.concatenate(
+                    [prompt, np.asarray(tail, np.int32)])
+            pending.append({"index": idx, "prompt": prompt,
+                            "generated": gen,
+                            "max_new_tokens": r.max_new_tokens - len(gen)})
+        return completed, pending
+
     def generate(self, prompts: Sequence[np.ndarray],
                  max_new_tokens=32, seed: int = 0,
                  arrival_times: Optional[Sequence[float]] = None,
@@ -1020,6 +1117,7 @@ class InferenceEngineV2:
         so TTFT/TPOT histograms reflect device completion.
         """
         gen = self.config.generation.model_copy(update=gen_overrides)
+        self._serve_ctx = None   # never expose a PREVIOUS call's requests
         sm = self.config.state_manager
         S = self.state.max_tracked_sequences
         stel = self.telemetry
@@ -1062,6 +1160,12 @@ class InferenceEngineV2:
         if arrival_times is not None:
             waiting.sort(key=lambda r: r.t_arrival)
             incoming, waiting = waiting, []
+        # fleet migration hook: export_pending_requests() reads these live
+        # views if this serve stops early (drain / injected death); the
+        # lists are only MUTATED below (never rebound), so the references
+        # stay current.  Cleared on normal completion.
+        self._serve_ctx = {"waiting": waiting, "running": running,
+                           "incoming": incoming, "results": results}
 
         eos = gen.eos_token_id
         sync_interval = 16 if eos is not None else None
@@ -1116,6 +1220,24 @@ class InferenceEngineV2:
 
         burst_sizes = (64, 32, 16, 8)
         while waiting or running or incoming:
+            # ---- fleet hooks, once per scheduler round: the chaos site a
+            # replica death injects through (kind@replica.mid_decode), the
+            # liveness beat the supervisor deadlines on, and the drain latch
+            faults.fire("replica.mid_decode")
+            if self.heartbeat_fn is not None:
+                self.heartbeat_fn()
+            if self._drain_requested.is_set():
+                # serving drain (PR 6 semantics applied to requests instead
+                # of optimizer state): materialize so .generated is exact,
+                # free every live sequence, and hand the unfinished set to
+                # export_pending_requests() for migration
+                materialize()
+                for r in list(running):
+                    self.state.flush(r.uid)
+                raise EngineDrained(
+                    f"drain requested: {len(running)} running + "
+                    f"{len(waiting) + len(incoming)} queued request(s) "
+                    f"exported for migration")
             now = now_fn()
             while incoming and incoming[0].t_arrival <= now:
                 waiting.append(incoming.pop(0))
@@ -1431,5 +1553,6 @@ class InferenceEngineV2:
                 materialize()
 
         materialize()
+        self._serve_ctx = None      # clean completion: nothing to migrate
         return [np.asarray(results[-(i + 1)].generated, np.int32)
                 for i in range(len(prompts))]

@@ -34,6 +34,21 @@ the chaos tests use it to arm faults in spawned workers.
 Sites are free-form strings; :func:`fire` at an unarmed site costs one dict
 lookup on an empty-by-default registry.  The module is always importable and
 always armed-empty in production — there is no "enabled" flag to forget.
+
+Instrumented site families (grep for ``faults.fire`` / ``fire(`` for the
+authoritative list): ``universal.*`` / ``drain.*`` (checkpoint + drain
+durability ordering, PR 6) and the serving-fleet sites —
+``router.dispatch`` (a dispatch attempt from the fleet router),
+``replica.heartbeat`` (a replica's liveness beat; ``sleep`` here models a
+stalled replica the supervisor must deadline out), ``replica.mid_decode``
+(inside the v2 engine's scheduler loop — a replica dying mid-serve), and
+``admission.decide`` (the admission controller's per-request decision).
+
+Introspection: :func:`fired`/:func:`armed`/:func:`sites` read the per-site
+accounting (fired counts persist after a one-shot fault disarms, so a test
+can assert "exactly one injection tripped at replica.mid_decode" without
+process isolation); :func:`reset` returns the process-wide injector to the
+pristine state (disarms everything and zeroes the accounting).
 """
 
 from __future__ import annotations
@@ -80,6 +95,10 @@ class FaultInjector:
     def __init__(self):
         self._lock = threading.Lock()
         self._faults: Dict[str, List[_Fault]] = {}
+        # site -> trips since the last clear()/reset(): survives a one-shot
+        # fault disarming (the _Fault object keeps its own .fired too, but a
+        # site-level log is what determinism assertions read)
+        self._fired_log: Dict[str, int] = {}
 
     # ------------------------------------------------------------- arming
 
@@ -123,6 +142,12 @@ class FaultInjector:
     def clear(self) -> None:
         with self._lock:
             self._faults.clear()
+            self._fired_log.clear()
+
+    # ``reset`` is the test-facing name for "return to pristine": today it
+    # is clear(), kept separate so arming semantics can later diverge from
+    # accounting semantics without breaking callers of either.
+    reset = clear
 
     # ------------------------------------------------------------- firing
 
@@ -146,6 +171,7 @@ class FaultInjector:
                 return
             fault.remaining -= 1
             fault.fired += 1
+            self._fired_log[site] = self._fired_log.get(site, 0) + 1
         extra = (" " + " ".join(f"{k}={v}" for k, v in ctx.items())
                  if ctx else "")
         logger.warning(f"fault injection: {fault.kind} at {site}{extra}")
@@ -159,13 +185,12 @@ class FaultInjector:
         raise InjectedFault(f"injected fault at {site}{extra}")
 
     def fired(self, site: Optional[str] = None) -> int:
-        """How many faults have tripped (at ``site``, or anywhere)."""
+        """How many faults have tripped (at ``site``, or anywhere) since the
+        last clear()/reset() — counts persist after a one-shot disarms."""
         with self._lock:
-            total = 0
-            for s, fs in self._faults.items():
-                if site is None or s == site:
-                    total += sum(f.fired for f in fs)
-            return total
+            if site is not None:
+                return self._fired_log.get(site, 0)
+            return sum(self._fired_log.values())
 
     def armed(self, site: Optional[str] = None) -> int:
         with self._lock:
@@ -174,6 +199,18 @@ class FaultInjector:
                 if site is None or s == site:
                     total += sum(f.remaining for f in fs)
             return total
+
+    def sites(self) -> Dict[str, Dict[str, int]]:
+        """Snapshot of the per-site accounting:
+        ``{site: {"armed": still-pending trips, "fired": trips so far}}``
+        covering every site that was ever armed or tripped."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for s, fs in self._faults.items():
+                out[s] = {"armed": sum(f.remaining for f in fs), "fired": 0}
+            for s, n in self._fired_log.items():
+                out.setdefault(s, {"armed": 0, "fired": 0})["fired"] = n
+            return out
 
 
 # the process-wide injector every instrumented site fires through
@@ -191,6 +228,25 @@ def fire(site: str, **ctx) -> None:
 
 def clear() -> None:
     injector.clear()
+
+
+def reset() -> None:
+    """Return the process-wide injector to the pristine state: disarm every
+    fault and zero the fired/armed accounting (the per-test baseline the
+    chaos and fleet suites call instead of isolating processes)."""
+    injector.reset()
+
+
+def fired(site: Optional[str] = None) -> int:
+    return injector.fired(site)
+
+
+def armed(site: Optional[str] = None) -> int:
+    return injector.armed(site)
+
+
+def sites() -> Dict[str, Dict[str, int]]:
+    return injector.sites()
 
 
 # worker processes arm faults from the environment (the elastic agent / chaos

@@ -56,13 +56,22 @@ class PreemptionHandler:
     must never run inside a signal frame."""
 
     def __init__(self, signals=(signal.SIGTERM,),
-                 flag_file: Optional[str] = None):
+                 flag_file: Optional[str] = None,
+                 on_notice=None):
         self._signals = tuple(signals)
         self.flag_file = flag_file
         self._event = threading.Event()
         self.reason: Optional[str] = None
         self._prev: Dict[int, Any] = {}
         self._installed = False
+        self._on_notice = on_notice
+
+    def set_notice_callback(self, fn) -> None:
+        """Register a callback fired ONCE when the notice first latches.
+        It may run inside a signal frame, so it must only set flags / poke
+        queues (the serving fleet uses it to wake a sleeping dispatcher
+        tick) — never drain, join, or touch the device."""
+        self._on_notice = fn
 
     def install(self) -> "PreemptionHandler":
         if self._installed:
@@ -81,7 +90,13 @@ class PreemptionHandler:
     def request(self, reason: str = "manual") -> None:
         if self.reason is None:
             self.reason = reason
+        first = not self._event.is_set()
         self._event.set()
+        if first and self._on_notice is not None:
+            try:
+                self._on_notice(self.reason)
+            except Exception:  # noqa: BLE001 — a notice callback must
+                pass           # never turn a preemption into a crash
 
     @property
     def requested(self) -> bool:

@@ -1,0 +1,136 @@
+"""Admission control / graceful degradation for the serving fleet.
+
+At overload, a serving tier has exactly two choices: shed load early with
+a cheap, explicit rejection, or accept everything and let every request's
+latency fall off a cliff together (the queue grows without bound, TTFT
+p99 explodes, and the SLO goodput PR 5 measures collapses to zero even
+though tokens/s looks fine).  This controller implements the first choice
+as a control loop over the two overload signals the telemetry layer
+already emits:
+
+- ``kv_alloc_failures_total`` — every starved allocator decision site in
+  the v2 engine counts here (PR 5 put the counter in exactly so "the
+  future admission controller" could key off it; with the fleet's shared
+  registry the sum spans every replica's series);
+- router queue depth — requests arrived and waiting for dispatch.
+
+**Hysteresis**: shedding trips when EITHER signal crosses its high
+watermark and releases only when BOTH are back under their low
+watermarks, so the controller cannot flap on a load level that hovers at
+one threshold (reject → queue drains → admit → queue refills → ...).
+
+A shed request gets a 429-style rejection with a ``retry_after_s`` hint;
+the fleet re-enters it after that delay (the in-process stand-in for the
+client's retry) without burning the router's retry budget — admission
+rejections are back-pressure, not failures.  ``max_rejections`` bounds
+how long one request can be shed before it surfaces a typed
+``RequestFailed(reason="admission")`` (0 = shed indefinitely: pure
+back-pressure).
+
+Chaos site: ``admission.decide`` fires on every decision.  The fleet
+treats an injected fault here as *fail open* (admit) — admission is an
+optimization layer and must never become a correctness gate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from deepspeed_tpu.config import DeepSpeedConfigModel
+from deepspeed_tpu.runtime import faults
+
+
+class AdmissionConfig(DeepSpeedConfigModel):
+    """``admission`` block of the fleet config.  The ``*_queue_depth``
+    band is in requests; the ``*_kv_failures_per_tick`` band is the DELTA
+    of the fleet-wide ``kv_alloc_failures_total`` sum between control
+    ticks (a rate, robust to the counter's monotonic growth)."""
+
+    enabled: bool = True
+    high_queue_depth: int = 64
+    low_queue_depth: int = 16
+    high_kv_failures_per_tick: float = 32.0
+    low_kv_failures_per_tick: float = 1.0
+    retry_after_s: float = 0.25
+    max_rejections: int = 0          # 0 = unbounded client retries
+
+
+class AdmissionController:
+    """One instance per fleet; ``update()`` runs once per dispatcher tick,
+    ``decide()`` once per dispatch attempt."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None, *,
+                 registry, clock: Callable[[], float]):
+        cfg = config or AdmissionConfig()
+        if cfg.low_queue_depth > cfg.high_queue_depth:
+            raise ValueError(
+                f"admission hysteresis band inverted: low_queue_depth="
+                f"{cfg.low_queue_depth} > high_queue_depth="
+                f"{cfg.high_queue_depth}")
+        if cfg.low_kv_failures_per_tick > cfg.high_kv_failures_per_tick:
+            raise ValueError(
+                f"admission hysteresis band inverted: "
+                f"low_kv_failures_per_tick={cfg.low_kv_failures_per_tick} "
+                f"> high_kv_failures_per_tick="
+                f"{cfg.high_kv_failures_per_tick}")
+        self.config = cfg
+        self.clock = clock
+        self.registry = registry
+        self.shedding = False
+        self._last_kv_total: Optional[float] = None
+        self.c_rejections = registry.counter(
+            "admission_rejections_total", "requests shed (429-style, with "
+            "retry-after) by the fleet admission controller before "
+            "dispatch")
+        self.g_shedding = registry.gauge(
+            "admission_shedding", "1 while the admission controller is in "
+            "its shedding state (hysteresis band tripped), else 0")
+        self.g_shedding.set(0.0)
+
+    # ------------------------------------------------------------- signals
+    def kv_failures_total(self) -> float:
+        """Fleet-wide sum of ``kv_alloc_failures_total`` over every label
+        set (site x replica) in the shared registry."""
+        m = self.registry._metrics.get("kv_alloc_failures_total")
+        if m is None:
+            return 0.0
+        return sum(v for _, v in m.samples())
+
+    # -------------------------------------------------------- control loop
+    def update(self, queue_depth: int,
+               kv_failures_total: Optional[float] = None) -> bool:
+        """One control tick: fold the current signals through the
+        hysteresis band and return the (possibly new) shedding state.
+        ``kv_failures_total`` is injectable for tests; by default it is
+        read from the shared registry."""
+        cfg = self.config
+        if not cfg.enabled:
+            return False
+        total = (self.kv_failures_total() if kv_failures_total is None
+                 else float(kv_failures_total))
+        if self._last_kv_total is None:
+            self._last_kv_total = total
+        delta = total - self._last_kv_total
+        self._last_kv_total = total
+        if not self.shedding:
+            if (queue_depth > cfg.high_queue_depth
+                    or delta >= cfg.high_kv_failures_per_tick):
+                self.shedding = True
+        else:
+            if (queue_depth <= cfg.low_queue_depth
+                    and delta <= cfg.low_kv_failures_per_tick):
+                self.shedding = False
+        self.g_shedding.set(1.0 if self.shedding else 0.0)
+        return self.shedding
+
+    # ------------------------------------------------------------ decision
+    def decide(self, req) -> Tuple[bool, float]:
+        """Admit or shed one request: ``(admitted, retry_after_s)``.
+        Fires the ``admission.decide`` chaos site; the fleet catches any
+        injected fault and admits (fail open)."""
+        faults.fire("admission.decide", index=getattr(req, "index", None))
+        if not self.config.enabled or not self.shedding:
+            return True, 0.0
+        self.c_rejections.inc(1)
+        req.rejections += 1
+        return False, self.config.retry_after_s

@@ -1,0 +1,687 @@
+"""ServingFleet — N supervised ``InferenceEngineV2`` replicas behind a
+failure-tolerant router.
+
+One v2 engine is not a service: a replica death mid-decode used to lose
+every in-flight request, and there was no admission, retry, or
+degradation story between "one engine" and real traffic.  This module is
+the composition layer over the primitives earlier PRs built — PR 6's
+drain semantics and deterministic fault injection (``runtime/faults.py``),
+PR 5's serving telemetry (now with a per-replica label over one shared
+registry) — treating replica failure as a supported membership event, the
+serving-side analogue of the elastic agent's host-loss handling
+(arXiv:2004.13336's fault model).
+
+Replica lifecycle (state machine, one worker thread per incarnation)::
+
+    spawn ──> healthy ──────────────> draining ──┐
+                │  (request_drain: finish or     │
+                │   migrate in-flight, export)   │
+                │ death (fault / exception /     │
+                │        heartbeat deadline)     │
+                ▼                                ▼
+              dead ──(respawn: fresh engine, WARM shared compile
+                      cache = fast resume)──> healthy
+
+Supervision signals: every replica beats once per engine scheduler round
+(``replica.heartbeat`` chaos site) and the dispatcher deadlines busy
+replicas on ``heartbeat_deadline_s``; the admission controller reads the
+fleet-wide ``kv_alloc_failures_total`` sum and router queue depth.
+
+Request flow: the router (serving/router.py) owns pending/inflight/done
+with bounded retry + backoff; replica workers run ``engine.generate`` on
+their queued batch and report completions or exported migrations through
+one event queue back to the dispatcher (single-threaded control plane —
+every state transition happens on the ``serve()`` thread).
+
+Token-exactness invariant: all replicas are built from the SAME params
+(shared tree or same init seed), decoding is greedy, and migration folds
+only host-known generated tokens into the prompt — so any completion
+path (direct, migrated once, migrated twice) yields the byte-identical
+output of a single no-failure engine, which is what the chaos tests pin.
+
+Chaos wiring: arm ``runtime/faults.py`` sites ``replica.mid_decode``
+(death inside the scheduler loop), ``replica.heartbeat`` (``sleep`` =
+stalled replica, ``exc`` = death at the beat), ``router.dispatch``
+(dispatch-path failure -> retry/backoff), ``admission.decide`` (controller
+failure -> fail open).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+from pydantic import Field
+
+from deepspeed_tpu.config import DeepSpeedConfigModel
+from deepspeed_tpu.runtime import faults
+from deepspeed_tpu.serving.admission import (AdmissionConfig,
+                                             AdmissionController)
+from deepspeed_tpu.serving.router import (FleetRequest, NoHealthyReplicas,
+                                          RequestFailed, Router,
+                                          RouterConfig)
+from deepspeed_tpu.telemetry.registry import MetricRegistry
+from deepspeed_tpu.utils.logging import logger
+
+REPLICA_STATES = ("spawning", "healthy", "draining", "dead")
+
+
+class FleetDrained(RuntimeError):
+    """``serve()`` stopped because the whole fleet drained (preemption
+    notice / ``drain_all``).  Carries what a successor fleet needs:
+    ``completed`` (index -> tokens) and ``pending`` (migration-folded
+    :class:`FleetRequest` records, original arrival timestamps intact)."""
+
+    def __init__(self, completed: Dict[int, np.ndarray],
+                 pending: List[FleetRequest]):
+        super().__init__(
+            f"fleet drained: {len(completed)} request(s) completed, "
+            f"{len(pending)} exported for a successor")
+        self.completed = completed
+        self.pending = pending
+
+
+class FleetConfig(DeepSpeedConfigModel):
+    """Top-level fleet config.  ``heartbeat_deadline_s`` only applies to
+    BUSY replicas (an idle worker beats from its wait loop without the
+    chaos site).  ``max_respawns`` bounds death-respawns per replica;
+    drain-respawns are planned events and bypass it
+    (``respawn_after_drain``).  ``share_compile_cache`` hands every
+    replica one jitted-step dict, so the fleet compiles each program
+    once and a respawned replica fast-resumes warm."""
+
+    num_replicas: int = 2
+    heartbeat_deadline_s: float = 10.0
+    respawn: bool = True
+    max_respawns: int = 2
+    respawn_after_drain: bool = True
+    share_compile_cache: bool = True
+    poll_interval_s: float = 0.005
+    router: RouterConfig = Field(default_factory=RouterConfig)
+    admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Dispatch:
+    """Immutable snapshot of one request at hand-off to a replica worker:
+    the worker must never read the live (dispatcher-mutated) FleetRequest.
+    ``gen`` is the serve-call generation — events from a zombie worker of
+    an earlier serve() are dropped against it."""
+
+    index: int
+    epoch: int
+    prompt: np.ndarray
+    remaining: int
+    prefix: Tuple[int, ...]
+    gen: int
+
+
+class Replica:
+    """One supervised serving replica.  All state transitions happen on
+    the dispatcher thread; the worker thread only reads its own
+    incarnation's queue and reports through the fleet event queue."""
+
+    def __init__(self, name: str, fleet: "ServingFleet"):
+        self.name = name
+        self.fleet = fleet
+        self.state = "spawning"
+        self.engine = None
+        self.incarnation = 0
+        self.respawns = 0              # death-respawns taken
+        self.queue: List[_Dispatch] = []
+        self.cond = threading.Condition()
+        self.busy = False
+        self.last_beat = fleet.clock()
+        self.worker: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        """Engine-loop liveness beat (once per scheduler round, via
+        ``engine.heartbeat_fn``).  Fires the ``replica.heartbeat`` chaos
+        site FIRST: a ``sleep`` fault stalls the beat (the supervisor
+        deadlines the replica out), an ``exc`` fault kills it here."""
+        faults.fire("replica.heartbeat", replica=self.name)
+        self.last_beat = self.fleet.clock()
+
+    def enqueue(self, req: FleetRequest) -> None:
+        d = _Dispatch(index=req.index, epoch=req.epoch,
+                      prompt=np.asarray(req.prompt, np.int32),
+                      remaining=req.remaining,
+                      prefix=tuple(req.generated),
+                      gen=self.fleet._serve_gen)
+        with self.cond:
+            self.queue.append(d)
+            self.cond.notify_all()
+
+
+class ServingFleet:
+    """N supervised replicas + router + admission controller.
+
+    ``model``/``engine_config``/``params`` feed the default engine
+    factory (every replica gets identical weights — required for
+    token-exact migration); pass ``engine_factory(name)`` to construct
+    custom (or fake, in tests) engines instead.  The engine protocol the
+    fleet needs: ``generate(prompts, max_new_tokens=list)``,
+    ``request_drain()``/``clear_drain()``, ``export_pending_requests()``,
+    a writable ``heartbeat_fn`` attribute, and ``EngineDrained`` raised
+    on drain.
+
+    One shared ``MetricRegistry`` carries every replica's serving series
+    (per-``replica`` label) plus the fleet families
+    (``fleet_replica_state``, ``router_retries_total``,
+    ``requests_migrated_total``, ``admission_rejections_total``, ...).
+    """
+
+    def __init__(self, model=None, engine_config: Optional[dict] = None,
+                 params=None, config=None,
+                 engine_factory: Optional[Callable[[str], Any]] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 preemption_handler=None):
+        self.config = FleetConfig.parse(config)
+        self.clock = clock or time.monotonic
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._model = model
+        self._engine_config = engine_config or {}
+        self._params = params
+        self._steps_cache: Optional[Dict[Any, Any]] = (
+            {} if self.config.share_compile_cache else None)
+        if engine_factory is None and model is None:
+            raise ValueError("pass a model (+ engine_config/params) or an "
+                             "engine_factory")
+        self._engine_factory = engine_factory or self._default_factory
+        self._events: "queue.Queue" = queue.Queue()
+        self._serve_gen = 0
+        self._fleet_draining = False
+        self._admission_failed_open = False
+        self.request_log: List[dict] = []
+        self.last_failures: Dict[int, RequestFailed] = {}
+        self.router = Router(self.config.router, clock=self.clock,
+                             registry=self.registry)
+        self.admission = AdmissionController(
+            self.config.admission, registry=self.registry, clock=self.clock)
+        self.g_state = self.registry.gauge(
+            "fleet_replica_state", "one-hot replica state machine: 1 for "
+            "the replica's current state (spawning / healthy / draining / "
+            "dead), 0 for the rest")
+        self.c_deaths = self.registry.counter(
+            "fleet_replica_deaths_total", "replica deaths booked by the "
+            "supervisor, per reason (replica_death / heartbeat_timeout / "
+            "drain)")
+        self.c_respawns = self.registry.counter(
+            "fleet_respawns_total", "replica respawns (fresh engine against "
+            "the warm shared compile cache) after a death or drain")
+        self.h_recovery = self.registry.histogram(
+            "fleet_recovery_ms", "replica death/drain detection to the "
+            "replacement healthy (in-flight work is already requeued "
+            "before the respawn starts)")
+        self.replicas: Dict[str, Replica] = {}
+        for i in range(int(self.config.num_replicas)):
+            rep = Replica(f"r{i}", self)
+            self.replicas[rep.name] = rep
+            self._spawn(rep, is_respawn=False)
+        self._handler = preemption_handler
+        if self._handler is not None:
+            # latch + poke: the signal frame only sets the flag and drops a
+            # marker into the event queue so a sleeping tick wakes promptly
+            if hasattr(self._handler, "set_notice_callback"):
+                self._handler.set_notice_callback(
+                    lambda reason: self._events.put(("wakeup",)))
+            self._handler.install()
+
+    # ------------------------------------------------------------ spawning
+    def _default_factory(self, name: str):
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2
+        ecfg = copy.deepcopy(self._engine_config)
+        ecfg.setdefault("telemetry", {})["replica"] = name
+        return InferenceEngineV2(self._model, ecfg, params=self._params,
+                                 steps_cache=self._steps_cache,
+                                 telemetry_registry=self.registry)
+
+    def _set_state(self, rep: Replica, state: str) -> None:
+        assert state in REPLICA_STATES, state
+        rep.state = state
+        for s in REPLICA_STATES:
+            self.g_state.set(1.0 if s == state else 0.0,
+                             replica=rep.name, state=s)
+
+    def _spawn(self, rep: Replica, *, is_respawn: bool) -> None:
+        self._set_state(rep, "spawning")
+        engine = self._engine_factory(rep.name)
+        if hasattr(engine, "clear_drain"):
+            engine.clear_drain()
+        rep.engine = engine
+        with rep.cond:
+            rep.incarnation += 1
+            inc = rep.incarnation
+            rep.busy = False
+            rep.queue.clear()
+
+        def _beat(rep=rep, inc=inc):
+            # incarnation-guarded: a ZOMBIE worker (heartbeat-declared dead,
+            # still inside its old engine.generate) must neither refresh the
+            # replacement's liveness clock — that would mask a real hang —
+            # nor consume chaos faults armed for the live incarnation
+            if rep.incarnation == inc:
+                rep.beat()
+        engine.heartbeat_fn = _beat
+        rep.last_beat = self.clock()
+        rep.worker = threading.Thread(
+            target=self._worker, args=(rep, engine, inc), daemon=True,
+            name=f"fleet-{rep.name}-i{inc}")
+        rep.worker.start()
+        self._set_state(rep, "healthy")
+        if is_respawn:
+            self.c_respawns.inc(1)
+
+    # ------------------------------------------------------ replica worker
+    def _worker(self, rep: Replica, engine, incarnation: int) -> None:
+        from deepspeed_tpu.inference.v2.engine_v2 import EngineDrained
+        while True:
+            with rep.cond:
+                while not rep.queue:
+                    if rep.incarnation != incarnation:
+                        return
+                    rep.cond.wait(timeout=0.05)
+                    # idle liveness (no chaos site: only the engine loop's
+                    # beat models a SERVING replica's heartbeat)
+                    rep.last_beat = self.clock()
+                if rep.incarnation != incarnation:
+                    return
+                batch, rep.queue = rep.queue, []
+                rep.busy = True
+                # deadline clock starts at pick-up, not at the last idle
+                # beat (the queue wait must not count against serving)
+                rep.last_beat = self.clock()
+            try:
+                outs = engine.generate(
+                    [d.prompt for d in batch],
+                    max_new_tokens=[d.remaining for d in batch])
+                items = [(d.index, d.epoch, self._stitch(d.prefix, out))
+                         for d, out in zip(batch, outs)]
+                self._events.put(("complete", rep.name, incarnation,
+                                  batch[0].gen, items))
+                with rep.cond:
+                    if rep.incarnation == incarnation:
+                        rep.busy = False
+            except EngineDrained:
+                self._events.put(("drained", rep.name, incarnation,
+                                  batch[0].gen,
+                                  *self._merge_export(engine, batch), ""))
+                self._worker_exit(rep, incarnation)
+                return
+            except BaseException as e:  # noqa: BLE001 — a replica death is
+                #                         whatever escaped the engine
+                self._events.put(("death", rep.name, incarnation,
+                                  batch[0].gen,
+                                  *self._merge_export(engine, batch),
+                                  repr(e)))
+                self._worker_exit(rep, incarnation)
+                return
+
+    def _worker_exit(self, rep: Replica, incarnation: int) -> None:
+        with rep.cond:
+            if rep.incarnation == incarnation:
+                rep.busy = False
+
+    @staticmethod
+    def _stitch(prefix: Tuple[int, ...], out: np.ndarray) -> np.ndarray:
+        if not prefix:
+            return np.asarray(out, np.int32)
+        return np.concatenate([np.asarray(prefix, np.int32),
+                               np.asarray(out, np.int32)])
+
+    @staticmethod
+    def _merge_export(engine, batch: List[_Dispatch]):
+        """Map the engine's per-call export (local prompt indices) back to
+        fleet indices/epochs.  Safe on a dead engine (host-state only);
+        a failed export degrades to record-less migration."""
+        try:
+            completed, pending = engine.export_pending_requests()
+        except Exception:  # noqa: BLE001 — dead replica, best effort
+            completed, pending = {}, []
+        items = [(batch[i].index, batch[i].epoch,
+                  ServingFleet._stitch(batch[i].prefix, toks))
+                 for i, toks in completed.items() if i < len(batch)]
+        migrations = []
+        exported = set()
+        for rec in pending:
+            if rec["index"] >= len(batch):
+                continue                 # defensive: not this batch's export
+            d = batch[rec["index"]]
+            exported.add(rec["index"])
+            migrations.append((d.index, d.epoch,
+                               {"prompt": rec["prompt"],
+                                "generated": list(rec["generated"])}))
+        # engine errors before generate() set a serve context (e.g. a
+        # death at the very first scheduler round of a previous context)
+        # leave batch members unexported: migrate them record-less
+        for i, d in enumerate(batch):
+            if i not in exported and all(it[0] != d.index for it in items):
+                migrations.append((d.index, d.epoch, None))
+        return items, migrations
+
+    # ------------------------------------------------------------- serving
+    def serve(self, prompts, max_new_tokens=32, arrival_times=None,
+              raise_on_failure: bool = True,
+              max_wall_s: Optional[float] = None) -> List[np.ndarray]:
+        """Serve ``prompts`` to completion across the fleet and return one
+        output array per prompt (order preserved).  ``arrival_times`` are
+        open-loop offsets in seconds from call start (requests dispatch
+        only once arrived).  Failed requests (retry budget exhausted,
+        admission bound, no replicas left) surface as a typed
+        :class:`RequestFailed` — raised after everything else settled, or
+        returned as ``None`` entries with ``raise_on_failure=False``
+        (details in ``self.last_failures``).  ``max_wall_s`` is a hard
+        safety deadline for tests ("not a hang")."""
+        if isinstance(max_new_tokens, (int, np.integer)):
+            max_list = [int(max_new_tokens)] * len(prompts)
+        else:
+            max_list = [int(m) for m in max_new_tokens]
+            if len(max_list) != len(prompts):
+                raise ValueError("max_new_tokens list must match prompts")
+        if arrival_times is not None and len(arrival_times) != len(prompts):
+            raise ValueError("arrival_times must match prompts")
+        self._serve_gen += 1
+        self.request_log = []
+        self.last_failures = {}   # never leak a previous serve's failures
+        #                           into a call that exits via an exception
+        # purge replica queues of any previous serve's undispatched work
+        # (e.g. a timed-out attempt whose replica never woke): a batch is
+        # taken atomically, so after this every batch is gen-homogeneous
+        # and the event-level gen filter in _handle_event is exact
+        for rep in self.replicas.values():
+            with rep.cond:
+                rep.queue.clear()
+        self.router = Router(self.config.router, clock=self.clock,
+                             registry=self.registry)
+        t0 = self.clock()
+        for i, (p, m) in enumerate(zip(prompts, max_list)):
+            self.router.submit(FleetRequest(
+                index=i, prompt=np.asarray(p, np.int32).reshape(-1),
+                max_new_tokens=m,
+                t_arrival=t0 + (float(arrival_times[i])
+                                if arrival_times is not None else 0.0)))
+        while not self.router.settled():
+            if max_wall_s is not None and self.clock() - t0 > max_wall_s:
+                raise RuntimeError(
+                    f"fleet serve exceeded max_wall_s={max_wall_s}: "
+                    f"{len(self.router.pending)} pending, "
+                    f"{len(self.router.inflight)} inflight, states "
+                    f"{[(r.name, r.state) for r in self.replicas.values()]}")
+            self._tick()
+            if self._fleet_draining and not self.router.inflight \
+                    and not any(r.busy for r in self.replicas.values()):
+                raise FleetDrained(dict(self.router.done),
+                                   list(self.router.pending))
+        self.last_failures = dict(self.router.failed)
+        if self.last_failures and raise_on_failure:
+            raise self.last_failures[min(self.last_failures)]
+        return [self.router.done.get(i) for i in range(len(prompts))]
+
+    # ------------------------------------------------------ dispatcher tick
+    def _tick(self) -> None:
+        # 1) block briefly on worker events (this wait paces the loop)
+        try:
+            self._handle_event(
+                self._events.get(timeout=self.config.poll_interval_s))
+            while True:
+                self._handle_event(self._events.get_nowait())
+        except queue.Empty:
+            pass
+        now = self.clock()
+        # 2) preemption notice -> fleet-wide drain (flag polled, never a
+        # signal-frame action: same contract as the training-side handler)
+        if (self._handler is not None and not self._fleet_draining
+                and self._handler.requested):
+            self.drain_all()
+        # 3) supervision: heartbeat deadlines, per-attempt timeouts,
+        # draining replicas that went idle
+        self._check_health(now)
+        self.router.check_timeouts(now)
+        for rep in list(self.replicas.values()):
+            if rep.state == "draining":
+                with rep.cond:
+                    busy = rep.busy
+                if busy:
+                    rep.engine.request_drain()
+                else:
+                    self._retire_replica(rep, "drain")
+        # 4) admission control tick + dispatch
+        depth = self.router.queue_depth(now)
+        self.admission.update(depth)
+        if self._fleet_draining:
+            return
+        for req in self.router.take_dispatchable(now):
+            try:
+                admitted, retry_after = self.admission.decide(req)
+            except Exception as e:  # noqa: BLE001 — admission fails OPEN:
+                # shedding is an optimization, never a correctness gate
+                if not self._admission_failed_open:
+                    self._admission_failed_open = True
+                    logger.warning(f"admission controller failed open: {e!r}")
+                admitted, retry_after = True, 0.0
+            if not admitted:
+                cap = self.config.admission.max_rejections
+                if cap and req.rejections >= cap:
+                    self.router.failed[req.index] = RequestFailed(
+                        req.index, "admission", req.attempts,
+                        f"shed {req.rejections} times")
+                else:
+                    self.router.requeue_wait(req, now + retry_after)
+                continue
+            healthy = [r for r in self.replicas.values()
+                       if r.state == "healthy"]
+            try:
+                rep = self.router.pick(req, healthy)
+            except NoHealthyReplicas:
+                if all(r.state == "dead" for r in self.replicas.values()):
+                    self.router.failed[req.index] = RequestFailed(
+                        req.index, "no_healthy_replicas", req.attempts)
+                else:
+                    self.router.requeue_wait(
+                        req, now + self.config.poll_interval_s)
+                continue
+            bad = self._invalid_reason(req, rep)
+            if bad is not None:
+                # a client input error fails the REQUEST, never the
+                # replica: without this gate the engine's validation
+                # ValueError would book a replica death and a few poison
+                # requests could burn the whole fleet's respawn budget
+                self.router.failed[req.index] = RequestFailed(
+                    req.index, "invalid_request", req.attempts, bad)
+                continue
+            try:
+                self.router.dispatch(req, rep, now)
+            except Exception as e:  # noqa: BLE001 — injected or real
+                self.router.fail_attempt(req, now, "dispatch_error",
+                                         repr(e))
+
+    def _handle_event(self, ev) -> None:
+        kind = ev[0]
+        if kind == "wakeup":
+            return                       # just a queue poke; tick handles it
+        name, incarnation, gen = ev[1], ev[2], ev[3]
+        rep = self.replicas.get(name)
+        stale_serve = gen != self._serve_gen   # zombie of an earlier serve:
+        # its request-level payload addresses a retired Router, but its
+        # STATE transition is still real — a dead worker must not leave a
+        # "healthy" replica silently black-holing new dispatches
+        now = self.clock()
+        if kind == "complete":
+            if not stale_serve:
+                for index, epoch, tokens in ev[4]:
+                    self._complete(index, epoch, tokens, now)
+            return
+        # drained / death
+        completions, migrations = ev[4], ev[5]
+        reason = "drain" if kind == "drained" else "replica_death"
+        if not stale_serve:
+            for index, epoch, tokens in completions:
+                self._complete(index, epoch, tokens, now)
+            for index, epoch, record in migrations:
+                self._apply_migration(index, epoch, record, reason, now)
+        if rep is not None and rep.incarnation == incarnation:
+            if kind == "death":
+                logger.warning(
+                    f"fleet: replica {name} died mid-serve ({ev[6]}); "
+                    f"{len(migrations)} request(s) migrated")
+            self._retire_replica(rep, reason)
+
+    def _complete(self, index: int, epoch: int, tokens, now: float) -> None:
+        if not self.router.complete(index, epoch, tokens):
+            return
+        req = self.router.requests[index]
+        self.request_log.append({
+            "index": index, "t_arrival": req.t_arrival, "t_done": now,
+            "generated_tokens": int(len(tokens)), "attempts": req.attempts,
+            "migrations": req.migrations, "rejections": req.rejections})
+
+    def _apply_migration(self, index: int, epoch: int,
+                         record: Optional[dict], reason: str,
+                         now: float) -> None:
+        req = self.router.inflight.get(index)
+        if req is None or req.epoch != epoch:
+            return                       # stale: already requeued/finished
+        self.router.migrate(req, now, reason=reason, record=record,
+                            burn_budget=(reason != "drain"))
+
+    @staticmethod
+    def _invalid_reason(req: FleetRequest, rep: Replica) -> Optional[str]:
+        """Best-effort mirror of the engine's PER-REQUEST validation (the
+        two classes ``generate`` rejects with ValueError before doing any
+        work): context overflow and a single request that cannot fit the
+        KV pool even empty.  Only runs when the engine exposes the limits
+        (fakes without them skip the gate); migration-folded prompts keep
+        ``len(prompt) + remaining`` invariant, so a request this gate
+        admitted once is never rejected after a migration."""
+        eng = rep.engine
+        mc = getattr(eng, "model_config", None)
+        if mc is not None and len(req.prompt) + req.remaining \
+                > mc.max_seq_len:
+            return (f"prompt {len(req.prompt)} + {req.remaining} new "
+                    f"tokens exceeds max_seq_len {mc.max_seq_len}")
+        state = getattr(eng, "state", None)
+        if state is not None:
+            need = -(-(len(req.prompt) + req.remaining)
+                     // state.block_size)
+            if need > state.allocator.num_blocks:
+                return (f"request needs {need} KV blocks but the pool "
+                        f"holds {state.allocator.num_blocks}")
+        return None
+
+    # ---------------------------------------------------------- supervision
+    def _check_health(self, now: float) -> None:
+        ddl = self.config.heartbeat_deadline_s
+        if ddl <= 0:
+            return
+        for rep in list(self.replicas.values()):
+            if rep.state in ("healthy", "draining") and rep.busy \
+                    and now - rep.last_beat > ddl:
+                logger.warning(
+                    f"fleet: replica {rep.name} missed its heartbeat "
+                    f"deadline ({now - rep.last_beat:.2f}s > {ddl}s); "
+                    f"declaring dead and migrating its requests")
+                self._retire_replica(rep, "heartbeat_timeout")
+
+    def _retire_replica(self, rep: Replica, reason: str) -> None:
+        """Book a replica death/drain: stale-ify its worker, migrate every
+        request still attributed to it (undispatched queue + router
+        inflight), then respawn if policy allows.  Requeue happens BEFORE
+        the respawn so migrated work re-dispatches to survivors first."""
+        t_detect = self.clock()
+        with rep.cond:
+            rep.incarnation += 1         # zombie worker exits / goes stale
+            leftovers, rep.queue = rep.queue, []
+            rep.busy = False
+            rep.cond.notify_all()
+        self._set_state(rep, "dead")
+        self.c_deaths.inc(1, reason=reason)
+        now = self.clock()
+        for d in leftovers:
+            self._apply_migration(d.index, d.epoch, None, reason, now)
+        for req in self.router.assigned_to(rep.name):
+            self.router.migrate(req, now, reason=reason, record=None,
+                                burn_budget=(reason != "drain"))
+        if reason == "drain":
+            allowed = self.config.respawn_after_drain \
+                and not self._fleet_draining
+        else:
+            # never respawn into a fleet-wide drain either: building an
+            # engine inside the preemption window stretches time-to-exit
+            # for a replica that could never receive work anyway
+            allowed = self.config.respawn \
+                and rep.respawns < self.config.max_respawns \
+                and not self._fleet_draining
+            rep.respawns += 1 if allowed else 0
+        if allowed:
+            self._spawn(rep, is_respawn=True)
+            self.h_recovery.observe((self.clock() - t_detect) * 1e3)
+
+    # ------------------------------------------------------------- control
+    def drain_replica(self, name: str) -> None:
+        """Graceful drain of one replica: stop admission to it, let it
+        finish or migrate in-flight requests (``EngineDrained`` export),
+        then retire + respawn it against the warm compile cache."""
+        rep = self.replicas[name]
+        if rep.state != "healthy":
+            return
+        self._set_state(rep, "draining")
+        with rep.cond:
+            busy = rep.busy
+        if busy:
+            rep.engine.request_drain()
+        # idle replicas are finalized by the next tick
+
+    def drain_all(self) -> None:
+        """Fleet-wide drain (preemption notice): stop dispatching, drain
+        every replica; ``serve()`` surfaces :class:`FleetDrained` with the
+        completed + exported request sets."""
+        self._fleet_draining = True
+        for rep in self.replicas.values():
+            if rep.state == "healthy":
+                self.drain_replica(rep.name)
+
+    def health(self) -> Dict[str, dict]:
+        """Supervisor view: per-replica state, beat age, and the KV-pool
+        gauges (per-replica label) the telemetry layer maintains."""
+        now = self.clock()
+        reg = self.registry._metrics
+        out = {}
+        for rep in self.replicas.values():
+            kv = reg.get("kv_pool_blocks")
+            free = kv.value(replica=rep.name, state="free") if kv else 0.0
+            used = kv.value(replica=rep.name, state="used") if kv else 0.0
+            out[rep.name] = {
+                "state": rep.state, "beat_age_s": now - rep.last_beat,
+                "busy": rep.busy, "respawns": rep.respawns,
+                "kv_free_blocks": free, "kv_used_blocks": used,
+                "outstanding_tokens":
+                    self.router.outstanding_tokens(rep.name)}
+        return out
+
+    def shutdown(self) -> None:
+        """Stop every worker thread (idempotent).  Busy workers are asked
+        to drain cooperatively and JOINED: tearing the interpreter down
+        with a thread mid-XLA-dispatch aborts the process."""
+        for rep in self.replicas.values():
+            with rep.cond:
+                rep.incarnation += 1
+                rep.cond.notify_all()
+            if rep.engine is not None and hasattr(rep.engine,
+                                                  "request_drain"):
+                rep.engine.request_drain()
+        for rep in self.replicas.values():
+            if rep.worker is not None:
+                rep.worker.join(timeout=60.0)
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown()
+        return False

@@ -1,0 +1,354 @@
+"""Failure-tolerant request routing for the multi-replica serving fleet.
+
+The router owns the request-level half of the fleet contract
+(serving/fleet.py owns the replica-lifecycle half): every request moves
+through
+
+    pending --(policy pick + ``router.dispatch`` fault site)--> inflight
+      --> done | failed
+
+with three ways back from ``inflight`` to ``pending``:
+
+- **retry** — a dispatch error or a per-attempt timeout re-enters the
+  queue after an exponential-backoff-with-jitter delay; each retry burns
+  one unit of the bounded budget (``max_retries`` re-dispatches after the
+  first attempt) and exhaustion surfaces a typed :class:`RequestFailed`,
+  never a hang;
+- **migration on death** — a replica dying mid-decode re-enters its queued
+  and in-flight requests immediately (no backoff: the survivors are
+  healthy) with their ORIGINAL arrival timestamps and any host-known
+  generated prefix folded into the prompt (engine
+  ``export_pending_requests``), so open-loop greedy output stays
+  token-exact vs. a no-failure run; death migrations still count against
+  the retry budget so a crash-looping fleet fails requests instead of
+  cycling them forever;
+- **migration on drain** — a graceful drain migrates the same way but
+  burns NO budget (the operator asked for it; punishing the request would
+  make drains lossy).
+
+Determinism for tests: the clock is injected (``clock=...``), and the
+backoff jitter comes from a seeded ``numpy`` Generator, so the full retry
+schedule is pinned by ``RouterConfig.seed``.
+
+Routing policies are pluggable (``POLICIES``): ``least_outstanding_tokens``
+(default) balances by the live token footprint per replica;
+``round_robin`` is the trivial baseline; ``prefix_affinity`` is the
+reserved hook for the future radix prefix cache — it sticky-routes
+requests sharing a prompt prefix to one replica (hash of the first
+``PREFIX_AFFINITY_TOKENS`` tokens) so a shared-prefix KV cache on that
+replica actually gets hit, falling back to least-outstanding when the
+sticky target is unhealthy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.config import DeepSpeedConfigModel
+from deepspeed_tpu.runtime import faults
+from deepspeed_tpu.utils.logging import logger
+
+# prompt tokens hashed by the prefix-affinity policy (the future radix
+# cache's sticky key: requests sharing a system prompt land together)
+PREFIX_AFFINITY_TOKENS = 16
+
+
+class RequestFailed(RuntimeError):
+    """A request exhausted its bounded retry budget (or the fleet has no
+    replica left to serve it).  Typed so callers can distinguish "the
+    request failed" from "the fleet is broken" — carries the request
+    index, the final failure reason, and the attempt count."""
+
+    def __init__(self, index: int, reason: str, attempts: int,
+                 detail: str = ""):
+        super().__init__(
+            f"request {index} failed after {attempts} attempt(s): {reason}"
+            + (f" ({detail})" if detail else ""))
+        self.index = index
+        self.reason = reason
+        self.attempts = attempts
+
+
+class NoHealthyReplicas(RuntimeError):
+    """No replica is in the healthy state to dispatch to (transient while a
+    respawn is in flight; terminal when the fleet is out of respawns)."""
+
+
+class RouterConfig(DeepSpeedConfigModel):
+    """``router`` block of the fleet config.
+
+    ``max_retries`` bounds RE-dispatches after the first attempt (so a
+    request is tried at most ``max_retries + 1`` times).  The k-th failed
+    attempt waits ``min(backoff_max_s, backoff_base_s * backoff_factor**
+    (k-1)) * (1 + backoff_jitter * u)`` with ``u ~ U[0, 1)`` from the
+    seeded generator.  ``request_timeout_s`` is the per-ATTEMPT completion
+    deadline (0 disables): a replica sitting on a request past it gets the
+    request retried elsewhere (the stale attempt's late result is
+    deduplicated by the assignment epoch)."""
+
+    policy: str = "least_outstanding_tokens"
+    max_retries: int = 3
+    request_timeout_s: float = 0.0
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.5
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One request's routing state.  ``prompt`` is the CURRENT context —
+    migrations fold the host-known generated prefix in, exactly like
+    recompute-preemption — while ``generated`` accumulates that prefix so
+    the final output is ``generated + last_attempt_output`` and
+    ``t_arrival`` never changes (original-arrival semantics)."""
+
+    index: int
+    prompt: np.ndarray
+    max_new_tokens: int                     # ORIGINAL budget
+    t_arrival: float = 0.0
+    generated: List[int] = dataclasses.field(default_factory=list)
+    attempts: int = 0                       # dispatches tried so far
+    rejections: int = 0                     # admission 429s taken
+    migrations: int = 0
+    epoch: int = 0                          # bumped per requeue: stale-result
+    #                                         events are ignored against it
+    next_eligible: float = 0.0              # arrival / backoff / retry-after
+    deadline: float = float("inf")          # per-attempt timeout
+    assigned: Optional[str] = None          # replica name while inflight
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+
+# ------------------------------------------------------------------ policies
+
+def least_outstanding_tokens(req: FleetRequest, healthy: list,
+                             router: "Router", rng) -> object:
+    """Default: the replica with the smallest live token footprint
+    (outstanding prompts + remaining budgets it has been assigned).  Ties
+    break by name so the choice is deterministic."""
+    return min(healthy,
+               key=lambda rep: (router.outstanding_tokens(rep.name),
+                                rep.name))
+
+
+def round_robin(req: FleetRequest, healthy: list, router: "Router",
+                rng) -> object:
+    router._rr_cursor += 1
+    return healthy[router._rr_cursor % len(healthy)]
+
+
+def prefix_affinity(req: FleetRequest, healthy: list, router: "Router",
+                    rng) -> object:
+    """Reserved hook for the radix prefix cache ([serving_scale]): requests
+    sharing a prompt prefix sticky-route to one replica, so a future
+    shared-prefix KV cache held there multiplies instead of fragmenting
+    across the fleet.  Unhealthy sticky target falls back to
+    least-outstanding (correctness first; affinity is an optimization)."""
+    key = np.asarray(req.prompt[:PREFIX_AFFINITY_TOKENS],
+                     np.int32).tobytes()
+    pick = sorted(healthy, key=lambda rep: rep.name)[
+        zlib.crc32(key) % len(healthy)]
+    return pick
+
+
+POLICIES: Dict[str, Callable] = {
+    "least_outstanding_tokens": least_outstanding_tokens,
+    "round_robin": round_robin,
+    "prefix_affinity": prefix_affinity,
+}
+
+
+class Router:
+    """Request queue + retry/migration bookkeeping.  Single-threaded by
+    design: only the fleet dispatcher calls in (replica workers talk to the
+    dispatcher through the fleet's event queue), so there is no lock and
+    the retry schedule stays deterministic under an injected clock."""
+
+    def __init__(self, config: Optional[RouterConfig] = None, *,
+                 clock: Callable[[], float], registry):
+        self.config = config or RouterConfig()
+        if self.config.policy not in POLICIES:
+            raise ValueError(
+                f"unknown routing policy {self.config.policy!r}; expected "
+                f"one of {sorted(POLICIES)}")
+        self.clock = clock
+        self._policy = POLICIES[self.config.policy]
+        self._rng = np.random.default_rng(self.config.seed)
+        self._rr_cursor = -1
+        self.pending: List[FleetRequest] = []
+        self.inflight: Dict[int, FleetRequest] = {}
+        self.done: Dict[int, np.ndarray] = {}
+        self.failed: Dict[int, RequestFailed] = {}
+        self.requests: Dict[int, FleetRequest] = {}
+        self.c_retries = registry.counter(
+            "router_retries_total", "request re-dispatches taken by the "
+            "fleet router, per reason (dispatch_error / timeout / "
+            "replica_death / heartbeat_timeout)")
+        self.c_migrated = registry.counter(
+            "requests_migrated_total", "queued or in-flight requests "
+            "re-entered into the router after a replica death or drain")
+        self.g_depth = registry.gauge(
+            "router_queue_depth", "requests arrived and waiting for "
+            "dispatch (the admission controller's queue signal)")
+
+    # ----------------------------------------------------------- admission
+    def submit(self, req: FleetRequest) -> None:
+        self.requests[req.index] = req
+        req.next_eligible = max(req.next_eligible, req.t_arrival)
+        self.pending.append(req)
+
+    def queue_depth(self, now: float) -> int:
+        """Arrived-and-waiting count (not-yet-arrived open-loop requests
+        are excluded: they are load that has not happened yet)."""
+        depth = sum(1 for r in self.pending if r.t_arrival <= now)
+        self.g_depth.set(depth)
+        return depth
+
+    def take_dispatchable(self, now: float) -> List[FleetRequest]:
+        """Pop every request whose arrival AND backoff/retry-after gates
+        have passed, earliest-arrival first (FIFO within a tick)."""
+        ready = [r for r in self.pending if r.next_eligible <= now]
+        if ready:
+            self.pending = [r for r in self.pending
+                            if r.next_eligible > now]
+            ready.sort(key=lambda r: (r.t_arrival, r.index))
+        return ready
+
+    def requeue_wait(self, req: FleetRequest, until: float) -> None:
+        """Put a request back untouched (no budget burned) until ``until``
+        — the no-healthy-replica / admission-retry-after path."""
+        req.next_eligible = until
+        self.pending.append(req)
+
+    # ------------------------------------------------------------ dispatch
+    def backoff(self, attempt: int) -> float:
+        """Delay before re-dispatch number ``attempt`` (1-based count of
+        failures so far).  Deterministic given ``RouterConfig.seed``: the
+        k-th call consumes the k-th variate of the seeded generator."""
+        c = self.config
+        base = min(c.backoff_max_s,
+                   c.backoff_base_s * c.backoff_factor ** (attempt - 1))
+        return base * (1.0 + c.backoff_jitter * float(self._rng.random()))
+
+    def pick(self, req: FleetRequest, healthy: list):
+        """Choose a replica for ``req`` under the configured policy."""
+        if not healthy:
+            raise NoHealthyReplicas(
+                f"no healthy replica for request {req.index}")
+        return self._policy(req, healthy, self, self._rng)
+
+    def dispatch(self, req: FleetRequest, replica, now: float) -> None:
+        """Hand ``req`` to ``replica``.  Fires the ``router.dispatch``
+        chaos site BEFORE the hand-off: an injected fault here models a
+        dispatch-path failure (connection refused, serialization error)
+        and is the retry/backoff path's test vector."""
+        req.attempts += 1              # counted even if the dispatch faults
+        faults.fire("router.dispatch", index=req.index,
+                    replica=replica.name)
+        req.assigned = replica.name
+        req.deadline = (now + self.config.request_timeout_s
+                        if self.config.request_timeout_s > 0
+                        else float("inf"))
+        self.inflight[req.index] = req
+        replica.enqueue(req)
+
+    def fail_attempt(self, req: FleetRequest, now: float, reason: str,
+                     detail: str = "") -> None:
+        """One attempt failed (dispatch error, timeout, replica death).
+        Requeue with backoff, or — past the bounded budget — move the
+        request to ``failed`` as a typed :class:`RequestFailed`."""
+        self.inflight.pop(req.index, None)
+        req.assigned = None
+        req.epoch += 1
+        if req.attempts > self.config.max_retries:
+            self.failed[req.index] = RequestFailed(
+                req.index, reason, req.attempts, detail)
+            logger.warning(f"router: {self.failed[req.index]}")
+            return
+        self.c_retries.inc(1, reason=reason)
+        req.next_eligible = now + self.backoff(req.attempts)
+        self.pending.append(req)
+
+    # ----------------------------------------------------------- migration
+    def migrate(self, req: FleetRequest, now: float, *, reason: str,
+                record: Optional[dict] = None,
+                burn_budget: bool = True) -> None:
+        """Re-enter a queued/in-flight request after a replica death or
+        drain.  ``record`` is the engine's export for this request (folded
+        prompt + generated tail); without one (heartbeat-declared death of
+        a hung replica — its state cannot be read safely) the request
+        retries from its last known context, recomputing the lost tail.
+        The ORIGINAL arrival timestamp is preserved: with greedy decoding
+        the re-served request completes token-exact vs. a no-failure run."""
+        self.inflight.pop(req.index, None)
+        req.assigned = None
+        req.epoch += 1
+        req.migrations += 1
+        if record is not None:
+            req.prompt = record["prompt"]
+            req.generated = req.generated + list(record["generated"])
+        self.c_migrated.inc(1)
+        if burn_budget:
+            # the failed dispatch itself already counted in req.attempts
+            if req.attempts > self.config.max_retries:
+                self.failed[req.index] = RequestFailed(
+                    req.index, reason, req.attempts)
+                logger.warning(f"router: {self.failed[req.index]}")
+                return
+            self.c_retries.inc(1, reason=reason)
+        # no backoff: the survivors are healthy and the request has waited
+        # since its original arrival already
+        req.next_eligible = now
+        self.pending.append(req)
+
+    # ---------------------------------------------------------- completion
+    def complete(self, index: int, epoch: int, tokens: np.ndarray) -> bool:
+        """Record a finished request.  First result wins: a stale attempt
+        (its epoch lost a requeue race) that still finished carries
+        token-identical output under greedy decoding, so it is accepted
+        when it arrives first and dropped when it arrives second — either
+        way ``done`` holds exactly one output per request."""
+        if index in self.done or index in self.failed:
+            return False
+        req = self.requests.get(index)
+        if req is None:
+            return False
+        self.done[index] = np.asarray(tokens, np.int32)
+        # first result retires the request wherever it currently sits —
+        # inflight (fresh or re-dispatched attempt) or pending (queued for
+        # a retry the stale result just made unnecessary); ``epoch`` is
+        # informational here, it only gates MIGRATION records
+        self.inflight.pop(index, None)
+        self.pending = [r for r in self.pending if r.index != index]
+        return True
+
+    def check_timeouts(self, now: float) -> List[FleetRequest]:
+        """Per-attempt deadlines: an in-flight request past its deadline is
+        retried elsewhere (reason ``timeout``; the hung attempt's late
+        result deduplicates against the epoch bump)."""
+        late = [r for r in self.inflight.values() if now > r.deadline]
+        for req in late:
+            self.fail_attempt(req, now, "timeout",
+                              detail=f"replica {req.assigned}")
+        return late
+
+    # -------------------------------------------------------------- status
+    def outstanding_tokens(self, replica_name: str) -> int:
+        return sum(len(r.prompt) + r.remaining
+                   for r in self.inflight.values()
+                   if r.assigned == replica_name)
+
+    def assigned_to(self, replica_name: str) -> List[FleetRequest]:
+        return [r for r in self.inflight.values()
+                if r.assigned == replica_name]
+
+    def settled(self) -> bool:
+        return not self.pending and not self.inflight

@@ -58,6 +58,12 @@ class ServingTelemetryConfig(DeepSpeedConfigModel):
     trace_enabled: bool = True
     max_trace_events: int = 100_000
     stream_sync: bool = False
+    # fleet mode (serving/fleet.py): the replica's name, threaded as a
+    # ``replica`` label into EVERY serving metric family so N replicas can
+    # share one fleet-level registry without blending their series; None
+    # (single-engine default) adds no label, keeping the series names the
+    # dashboards already scrape
+    replica: Optional[str] = None
 
 
 class ServingTelemetry:
@@ -68,6 +74,11 @@ class ServingTelemetry:
         self.config = cfg
         self.enabled = bool(cfg.enabled)
         self.stream_sync = bool(cfg.stream_sync)
+        # fleet mode: one shared registry + a per-replica label on every
+        # series (the merge into self.labels below threads it through each
+        # write AND each read, so quantile()/value() callers stay oblivious)
+        self.labels: Dict[str, str] = (
+            {"replica": str(cfg.replica)} if cfg.replica else {})
         self.registry = registry if registry is not None else MetricRegistry()
         if pid is None:
             import jax
@@ -205,7 +216,7 @@ class ServingTelemetry:
         skipped rather than guessed."""
         if not self.enabled:
             return
-        self.c_requests.inc(1, outcome=outcome)
+        self.c_requests.inc(1, outcome=outcome, **self.labels)
         t_done = t_last if t_last is not None else self.now()
         rec = {"uid": uid, "outcome": outcome,
                "prompt_tokens": int(n_prompt),
@@ -213,17 +224,18 @@ class ServingTelemetry:
                "preempts": int(preempts),
                "e2e_ms": (t_done - t_arrival) * 1e3,
                "ttft_ms": None, "tpot_ms": None}
-        self.h_e2e.observe(rec["e2e_ms"])
+        self.h_e2e.observe(rec["e2e_ms"], **self.labels)
         if t_admit is not None:
-            self.h_queue.observe((t_admit - t_arrival) * 1e3)
+            self.h_queue.observe((t_admit - t_arrival) * 1e3, **self.labels)
             if t_prefill_end is not None:
-                self.h_prefill.observe((t_prefill_end - t_admit) * 1e3)
+                self.h_prefill.observe((t_prefill_end - t_admit) * 1e3,
+                                       **self.labels)
         if t_first is not None:
             rec["ttft_ms"] = (t_first - t_arrival) * 1e3
-            self.h_ttft.observe(rec["ttft_ms"])
+            self.h_ttft.observe(rec["ttft_ms"], **self.labels)
             if t_last is not None and n_generated > 1:
                 rec["tpot_ms"] = (t_last - t_first) * 1e3 / (n_generated - 1)
-                self.h_tpot.observe(rec["tpot_ms"])
+                self.h_tpot.observe(rec["tpot_ms"], **self.labels)
         if len(self.request_log) < self.request_log_cap:
             self.request_log.append(rec)
         if self.tracer.enabled:
@@ -243,29 +255,29 @@ class ServingTelemetry:
 
     def dispatch(self, kind: str) -> None:
         if self.enabled:
-            self.c_dispatch.inc(1, kind=kind)
+            self.c_dispatch.inc(1, kind=kind, **self.labels)
 
     def tokens(self, phase: str, n: int) -> None:
         if self.enabled and n:
-            self.c_tokens.inc(n, phase=phase)
+            self.c_tokens.inc(n, phase=phase, **self.labels)
 
     def preemption(self, kind: str) -> None:
         if self.enabled:
-            self.c_preempt.inc(1, kind=kind)
+            self.c_preempt.inc(1, kind=kind, **self.labels)
 
     def occupancy(self, running: int, slots: int) -> None:
         if self.enabled and slots:
-            self.g_occupancy.set(running / slots)
+            self.g_occupancy.set(running / slots, **self.labels)
 
     def padding_waste(self, live_tokens: int, bucket: int) -> None:
         if self.enabled and bucket:
-            self.g_padding.set((bucket - live_tokens) / bucket)
+            self.g_padding.set((bucket - live_tokens) / bucket, **self.labels)
 
     # ------------------------------------------------------------ KV pool
 
     def alloc_failure(self, site: str, n: int = 1) -> None:
         if self.enabled:
-            self.c_kv_fail.inc(n, site=site)
+            self.c_kv_fail.inc(n, site=site, **self.labels)
 
     def kv_sample(self, state) -> None:
         """Gauge the paged pool off a DSStateManager: used/free blocks and
@@ -276,15 +288,16 @@ class ServingTelemetry:
         free = state.allocator.free_blocks
         total = state.allocator.num_blocks
         used = total - free
-        self.g_kv_blocks.set(used, state="used")
-        self.g_kv_blocks.set(free, state="free")
+        self.g_kv_blocks.set(used, state="used", **self.labels)
+        self.g_kv_blocks.set(free, state="free", **self.labels)
         alloc_tokens = 0
         live_tokens = 0
         for seq in state.tracked.values():
             alloc_tokens += len(seq.blocks) * state.block_size
             live_tokens += seq.seen_tokens
         self.g_kv_frag.set(
-            1.0 - live_tokens / alloc_tokens if alloc_tokens else 0.0)
+            1.0 - live_tokens / alloc_tokens if alloc_tokens else 0.0,
+            **self.labels)
 
     # -------------------------------------------------------- speculative
 
@@ -297,55 +310,61 @@ class ServingTelemetry:
         if not self.enabled:
             return
         steps = outer * n_seqs
-        self.c_spec_outer.inc(steps)
-        self.c_spec_proposed.inc(steps * gamma)
-        self.c_spec_accepted.inc(max(0, emitted - steps))
-        self.c_spec_emitted.inc(emitted)
-        self.c_spec_ms.inc(dur_ms)
-        proposed = self.c_spec_proposed.value()
+        self.c_spec_outer.inc(steps, **self.labels)
+        self.c_spec_proposed.inc(steps * gamma, **self.labels)
+        self.c_spec_accepted.inc(max(0, emitted - steps), **self.labels)
+        self.c_spec_emitted.inc(emitted, **self.labels)
+        self.c_spec_ms.inc(dur_ms, **self.labels)
+        proposed = self.c_spec_proposed.value(**self.labels)
         if proposed:
             self.g_spec_ratio.set(
-                self.c_spec_accepted.value() / proposed)
+                self.c_spec_accepted.value(**self.labels) / proposed,
+                **self.labels)
 
     def spec_profile(self, draft_ms: float, verify_ms: float) -> None:
         if self.enabled:
-            self.c_spec_draft_ms.inc(draft_ms)
-            self.c_spec_verify_ms.inc(verify_ms)
+            self.c_spec_draft_ms.inc(draft_ms, **self.labels)
+            self.c_spec_verify_ms.inc(verify_ms, **self.labels)
 
     def spec_summary(self) -> Dict[str, float]:
         """The bench/test-facing read of the speculative counters (replaces
         the old ``eng.spec_stats`` dict)."""
         if not self.enabled:
             return {}
-        proposed = self.c_spec_proposed.value()
-        outer = self.c_spec_outer.value()
+        L = self.labels
+        proposed = self.c_spec_proposed.value(**L)
+        outer = self.c_spec_outer.value(**L)
         return {
             "outer_steps": outer,
             "proposed": proposed,
-            "accepted": self.c_spec_accepted.value(),
-            "emitted": self.c_spec_emitted.value(),
-            "accept_ratio": (self.c_spec_accepted.value() / proposed
+            "accepted": self.c_spec_accepted.value(**L),
+            "emitted": self.c_spec_emitted.value(**L),
+            "accept_ratio": (self.c_spec_accepted.value(**L) / proposed
                              if proposed else 0.0),
-            "emitted_per_outer": (self.c_spec_emitted.value() / outer
+            "emitted_per_outer": (self.c_spec_emitted.value(**L) / outer
                                   if outer else 0.0),
-            "burst_ms": self.c_spec_ms.value(),
-            "draft_ms": self.c_spec_draft_ms.value(),
-            "verify_ms": self.c_spec_verify_ms.value(),
-            "draft_dispatches": self.c_dispatch.value(kind="spec_draft"),
-            "verify_dispatches": self.c_dispatch.value(kind="spec_verify"),
+            "burst_ms": self.c_spec_ms.value(**L),
+            "draft_ms": self.c_spec_draft_ms.value(**L),
+            "verify_ms": self.c_spec_verify_ms.value(**L),
+            "draft_dispatches": self.c_dispatch.value(kind="spec_draft", **L),
+            "verify_dispatches": self.c_dispatch.value(kind="spec_verify",
+                                                       **L),
         }
 
     # -------------------------------------------------------------- reads
 
     def value(self, name: str, **labels) -> float:
+        """Read one series; an instance's own replica label (fleet mode) is
+        merged in so callers address "my" series by the same names a
+        single-engine setup uses (pass ``replica=...`` to override)."""
         m = self.registry._metrics.get(name)
-        return m.value(**labels) if m is not None else 0.0
+        return m.value(**{**self.labels, **labels}) if m is not None else 0.0
 
     def quantile(self, name: str, q: float, **labels) -> float:
         m = self.registry._metrics.get(name)
         if m is None or m.kind != "histogram":
             return float("nan")
-        return m.quantile(q, **labels)
+        return m.quantile(q, **{**self.labels, **labels})
 
     # ------------------------------------------------------------- export
 

@@ -36,6 +36,14 @@ Scan targets (each file gets the pattern matching its hazard class):
   drain/warmup, but each must be a disclosed ``# sync-ok`` site: an
   undisclosed fence creeping in here silently stretches the preemption
   window (the time between the notice and the final committed export).
+- ``deepspeed_tpu/serving/router.py`` (every routing/retry/migration
+  method) and ``deepspeed_tpu/serving/fleet.py`` dispatcher loop
+  (``serve``/``_tick``/event + supervision handlers) — ``device_get`` /
+  ``block_until_ready``: the fleet control plane is pure host
+  bookkeeping; a transfer here would stall EVERY replica's dispatch
+  behind one device, the worst possible place to serialize.  Replica
+  worker bodies (``_worker`` and friends) are the sanctioned blocking
+  site (each blocks only its own replica) and are not scanned.
 
 Allowed on any line: ``device_get`` in engine.py (an explicit, visible
 host fetch — the sanctioned way to cross the boundary there) and a
@@ -68,6 +76,8 @@ SERVING_PATH = os.path.join(REPO, "deepspeed_tpu", "inference", "v2",
                             "engine_v2.py")
 RESILIENCE_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
                                "resilience.py")
+ROUTER_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "router.py")
+FLEET_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "fleet.py")
 
 # the v2 serving hot loop: scheduler + every dispatch helper.  Nested defs
 # (materialize/_append inside generate) are the sanctioned bulk-fetch
@@ -86,6 +96,37 @@ SERVING_FUNCS = {
 # (the serving target scans transfers only — TRANSFER_PATTERN below: the
 # loop stages host numpy arrays with np.asarray all over, which is not a
 # device sync, so the scalar patterns would drown the real hazard class)
+
+# the fleet router: every method is on the dispatch/retry/migration path
+ROUTER_FUNCS = {
+    "submit",
+    "queue_depth",
+    "take_dispatchable",
+    "requeue_wait",
+    "backoff",
+    "pick",
+    "dispatch",
+    "fail_attempt",
+    "migrate",
+    "complete",
+    "check_timeouts",
+    "outstanding_tokens",
+    "assigned_to",
+}
+# the fleet dispatcher loop (control plane only — replica worker bodies
+# are the sanctioned per-replica blocking sites)
+FLEET_FUNCS = {
+    "serve",
+    "_tick",
+    "_handle_event",
+    "_complete",
+    "_apply_migration",
+    "_invalid_reason",
+    "_check_health",
+    "_retire_replica",
+    "drain_replica",
+    "drain_all",
+}
 
 # the engine's per-step hot path: batch in → dispatch → reporting
 STEP_PATH_FUNCS = {
@@ -129,6 +170,8 @@ SCAN_TARGETS = [
     (SERVING_PATH, SERVING_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (RESILIENCE_PATH, {"drain", "resume", "warm_resume"},
      RESILIENCE_PATTERN, ALLOW_PATTERN),
+    (ROUTER_PATH, ROUTER_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
+    (FLEET_PATH, FLEET_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
 ]
 
 
