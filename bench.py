@@ -515,6 +515,27 @@ def run_bench():
                 comm_ms * extra["collective_exposed_ratio"], 3)
     except Exception as e:  # noqa: BLE001 — profiling must not kill the bench
         extra["comm_exposed_error"] = str(e)[:120]
+    try:
+        # step-time budget (telemetry/profiler.py): the measured flagship
+        # step decomposed into compute / exposed_comm / hbm_bound /
+        # host_gap / dispatch_floor, with achieved MFU — the attribution
+        # that names a relay floor instead of reading as a regression.
+        # scripts/perf_report.py renders the same budget from the snapshot.
+        from deepspeed_tpu.telemetry.profiler import step_time_budget
+        budget = step_time_budget(
+            snap, step_ms=dt * 1e3, fn="train_batch",
+            comm_total_ms=extra.get("comm_total_ms"),
+            registry=engine.telemetry.registry)
+        extra["mfu_budget"] = {
+            "compute_ms": round(budget["compute_ms"], 3),
+            **{f"{cause}_ms": round(ms, 3)
+               for cause, ms in budget["terms_ms"].items()},
+            "mfu_achieved": round(budget["mfu_achieved"], 4),
+            "mfu_lost": {c: round(v, 4)
+                         for c, v in budget["mfu_lost"].items()},
+        }
+    except Exception as e:  # noqa: BLE001 — attribution must not kill bench
+        extra["mfu_budget_error"] = str(e)[:120]
     del engine
 
     def emit():
@@ -534,8 +555,58 @@ def run_bench():
         _extra_points(GPTChunkedLoss, GPTConfig, deepspeed_tpu.initialize,
                       out=extra, emit=emit)
         extra["legs_complete"] = True
+        # bench regression sentinel (telemetry/regression.py): diff this
+        # round's numbers against the committed ledger — NON-fatally here
+        # (the driver still gets its metric line); scripts/check_bench.py
+        # is the enforcing gate.  The count rides the JSON line so a
+        # recorded round carries its own trajectory verdict.
+        try:
+            from deepspeed_tpu.telemetry import regression as _reg
+            ledger_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_BASELINE.json")
+            if os.path.exists(ledger_path):
+                res = _reg.compare(
+                    _reg.flatten_bench_record(
+                        {"metric": METRIC,
+                         "value": round(tokens_per_sec, 1),
+                         "extra": extra}),
+                    _reg.load_baseline(ledger_path))
+                extra["bench_regressions"] = len(res["regressions"])
+                if res["failed"]:
+                    print(_reg.render(res, "BENCH_BASELINE.json"),
+                          file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            extra["bench_sentinel_error"] = str(e)[:120]
         emit()                 # supervisor keeps the LAST metric line
+    _append_leg_records(METRIC, round(tokens_per_sec, 1), extra,
+                        smoke=smoke)
     return 0
+
+
+def _append_leg_records(metric, value, extra, smoke=False):
+    """Append the per-leg JSONL records (the regression sentinel's native
+    input) next to the stdout JSON line: one machine-readable record per
+    metric with the scheduler-regime echo and a timestamp.  The legacy
+    stdout line is untouched — this is purely additive."""
+    try:
+        from deepspeed_tpu.telemetry import regression as _reg
+        env = {"smoke": bool(smoke), "bench": os.path.basename(
+            os.path.abspath(sys.argv[0] or "bench.py"))}
+        try:
+            # scheduler-regime echo: the effective XLA_FLAGS this process
+            # ran under (the resolved per-leg overlap blocks live in each
+            # leg's telemetry snapshot; the flags are the process truth)
+            from deepspeed_tpu.runtime.overlap import effective_xla_flags
+            env["xla_flags"] = effective_xla_flags()
+        except Exception:  # noqa: BLE001 — regime echo is best-effort
+            pass
+        path = os.environ.get("BENCH_JSONL", "bench_records.jsonl")
+        # append_bench_records keeps numeric non-bool entries and skips
+        # the rest (strings, nested dicts, flags)
+        _reg.append_bench_records(path, {metric: value, **extra}, env=env)
+    except Exception as e:  # noqa: BLE001 — bookkeeping must not kill bench
+        print(f"bench: leg-record append failed: {e!r}", file=sys.stderr)
 
 
 def _probe_backend():

@@ -158,7 +158,8 @@ def run_open_loop(cfg, params, prompts, budgets, rate, slo_ttft_ms,
 
 
 def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
-                    kill_at=None, block_size=64, seed=11):
+                    kill_at=None, block_size=64, seed=11,
+                    out_dir="./telemetry/serving_bench"):
     """Multi-replica chaos leg ([serving_fleet]): N supervised v2 replicas
     behind the fleet router serve the open-loop Poisson workload, and a
     replica is killed mid-load via ``runtime/faults.py``
@@ -212,6 +213,35 @@ def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
         faults.reset()      # never leak an unconsumed kill into later legs
         fleet.shutdown()
     assert all(o is not None for o in outs), "fleet lost a request"
+    # merged fleet timeline: every replica's tracer (incl. the killed
+    # incarnation's — its object outlives the death) written per-replica,
+    # then clock-aligned into ONE Perfetto view (scripts/merge_traces.py)
+    # so the kill -> migrate -> recover sequence reads off one screen
+    fleet_trace = None
+    try:
+        import os as _os
+        import sys as _sys
+        scripts_dir = _os.path.join(_os.path.dirname(
+            _os.path.abspath(__file__)), "scripts")
+        if scripts_dir not in _sys.path:
+            _sys.path.insert(0, scripts_dir)
+        import merge_traces as _mt
+        per_replica = []
+        for rep in fleet.replicas.values():
+            eng = getattr(rep, "engine", None)
+            tel = getattr(eng, "telemetry", None)
+            if tel is None or not getattr(tel.tracer, "events", None):
+                continue
+            path = _os.path.join(out_dir, f"trace_{rep.name}.json")
+            tel.emitter.write(path, tel.tracer)
+            per_replica.append(path)
+        if per_replica:
+            fleet_trace = _os.path.join(out_dir, "fleet_trace.json")
+            _mt.merge_files(fleet_trace, per_replica)
+    except Exception as e:  # noqa: BLE001 — trace export must not kill
+        print(f"bench_serving: fleet trace merge failed: {e!r}",
+              file=sys.stderr)
+        fleet_trace = None
     reg = fleet.registry._metrics
     t_kill = t0 + kill_at
     log = fleet.request_log
@@ -239,6 +269,7 @@ def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
         "fleet_router_retries": sum(
             v for _, v in reg["router_retries_total"].samples()),
         "fleet_requests_completed": len(log),
+        "fleet_trace": fleet_trace,
     }
 
 
@@ -574,7 +605,8 @@ def main(argv=None):
     if args.replicas >= 2:
         fleet_leg = leg("fleet_chaos", lambda: run_fleet_chaos(
             cfg, params, prompts, budgets, rate, args.replicas,
-            kill_at=args.kill_replica_at)) or {}
+            kill_at=args.kill_replica_at,
+            out_dir=args.telemetry_out)) or {}
 
     extra = {"static_batch_tokens_per_sec": round(v1_tps, 1),
              "telemetry_off_tokens_per_sec": round(v2_notel_tps, 1),
@@ -607,6 +639,23 @@ def main(argv=None):
         "vs_baseline": ratio(v2_tps, v1_tps),
         "extra": extra,
     }))
+
+    # per-leg JSONL records (additive — the stdout line above is the
+    # legacy interface): one machine-readable record per metric, the
+    # regression sentinel's native input (telemetry/regression.py)
+    try:
+        from deepspeed_tpu.telemetry import regression as _reg
+        # append_bench_records keeps numeric non-bool entries and skips
+        # the rest (strings, nested dicts, flags)
+        _reg.append_bench_records(
+            os.environ.get("BENCH_JSONL", "bench_records.jsonl"),
+            {"fastgen_ragged_serving_effective_tokens_per_sec":
+             round(v2_tps, 1), **extra},
+            env={"smoke": bool(smoke), "bench": "bench_serving.py",
+                 "slots": SLOTS, "replicas": int(args.replicas)})
+    except Exception as e:  # noqa: BLE001 — bookkeeping must not kill bench
+        print(f"bench_serving: leg-record append failed: {e!r}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -72,8 +72,93 @@ def _log(name: str, wire_bytes: int, axis: AxisName, chunked: bool = False):
         name = name + "_chunked"
     comms_logger.record(name, wire_bytes, str(axis))
     # telemetry counter registry (telemetry/registry.py): same trace-time
-    # semantics as the comms logger, but labeled + snapshot-exportable
-    record_collective(name, wire_bytes, str(axis))
+    # semantics as the comms logger, but labeled + snapshot-exportable.
+    # The ici/dcn split rides along: the fraction of this axis's ring hops
+    # that cross a host boundary (device coordinates from the bound mesh)
+    # attributes the same wire bytes per link — the split sums EXACTLY to
+    # the unlabeled total by construction (dcn = total - ici).
+    record_collective(name, wire_bytes, str(axis),
+                      dcn_fraction=axis_dcn_fraction(axis))
+
+
+# --------------------------------------------------------------------------
+# per-link attribution (ici vs dcn)
+# --------------------------------------------------------------------------
+# The ring convention already fixes how many bytes one participant sends;
+# WHERE those bytes travel depends on the mesh axis's device placement:
+# a hop between two devices of the same process rides ICI, a hop crossing
+# processes rides DCN.  [pod_scale]'s topology-aware collective selection
+# (The Big Send-off, arXiv:2504.18658) keys on exactly this split.
+
+# test hook: map a device -> "process" id without needing a real multi-host
+# fleet (the CPU CI is always one process); None = the device's own
+# process_index
+_PROC_OF_DEVICE = None
+
+
+def set_link_process_fn(fn) -> None:
+    """Override how devices map to hosts for the ici/dcn split (tests /
+    simulated fleets).  ``fn(device) -> hashable`` or None to restore the
+    real ``device.process_index``."""
+    global _PROC_OF_DEVICE
+    _PROC_OF_DEVICE = fn
+
+
+def _current_physical_mesh():
+    """The mesh bound by the enclosing ``with mesh:`` context (how the
+    engine dispatches), or None.  Uses jax's thread-local resource env —
+    private API, so failures degrade to 'no mesh' rather than raising at
+    trace time."""
+    try:
+        from jax._src import mesh as mesh_lib
+        pm = mesh_lib.thread_resources.env.physical_mesh
+        return None if pm.empty else pm
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def axis_dcn_fraction(axis: AxisName) -> float:
+    """Fraction of a mesh axis's cyclic ring hops that cross a host
+    (process) boundary — 0.0 on a single host or when no physical mesh is
+    bound (the wire cost is then all-ICI by definition of 'one host').
+
+    For each ring along ``axis`` (all other mesh axes fixed), hop i→i+1
+    crosses DCN when the two devices live on different processes; the
+    fraction is averaged over every ring the mesh contains.  Multi-name
+    axes flatten in axis-major order (the order ``lax`` collectives use).
+    """
+    mesh = _current_physical_mesh()
+    if mesh is None:
+        return 0.0
+    names = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    try:
+        axis_names = list(mesh.axis_names)
+        for n in names:
+            if n not in axis_names:
+                return 0.0
+        devs = mesh.devices
+        # move the collective's axes (in the given order) to the back,
+        # flatten the rest in front: rows = rings
+        order = ([i for i, n in enumerate(axis_names) if n not in names]
+                 + [axis_names.index(n) for n in names])
+        import math
+
+        import numpy as _np
+        arr = _np.transpose(devs, order).reshape(-1, math.prod(
+            devs.shape[axis_names.index(n)] for n in names))
+        n = arr.shape[1]
+        if n <= 1:
+            return 0.0
+        proc = _PROC_OF_DEVICE or (lambda d: d.process_index)
+        crossing = total = 0
+        for ring in arr:
+            for i in range(n):
+                total += 1
+                if proc(ring[i]) != proc(ring[(i + 1) % n]):
+                    crossing += 1
+        return crossing / total if total else 0.0
+    except Exception:  # noqa: BLE001 — never kill tracing over telemetry
+        return 0.0
 
 
 def get_world_size(axis: AxisName) -> int:

@@ -67,7 +67,11 @@ def _check(cond: bool, msg: str):
 
 def _log_ring(kind: str, nbytes: int, axis: str):
     comms_logger.record(kind, nbytes, axis)
-    record_collective(kind, nbytes, axis)
+    # per-link split rides along, same as comm/collectives._log: ring
+    # ppermute hops crossing a host boundary book as dcn, the rest ici
+    from deepspeed_tpu.comm.collectives import axis_dcn_fraction
+    record_collective(kind, nbytes, axis,
+                      dcn_fraction=axis_dcn_fraction(axis))
 
 
 # --------------------------------------------------------- all-gather → matmul

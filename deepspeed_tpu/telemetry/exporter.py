@@ -76,14 +76,22 @@ class SnapshotExporter:
         self.registry = registry
         self.tracer = tracer
         self.namespace = namespace
+        # monotonically increasing per exporter instance: two snapshots
+        # from one process diff into rates (counter delta / monotonic
+        # delta) without trusting wall clocks, and a scraper can tell a
+        # rewrite from a stale file.  Additive keys — old schema preserved.
+        self._seq = 0
 
     # ---- snapshot assembly ----
 
     def snapshot(self, step: Optional[int] = None,
                  extra: Optional[dict] = None) -> dict:
+        self._seq += 1
         snap = {
             "schema": "deepspeed_tpu.telemetry.v1",
             "unix_time": time.time(),
+            "monotonic_time": time.monotonic(),
+            "snapshot_seq": self._seq,
             **self.registry.snapshot(),
         }
         if step is not None:
@@ -111,6 +119,24 @@ class SnapshotExporter:
     def prometheus_text(self, snap: Optional[dict] = None) -> str:
         snap = snap if snap is not None else self.snapshot()
         lines: List[str] = []
+
+        # snapshot provenance stamps (the JSON schema's additive keys,
+        # mirrored into the exposition so two .prom files also diff into
+        # rates): seq + wall + monotonic capture time
+        for key, pname, help_text in (
+                ("snapshot_seq", "snapshot_seq",
+                 "monotonically increasing snapshot sequence number "
+                 "(per exporter instance)"),
+                ("unix_time", "snapshot_unix_time",
+                 "wall-clock capture time of this snapshot (seconds)"),
+                ("monotonic_time", "snapshot_monotonic_seconds",
+                 "monotonic capture time of this snapshot (seconds; "
+                 "diff two snapshots for rate denominators)")):
+            if key in snap:
+                full = _prom_name(self.namespace, pname)
+                lines.append(f"# HELP {full} {_help_escape(help_text)}")
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {_prom_value(float(snap[key]))}")
 
         def header(pname: str, metric: dict, prom_type: str) -> None:
             # HELP + TYPE for EVERY family (conformance: scrapers treat a

@@ -185,17 +185,31 @@ class suppress_collective_recording:
         return False
 
 
-def record_collective(name: str, nbytes: int, axis: str) -> None:
+def record_collective(name: str, nbytes: int, axis: str,
+                      dcn_fraction: float = 0.0) -> None:
     """Trace-time hook for comm/collectives.py: bytes + calls per collective
     kind per mesh axis.  Under jit these count once per *trace*, not per
     execution (per-execution truth comes from the compiled-HLO counters in
-    step_telemetry.py); in eager shard_map they count per call."""
+    step_telemetry.py); in eager shard_map they count per call.
+
+    ``dcn_fraction`` (the share of the axis's ring hops crossing a host
+    boundary — comm/collectives.axis_dcn_fraction) splits the SAME wire
+    bytes into ``link="ici"`` / ``link="dcn"`` series alongside the
+    unlabeled per-(kind, axis) total.  The split sums exactly to the
+    total: ``dcn = round(bytes · fraction)``, ``ici = bytes − dcn`` — the
+    telemetry [pod_scale]'s topology-aware collective selection keys on.
+    """
     if _suppress_collectives:
         return
-    default_registry.counter(
+    bytes_c = default_registry.counter(
         COLLECTIVE_BYTES,
         "bytes entering named collective wrappers, per kind per mesh axis "
-        "(trace-time under jit)").inc(nbytes, kind=name, axis=axis)
+        "(trace-time under jit); link=ici|dcn series split the same bytes "
+        "by interconnect and sum exactly to the unlabeled total")
+    bytes_c.inc(nbytes, kind=name, axis=axis)
+    dcn_bytes = int(round(nbytes * max(0.0, min(1.0, dcn_fraction))))
+    bytes_c.inc(nbytes - dcn_bytes, kind=name, axis=axis, link="ici")
+    bytes_c.inc(dcn_bytes, kind=name, axis=axis, link="dcn")
     default_registry.counter(
         COLLECTIVE_CALLS,
         "calls into named collective wrappers, per kind per mesh axis "

@@ -244,6 +244,34 @@ class StepTelemetry:
         except Exception:  # noqa: BLE001 — not all backends implement it
             pass
         try:
+            # per-op-class roofline (telemetry/roofline.py): flops / HBM
+            # bytes / collective wire bytes per class joined with the
+            # accelerator peak-spec table → an attainable-step-time lower
+            # bound and a binding-resource split.  Uses the same hlo_text
+            # and calibrates flops against cost_analysis (while-loop trip
+            # counts are invisible to the static walk).
+            from deepspeed_tpu.telemetry.roofline import (detect_peak_spec,
+                                                          roofline_from_hlo)
+            model = roofline_from_hlo(hlo_text, spec=detect_peak_spec(),
+                                      cost_analysis=info.get(
+                                          "cost_analysis"))
+            info["roofline"] = model
+            self.registry.gauge(
+                "roofline_attainable_ms",
+                "roofline attainable-step-time lower bound from the "
+                "compiled HLO (sum over op classes of each class's "
+                "binding-resource time), per jitted function").set(
+                    model["attainable_ms"], fn=fn_name)
+            g = self.registry.gauge(
+                "roofline_bound_fraction",
+                "fraction of the roofline attainable time bound by each "
+                "resource (compute / hbm / ici), per jitted function")
+            for res, frac in model["bound_fraction"].items():
+                g.set(frac, fn=fn_name, resource=res)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"telemetry: roofline model of '{fn_name}' "
+                           f"failed: {e!r}")
+        try:
             ma = compiled.memory_analysis()
             mem = {}
             for attr in _MEMORY_ATTRS:

@@ -56,6 +56,10 @@ class SpanTracer:
         # track per request (queue_wait / prefill / decode laid end to end)
         self.thread_names: Dict[int, str] = {}
         self._epoch_ns = time.perf_counter_ns()
+        # wall-clock anchor of the ts=0 epoch: lets scripts/merge_traces.py
+        # align traces from different processes/replicas (each tracer's ts
+        # is relative to its own construction) onto one shared timeline
+        self.epoch_unix_time = time.time()
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._epoch_ns) / 1e3
@@ -158,7 +162,14 @@ class TraceEmitter:
         return {
             "traceEvents": meta + list(tracer.events),
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": tracer.dropped_events},
+            "otherData": {
+                "dropped_events": tracer.dropped_events,
+                # clock anchor for scripts/merge_traces.py: wall time of
+                # this trace's ts=0 (absent in traces written before the
+                # stamp existed — the merger then falls back to as-is)
+                "epoch_unix_time": getattr(tracer, "epoch_unix_time",
+                                           None),
+            },
         }
 
     def write(self, path: str, tracer: SpanTracer) -> str:

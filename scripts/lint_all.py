@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Run every repo lint in ONE process with a unified summary.
+
+Four lints guard four interfaces, and until now each was wired into the
+test suite as its own subprocess run (three interpreter startups + three
+jax imports just to say "clean"):
+
+- ``check_no_sync``  — no undisclosed host↔device syncs on dispatch paths
+- ``check_overlap``  — chunked collectives keep compute between them
+  (compiled-HLO demo on virtual CPU devices)
+- ``check_metrics``  — metric naming convention + docs coverage
+- ``check_bench --self-test`` — the bench regression sentinel trips on
+  the canned 10% slowdown fixture and stays quiet in the noise band
+
+This driver imports each lint's ``main()`` and runs them back to back,
+printing one PASS/FAIL table.  The test suite shells THIS script once
+(tests/test_lint_all.py); the per-lint violation/unit tests stay where
+they were.
+
+    python scripts/lint_all.py            # all four
+    python scripts/lint_all.py --only check_metrics check_bench
+
+Exit status: 0 all pass, 1 any lint failed, 2 a lint crashed / usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import sys
+import time
+from contextlib import redirect_stderr, redirect_stdout
+from typing import Callable, List, Optional, Tuple
+
+# check_overlap's --demo compiles on virtual CPU devices: both env knobs
+# must be set BEFORE anything imports jax in this process
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.join(HERE, os.pardir)
+for p in (HERE, REPO):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def _lints() -> List[Tuple[str, Callable[[], int]]]:
+    import check_bench
+    import check_metrics
+    import check_no_sync
+    import check_overlap
+    return [
+        ("check_no_sync", lambda: check_no_sync.main([])),
+        ("check_overlap", lambda: check_overlap.main(
+            ["--demo", "--assert-overlap", "--min-chunks", "2"])),
+        ("check_metrics", lambda: check_metrics.main([])),
+        ("check_bench", lambda: check_bench.main(["--self-test"])),
+    ]
+
+
+def run_all(only: Optional[List[str]] = None,
+            verbose: bool = False) -> int:
+    results: List[Tuple[str, str, float, str]] = []
+    worst = 0
+    for name, fn in _lints():
+        if only and name not in only:
+            continue
+        buf = io.StringIO()
+        t0 = time.perf_counter()
+        try:
+            with redirect_stdout(buf), redirect_stderr(buf):
+                rc = int(fn())
+        except SystemExit as e:  # argparse error inside a lint
+            rc = int(e.code or 0)
+        except Exception as e:  # noqa: BLE001 — a crashed lint is rc 2
+            buf.write(f"{type(e).__name__}: {e}\n")
+            rc = 2
+        dt = time.perf_counter() - t0
+        status = "PASS" if rc == 0 else ("FAIL" if rc == 1 else "ERROR")
+        results.append((name, status, dt, buf.getvalue()))
+        worst = max(worst, rc)
+    print("lint_all: unified lint summary")
+    for name, status, dt, _ in results:
+        print(f"  {name:<16}{status:<7}{dt:>7.1f}s")
+    for name, status, _, output in results:
+        if status != "PASS" or verbose:
+            print(f"\n---- {name} ({status}) ----")
+            print(output.rstrip() or "(no output)")
+    if worst == 0:
+        print(f"lint_all: OK — {len(results)} lints clean")
+    return 0 if worst == 0 else (1 if worst == 1 else 2)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run check_no_sync, check_overlap, check_metrics and "
+                    "the check_bench fixture lint in one process")
+    ap.add_argument("--only", nargs="+", metavar="LINT",
+                    help="subset of lints to run (by name)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print every lint's output, not just failures")
+    args = ap.parse_args(argv)
+    if args.only:
+        known = {name for name, _ in _lints()}
+        unknown = set(args.only) - known
+        if unknown:
+            print(f"lint_all: unknown lints {sorted(unknown)} "
+                  f"(known: {sorted(known)})", file=sys.stderr)
+            return 2
+    return run_all(only=args.only, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -547,15 +547,9 @@ class TestOffloadOverflowSentinel:
 # ------------------------------------------------------- CI tooling smoke
 
 class TestTooling:
-    def test_check_no_sync_lint_passes(self):
-        """The lint must hold on the current engine (wired into the suite
-        so a new undisclosed float()/np.asarray() on the step path fails
-        CI)."""
-        p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts",
-                                          "check_no_sync.py")],
-            capture_output=True, text=True)
-        assert p.returncode == 0, p.stderr
+    # the whole-repo green run of check_no_sync moved into the unified
+    # lint driver (scripts/lint_all.py, shelled once by
+    # tests/test_lint_all.py); the violation/behavior tests stay here
 
     def test_check_no_sync_lint_catches_violation(self, tmp_path):
         bad = tmp_path / "engine.py"
