@@ -347,6 +347,79 @@ def _serving_point(out=None, emit=None):
     return out
 
 
+def _guardian_point(initialize, out=None, emit=None):
+    """Guardian chaos leg (runtime/guardian.py): poison one step's grads
+    with the ``nan@step.grads`` fault, let the control loop roll back to
+    the health-verified ring checkpoint and skip the window, and report
+    ``rollback_recovery_ms`` (detection → training-ready) — the
+    self-healing latency the regression sentinel tracks.  Tiny model, CPU
+    and TPU alike: the number measures the remediation machinery (restore
+    + cursor rewind + pipeline rebuild), not the model."""
+    import tempfile
+
+    import numpy as np
+
+    from deepspeed_tpu.models import GPT, GPTConfig
+    from deepspeed_tpu.runtime import faults
+    out = {} if out is None else out
+    tick = emit or (lambda: None)
+    vocab, seq = 64, 32
+    run_dir = tempfile.mkdtemp(prefix="bench_guardian_")
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        "data_pipeline": {"prefetch_depth": 2},
+        "telemetry": {"enabled": False,
+                      "health": {"enabled": True,
+                                 "dump_path": os.path.join(run_dir, "pm")}},
+        "guardian": {"enabled": True, "checkpoint_interval": 2,
+                     "ring_keep": 3, "clean_window": 1, "max_rollbacks": 2,
+                     "watchdog": {"warmup_deadline_s": 600.0,
+                                  "min_deadline_s": 120.0,
+                                  "deadline_factor": 100.0}},
+    }
+    eng, _, _, _ = initialize(
+        model=GPT(GPTConfig.tiny(vocab_size=vocab, max_seq_len=seq)),
+        config=cfg,
+        example_batch={"input_ids": np.zeros((2, seq), np.int32)})
+    batch = int(eng.train_batch_size)
+
+    def batch_fn(i):
+        rng = np.random.default_rng(7000 + i)
+        return {"input_ids": rng.integers(0, vocab,
+                                          size=(batch, seq)
+                                          ).astype(np.int32)}
+
+    import shutil
+    faults.reset()
+    try:
+        faults.inject("step.grads", "nan", after=5)   # poisons step 6
+        guardian = eng.guardian(run_dir, batch_fn=batch_fn)
+        report = guardian.run(10)
+    finally:
+        # a leg abort must not leave the one-shot nan armed process-wide:
+        # later measured legs fire the same step.grads site
+        faults.reset()
+        shutil.rmtree(run_dir, ignore_errors=True)
+    out["guardian_status"] = report.status
+    out["guardian_rollbacks"] = report.rollbacks
+    # numeric healed flag for the regression sentinel: strings are dropped
+    # by the flattener and a missing metric is skipped non-strict, so this
+    # is the one guaranteed-present number that trips when the
+    # self-healing machinery itself breaks
+    out["guardian_healed"] = (
+        1.0 if report.status == "completed" and report.rollbacks == 1
+        else 0.0)
+    out["guardian_skipped_sources"] = len(report.skipped_sources)
+    if report.rollback_recovery_ms:
+        out["rollback_recovery_ms"] = round(
+            float(np.mean(report.rollback_recovery_ms)), 2)
+    tick()
+    return out
+
+
 def run_bench():
     """The actual measurement (runs inside the supervised subprocess)."""
     import jax
@@ -551,6 +624,13 @@ def run_bench():
     # the attempt timeout, the supervisor salvages this line from the killed
     # subprocess's partial stdout instead of losing the whole attempt
     emit()
+    # guardian chaos leg: CPU-sized on every run (smoke included) — it
+    # measures the remediation machinery, not the model
+    try:
+        _guardian_point(deepspeed_tpu.initialize, out=extra, emit=emit)
+    except Exception as e:  # noqa: BLE001 — a broken chaos leg must not
+        extra["guardian_leg_error"] = str(e)[:120]   # cost the headline
+        extra["guardian_healed"] = 0.0   # the sentinel must see the break
     if not smoke:
         _extra_points(GPTChunkedLoss, GPTConfig, deepspeed_tpu.initialize,
                       out=extra, emit=emit)

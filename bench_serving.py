@@ -186,10 +186,18 @@ def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
         "max_q_per_seq": 512,
         "kv_block_size": block_size},
         "generation": {"do_sample": False}}
+    # first-call compile stalls are covered by the fleet's
+    # warmup_deadline_s gate now (an incarnation's first generate runs
+    # under the warm-up budget) — the old blanket 120 s steady-state
+    # deadline papered over exactly that.  A modest steady-state override
+    # remains because CPU XLA can still compile a NEW schedule bucket
+    # mid-serve (~tens of seconds on a cold box); TPU fleets keep the
+    # 10 s default.
     fleet = ServingFleet(cfg, engine_config=ecfg, params=params,
                          config={"num_replicas": int(replicas),
                                  "respawn": False,
-                                 "heartbeat_deadline_s": 120.0,
+                                 "warmup_deadline_s": 600.0,
+                                 "heartbeat_deadline_s": 60.0,
                                  "router": {"max_retries": int(replicas)
                                             + 1}})
     arr_rng = np.random.default_rng(seed)

@@ -1,0 +1,223 @@
+"""Guarded checkpoint ring — K rolling universal exports with
+health-verified rollback-eligibility stamps.
+
+The guardian (runtime/guardian.py) can only roll back to a checkpoint it
+can TRUST: an export taken two steps before a NaN burst may already carry
+the poisoned optimizer moments, and "the newest export" is exactly the
+wrong rollback target.  The ring therefore separates two properties:
+
+- **complete** — the export committed under the crash-safe protocol
+  (checkpoint/universal.py: ``.in_progress`` marker → fragments + meta
+  durable → marker off).  Completeness is what PR 6's resume path already
+  checks; a torn ring entry is never selected for anything.
+- **rollback-eligible** — the export's TRAILING anomaly window was clean:
+  the guardian observed ``clean_window`` further steps with no anomaly
+  before stamping it.  The stamp (``rollback_eligible.json``) is written
+  atomically (tmp + rename) INSIDE the committed export dir, so it is
+  either absent or whole; an export that never earns its stamp is just a
+  regular resume candidate, never a rollback target.
+
+Entries are named ``ring_<step>`` under the run dir — ordinary universal
+exports, so the elastic-agent resume scan (``universal_candidates``) sees
+them too.  ``prune`` keeps the newest ``keep`` entries plus, always, the
+newest ELIGIBLE entry (the guardian must never be left without a rollback
+source); deletion drops the ``.in_progress`` marker back into the doomed
+dir first, so a crash mid-delete leaves a directory every reader already
+refuses, not a half-present export.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import List, NamedTuple, Optional
+
+from deepspeed_tpu.checkpoint import (IN_PROGRESS_FILE, _universal_step,
+                                      universal_complete)
+from deepspeed_tpu.utils.logging import logger
+
+RING_PREFIX = "ring_"
+ELIGIBLE_FILE = "rollback_eligible.json"
+RING_SIZE_GAUGE = "checkpoint_ring_size"
+
+
+class RingEntry(NamedTuple):
+    step: int
+    path: str
+    eligible: bool
+
+
+def is_eligible(path: str) -> bool:
+    """True iff ``path`` is a COMPLETE universal export carrying a whole
+    eligibility stamp."""
+    if not universal_complete(path):
+        return False
+    stamp = os.path.join(path, ELIGIBLE_FILE)
+    try:
+        with open(stamp) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+class CheckpointRing:
+    """K rolling universal exports under ``run_dir``, stamped
+    rollback-eligible by the guardian once their trailing anomaly window
+    proves clean."""
+
+    def __init__(self, run_dir: str, keep: int = 3, registry=None):
+        if keep < 1:
+            raise ValueError(f"ring keep must be >= 1, got {keep}")
+        self.run_dir = run_dir
+        self.keep = int(keep)
+        self.registry = registry
+        os.makedirs(run_dir, exist_ok=True)
+
+    # ------------------------------------------------------------ exports
+
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.run_dir, f"{RING_PREFIX}{int(step):08d}")
+
+    def export(self, engine) -> str:
+        """Commit a ring entry for the engine's current step (crash-safe —
+        the same ``export_universal_checkpoint`` protocol as drains) and
+        prune.  Idempotent: an already-committed same-step entry is reused,
+        never re-marked in-progress (the drain-path lesson)."""
+        step = engine.global_steps
+        path = self.path_for(step)
+        if not (universal_complete(path) and _universal_step(path) == step):
+            # a fresh commit must never inherit a stale eligibility stamp
+            # (a dir left torn by a crash mid-prune/discard still carries
+            # its rollback_eligible.json): eligibility is earned by THIS
+            # export's trailing window only
+            try:
+                os.remove(os.path.join(path, ELIGIBLE_FILE))
+            except OSError:
+                pass
+            engine.export_universal_checkpoint(path, run_dir=self.run_dir)
+        self.prune()
+        return path
+
+    # ------------------------------------------------------- eligibility
+
+    def stamp(self, path: str, *, step: int, stamped_at_step: int,
+              clean_window: int) -> None:
+        """Mark a COMPLETE entry rollback-eligible.  Atomic (tmp + rename):
+        readers see no stamp or a whole one, and a crash between the
+        export commit and the stamp merely leaves a valid-but-ineligible
+        entry."""
+        if not universal_complete(path):
+            raise ValueError(
+                f"refusing to stamp {path}: not a COMPLETE universal "
+                f"export (torn or foreign)")
+        stamp = os.path.join(path, ELIGIBLE_FILE)
+        tmp = f"{stamp}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step),
+                       "stamped_at_step": int(stamped_at_step),
+                       "clean_window": int(clean_window),
+                       "unix_time": time.time()}, f)
+        os.replace(tmp, stamp)
+        self._export_gauge()
+
+    # ------------------------------------------------------------ queries
+
+    def entries(self) -> List[RingEntry]:
+        """COMPLETE ring entries, oldest step first."""
+        out = []
+        if not os.path.isdir(self.run_dir):
+            return out
+        for name in sorted(os.listdir(self.run_dir)):
+            if not name.startswith(RING_PREFIX):
+                continue
+            path = os.path.join(self.run_dir, name)
+            if not universal_complete(path):
+                continue
+            step = _universal_step(path)
+            if step is None:
+                continue
+            out.append(RingEntry(step=step, path=path,
+                                 eligible=is_eligible(path)))
+        out.sort(key=lambda e: e.step)
+        return out
+
+    def latest_eligible(self, *, max_step: Optional[int] = None
+                        ) -> Optional[RingEntry]:
+        """Newest rollback-eligible entry (optionally at/below
+        ``max_step``), or None — the guardian's rollback target."""
+        best = None
+        for e in self.entries():
+            if not e.eligible:
+                continue
+            if max_step is not None and e.step > max_step:
+                continue
+            if best is None or e.step > best.step:
+                best = e
+        return best
+
+    def discard_after(self, step: int) -> List[str]:
+        """Delete every ring entry NEWER than ``step`` — after a rollback
+        those entries belong to the abandoned timeline, and a later
+        re-export at the same step number must never silently reuse them
+        (the replayed run skips a data window, so same-step params
+        differ).  Same crash-safe deletion as prune.  Returns the deleted
+        paths."""
+        deleted = []
+        for e in self.entries():
+            if e.step <= step:
+                continue
+            try:
+                with open(os.path.join(e.path, IN_PROGRESS_FILE), "w") as f:
+                    f.write("discarded: post-rollback timeline")
+                shutil.rmtree(e.path)
+                deleted.append(e.path)
+            except OSError as exc:
+                logger.warning(f"checkpoint ring: discard of {e.path} "
+                               f"failed: {exc!r}")
+        self._export_gauge()
+        return deleted
+
+    # ------------------------------------------------------------ pruning
+
+    def prune(self) -> List[str]:
+        """Delete entries beyond the newest ``keep``, always retaining the
+        newest ELIGIBLE entry even when it falls off the tail.  Returns the
+        deleted paths."""
+        entries = self.entries()
+        kept = entries[-self.keep:]
+        protected = {e.path for e in kept}
+        newest_eligible = self.latest_eligible()
+        if newest_eligible is not None:
+            protected.add(newest_eligible.path)
+        deleted = []
+        for e in entries:
+            if e.path in protected:
+                continue
+            try:
+                # mark torn FIRST: a crash mid-rmtree must leave a dir
+                # every complete-export check already rejects
+                with open(os.path.join(e.path, IN_PROGRESS_FILE), "w") as f:
+                    f.write("pruning")
+                shutil.rmtree(e.path)
+                deleted.append(e.path)
+            except OSError as exc:
+                logger.warning(f"checkpoint ring: prune of {e.path} "
+                               f"failed: {exc!r}")
+        self._export_gauge()
+        return deleted
+
+    def _export_gauge(self) -> None:
+        if self.registry is None:
+            return
+        entries = self.entries()
+        g = self.registry.gauge(
+            RING_SIZE_GAUGE,
+            "guarded checkpoint ring entries on disk, by eligibility "
+            "(eligible = trailing anomaly window verified clean)")
+        g.set(float(sum(1 for e in entries if e.eligible)),
+              eligible="true")
+        g.set(float(sum(1 for e in entries if not e.eligible)),
+              eligible="false")

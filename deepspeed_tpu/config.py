@@ -489,6 +489,112 @@ class ResilienceConfig(DeepSpeedConfigModel):
     aot_warmup: bool = True
 
 
+class GuardianWatchdogConfig(DeepSpeedConfigModel):
+    """Hang/straggler watchdog (runtime/guardian.py HangWatchdog): a
+    monitor thread deadlines each training step against an EMA-adaptive
+    budget.  On a trip it dumps a flight-recorder bundle carrying
+    all-thread stacks, bumps ``hangs_total``, and initiates a drain —
+    escalating to a hard ``EXIT_DRAINED`` exit after ``grace_s`` if the
+    step is still wedged (a process stuck inside a collective cannot drain
+    itself)."""
+
+    enabled: bool = True
+    # deadline = max(min_deadline_s, deadline_factor x EMA(step wall time))
+    deadline_factor: float = 8.0
+    min_deadline_s: float = 5.0
+    # before the FIRST completed step the EMA is empty and the step
+    # legitimately contains the XLA compile — the deadline is gated on
+    # warm-up completion instead of booking a cold program as a hang (the
+    # same first-call-compile hazard as the serving fleet's heartbeat)
+    warmup_deadline_s: float = 600.0
+    # after a trip: how long the watchdog waits for the step to come back
+    # before the hard EXIT_DRAINED exit (the bundle is already on disk)
+    grace_s: float = 10.0
+    ema_alpha: float = 0.2
+    poll_interval_s: float = 0.05
+
+    @model_validator(mode="after")
+    def _check(self):
+        for knob in ("deadline_factor", "min_deadline_s",
+                     "warmup_deadline_s", "grace_s", "poll_interval_s"):
+            if getattr(self, knob) <= 0:
+                raise ValueError(f"guardian.watchdog.{knob} must be > 0")
+        if not 0 < self.ema_alpha <= 1:
+            raise ValueError("guardian.watchdog.ema_alpha must be in (0, 1]")
+        return self
+
+
+class GuardianConfig(DeepSpeedConfigModel):
+    """Self-healing training (runtime/guardian.py): a closed control loop
+    converting the numerics-health anomaly signals into automatic
+    remediation — rollback to the last health-verified ring checkpoint
+    (checkpoint/ring.py), deterministic skip of the offending data window,
+    LR/loss-scale clamp-down on repeated retries — under a bounded retry
+    budget that escalates to postmortem-dump + graceful drain.  Requires
+    ``telemetry.health.enabled`` (the anomaly signals are the health
+    monitor's).  See docs/resilience.md "Self-healing"."""
+
+    enabled: bool = False
+    # steps between guarded-ring exports (checkpoint/ring.py)
+    checkpoint_interval: int = 50
+    ring_keep: int = 3
+    # trailing anomaly-free steps before a ring export earns its
+    # rollback-eligibility stamp
+    clean_window: int = 8
+    # rollbacks tolerated per incident (no net step progress) before the
+    # guardian escalates to postmortem + drain
+    max_rollbacks: int = 3
+    # advance the data cursor past the replayed window (seed-stable skip of
+    # the batches consumed since the rollback target)
+    skip_data_window: bool = True
+    # from the (clamp_after_rollbacks+1)-th rollback of one incident, clamp
+    # the LR (re-jits the step programs) and the dynamic loss scale down
+    clamp_after_rollbacks: int = 1
+    lr_clamp_factor: float = 0.5
+    loss_scale_clamp_factor: float = 0.5
+    # anomaly signals that trigger a rollback; anything not listed is
+    # observed (counted, recorded) but not remediated
+    rollback_on: list = Field(default_factory=lambda: [
+        "nonfinite_loss", "grad_nan", "overflow_streak", "loss_spike",
+        "grad_norm_explosion", "loss_scale_collapse"])
+    watchdog: GuardianWatchdogConfig = Field(
+        default_factory=GuardianWatchdogConfig)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.checkpoint_interval < 1:
+            raise ValueError("guardian.checkpoint_interval must be >= 1")
+        if self.ring_keep < 1:
+            raise ValueError("guardian.ring_keep must be >= 1")
+        if self.clean_window < 1:
+            raise ValueError("guardian.clean_window must be >= 1")
+        if self.clean_window > self.ring_keep * self.checkpoint_interval:
+            raise ValueError(
+                f"guardian.clean_window={self.clean_window} exceeds the "
+                f"ring's retention span ring_keep*checkpoint_interval="
+                f"{self.ring_keep * self.checkpoint_interval}: every "
+                f"export would be pruned off the keep tail before its "
+                f"trailing window could prove clean, so no entry would "
+                f"ever become rollback-eligible and the first anomaly "
+                f"would escalate straight to drain")
+        if self.max_rollbacks < 0:
+            raise ValueError("guardian.max_rollbacks must be >= 0")
+        if self.clamp_after_rollbacks < 0:
+            raise ValueError("guardian.clamp_after_rollbacks must be >= 0")
+        for knob in ("lr_clamp_factor", "loss_scale_clamp_factor"):
+            if not 0 < getattr(self, knob) <= 1:
+                raise ValueError(f"guardian.{knob} must be in (0, 1]")
+        known = {"nonfinite_loss", "grad_nan", "overflow_streak",
+                 "loss_spike", "grad_norm_explosion",
+                 "loss_scale_collapse"}
+        bad = [r for r in self.rollback_on if r not in known]
+        if bad:
+            raise ValueError(
+                f"guardian.rollback_on: unknown signal(s) {bad}; "
+                f"known: {sorted(known)}")
+        return self
+
+
 class GradientCompressionConfig(DeepSpeedConfigModel):
     """DCN-tier gradient compression (replaces reference 1-bit optimizers'
     error-feedback compression, runtime/fp16/onebit/ — see SURVEY.md: pointless over
@@ -537,6 +643,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     elasticity: ElasticityJSONConfig = Field(
         default_factory=ElasticityJSONConfig)
     resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
+    guardian: GuardianConfig = Field(default_factory=GuardianConfig)
     aio: AIOConfig = Field(default_factory=AIOConfig)
 
     gradient_clipping: float = 0.0

@@ -37,7 +37,7 @@ from deepspeed_tpu.monitor import MonitorMaster
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.parallel import partition
 from deepspeed_tpu.parallel.metadata import annotate_abstract, unbox
-from deepspeed_tpu.runtime import lr_schedules, optimizers, zero
+from deepspeed_tpu.runtime import faults, lr_schedules, optimizers, zero
 from deepspeed_tpu.runtime.precision import (LossScaleState, grads_finite,
                                              init_loss_scale, update_loss_scale)
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -78,6 +78,24 @@ def _cast_params(params, dtype):
     return jax.tree_util.tree_map(
         lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
         params)
+
+
+def _poison_first_float_leaf(params):
+    """Engine-site payload of the ``nan`` fault kind at ``step.grads``:
+    multiply the first floating-point parameter leaf by NaN (shape, dtype
+    and sharding preserved).  The poisoned leaf drives this step's loss and
+    gradients non-finite, and — whether the update is skipped by the
+    overflow machinery or applied — the corruption PERSISTS in the live
+    state, exactly the NaN-burst failure the guardian's rollback must heal
+    (a replayed step without the fault cannot; only restoring a
+    health-verified checkpoint can)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    for i, leaf in enumerate(leaves):
+        if (hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            leaves[i] = leaf * jnp.array(jnp.nan, leaf.dtype)
+            break
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 class DeepSpeedTPUEngine:
@@ -396,6 +414,13 @@ class DeepSpeedTPUEngine:
             self._opt_params = dict(config.optimizer.params)
         else:
             self.offload_opt = None
+        # guardian clamp-down state: effective LR = configured LR x
+        # _lr_scale (engine.clamp_lr); kept OUTSIDE the optimizer so the
+        # offload host step reads it sync-free and the device paths rebuild
+        # their chain from it on a clamp
+        self._lr_scale = 1.0
+        self._client_optimizer = client_optimizer
+        if not self.offloading:
             self.optimizer, self._opt_params = self._build_tx(client_optimizer)
         # overlapped host step (offload_optimizer.overlap_step): the CPU Adam
         # of step N runs on a worker thread while the device computes step
@@ -712,8 +737,22 @@ class DeepSpeedTPUEngine:
             params = dict(cfg.optimizer.params)
             if self.lr_schedule is not None:
                 params["lr"] = self.lr_schedule
+            scale = self._lr_scale
+            base = params.get("lr", 1e-3)
+            if scale != 1.0:
+                # guardian clamp-down: scale whatever LR the chain would
+                # have seen (schedule or constant) — the clamp survives a
+                # re-jit because _build_tx is the single LR authority
+                if callable(base):
+                    params["lr"] = lambda s, _b=base, _k=scale: _b(s) * _k
+                else:
+                    params["lr"] = float(base) * scale
             inner, opt_params = optimizers.build_optimizer(
                 cfg.optimizer.type, params)
+            if scale != 1.0 and not callable(base):
+                # readers (get_lr) apply _lr_scale themselves: keep the
+                # resolved params UNSCALED so the clamp is applied once
+                opt_params = dict(opt_params, lr=float(base))
         chain = []
         # error-feedback compressed grads (runtime/compression.py) — BEFORE
         # clipping so the clip sees the signal the optimizer will consume.
@@ -1193,6 +1232,7 @@ class DeepSpeedTPUEngine:
             lr = (float(self.lr_schedule(self.offload_opt.step_count))  # sync-ok: host schedule math
                   if self.lr_schedule is not None
                   else float(self._opt_params.get("lr", 1e-3)))  # sync-ok: config scalar
+            lr *= self._lr_scale          # guardian clamp-down (1.0 normally)
 
             def host_update(grad_scale=coef / denom, lr=lr):
                 # the heavy half: grads fetch + host Adam over the fp32
@@ -1432,6 +1472,12 @@ class DeepSpeedTPUEngine:
                            and self.global_steps + 1 >= fp.profile_step)
         if profile_pending:
             self._last_batch = batch  # traced by the flops profiler, then freed
+        # chaos: ``nan@step.grads`` forces this step's gradient computation
+        # non-finite (see _poison_first_float_leaf) — the signal the
+        # guardian's rollback remediation is chaos-verified against
+        if faults.fire("step.grads", step=step_id) == "nan":
+            self.state = self.state._replace(
+                params=_poison_first_float_leaf(self.state.params))
         self.timers(TRAIN_BATCH_TIMER).start()
         with self.mesh:
             if tel.enabled:
@@ -1443,6 +1489,9 @@ class DeepSpeedTPUEngine:
                     "train_batch", batch, step_id,
                     lower=lambda: jfn.lower(self.state, batch))
             with tel.span("dispatch", step=step_id):
+                # chaos: ``sleep@step.dispatch`` models a hung collective /
+                # straggler stall — the guardian watchdog's deadline target
+                faults.fire("step.dispatch", step=step_id)
                 if self.offloading:
                     # sets _last_health (host dict) itself
                     metrics = self._train_batch_offload(batch)
@@ -1594,7 +1643,7 @@ class DeepSpeedTPUEngine:
 
     def get_lr(self):
         if self.lr_schedule is None:
-            return [float(self._opt_params.get("lr", 0.0))]
+            return [float(self._opt_params.get("lr", 0.0)) * self._lr_scale]
         host = self._last_metrics_host
         if host is not None and self._host_metrics_step == self.global_steps:
             # state.step mirror without a device sync: overflow-skipped
@@ -1602,7 +1651,7 @@ class DeepSpeedTPUEngine:
             step = self.global_steps - host.skipped_steps
         else:
             step = int(self.state.step)  # sync-ok: cold path, no cached copy
-        return [float(self.lr_schedule(step))]
+        return [float(self.lr_schedule(step)) * self._lr_scale]
 
     def _fetch_metrics(self, metrics: StepMetrics,
                        health=None) -> StepMetrics:
@@ -1890,6 +1939,59 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.runtime import resilience
         return resilience.drain(self, run_dir, reason=reason,
                                 out_dir=out_dir)
+
+    def clamp_lr(self, factor: float) -> float:
+        """Multiply the effective learning rate by ``factor`` from now on —
+        the guardian's escalated-retry clamp-down.  On the device paths the
+        LR is traced into the compiled update, so this rebuilds the
+        optimizer chain and re-jits the step programs (one recompile; the
+        recompile watchdog is invalidated so it doesn't warn).  The offload
+        host step reads the scale directly — no recompile.  Returns the
+        cumulative scale.  Refuses under a client optimizer: the engine
+        cannot rebuild a chain it did not build."""
+        if not 0 < factor <= 1:
+            raise ValueError(f"clamp_lr factor must be in (0, 1], "
+                             f"got {factor}")
+        if self._client_optimizer is not None:
+            raise ValueError(
+                "clamp_lr cannot rebuild a client optimizer chain; clamp "
+                "the LR inside your own optimizer/schedule instead")
+        self._lr_scale *= float(factor)
+        if not self.offloading:
+            self.optimizer, self._opt_params = self._build_tx(None)
+            self._build_step_functions()
+        logger.warning(f"guardian: learning rate clamped x{factor:g} "
+                       f"(cumulative scale {self._lr_scale:g})")
+        return self._lr_scale
+
+    def clamp_loss_scale(self, factor: float) -> None:
+        """Scale the dynamic loss scale DOWN by ``factor`` (floored at
+        ``fp16.min_loss_scale``) — a data-only state edit, no recompile.
+        No-op outside dynamic fp16 scaling (bf16/fp32 run at the frozen
+        unit scale)."""
+        if not 0 < factor <= 1:
+            raise ValueError(f"clamp_loss_scale factor must be in (0, 1], "
+                             f"got {factor}")
+        cfg = self.config.fp16
+        if not cfg.enabled or cfg.loss_scale > 0:
+            return
+        ls = self.state.loss_scale
+        new_scale = jnp.maximum(ls.scale * jnp.float32(factor),
+                                jnp.float32(cfg.min_loss_scale))
+        self.state = self.state._replace(
+            loss_scale=ls._replace(scale=new_scale))
+
+    def guardian(self, run_dir: str, *, batch_fn=None, cursor=None,
+                 handler=None, config=None, **kwargs):
+        """Build the self-healing control loop over this engine
+        (runtime/guardian.py Guardian): guarded checkpoint ring, anomaly →
+        rollback/skip/clamp remediation, hang watchdog.  ``batch_fn(i)``
+        must be a pure (seed-stable) host-batch factory; alternatively pass
+        a prepared ``DataCursor``.  Reads the ``guardian`` config block
+        unless ``config`` overrides it."""
+        from deepspeed_tpu.runtime.guardian import Guardian
+        return Guardian(self, run_dir, batch_fn=batch_fn, cursor=cursor,
+                        handler=handler, config=config, **kwargs)
 
     def resume_from_latest(self, run_dir: str,
                            warmup: Optional[bool] = None) -> Optional[str]:

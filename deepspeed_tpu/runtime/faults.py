@@ -22,7 +22,12 @@ Fault kinds:
                   ``finally`` blocks, no atexit — the SIGKILL/preemption case
 - ``sleep``     — delay the site by ``arg`` seconds (slow-commit races: a
                   reader scanning for the newest COMPLETE export while the
-                  commit is stretched out)
+                  commit is stretched out; a hung collective when armed at
+                  ``step.dispatch``)
+- ``nan``       — a SIGNAL-ONLY kind: :func:`fire` returns ``"nan"`` and the
+                  instrumented site poisons its own values (the engine's
+                  ``step.grads`` site writes NaN into the step's gradient
+                  computation — the NaN-burst model the guardian remediates)
 
 Configuration: programmatic (``inject("universal.pre_meta", "exc")``) or the
 ``DSTPU_FAULTS`` env var (comma list of ``kind@site[:arg][*count][+after]``
@@ -37,12 +42,17 @@ always armed-empty in production — there is no "enabled" flag to forget.
 
 Instrumented site families (grep for ``faults.fire`` / ``fire(`` for the
 authoritative list): ``universal.*`` / ``drain.*`` (checkpoint + drain
-durability ordering, PR 6) and the serving-fleet sites —
+durability ordering, PR 6), the serving-fleet sites —
 ``router.dispatch`` (a dispatch attempt from the fleet router),
 ``replica.heartbeat`` (a replica's liveness beat; ``sleep`` here models a
 stalled replica the supervisor must deadline out), ``replica.mid_decode``
-(inside the v2 engine's scheduler loop — a replica dying mid-serve), and
-``admission.decide`` (the admission controller's per-request decision).
+(inside the v2 engine's scheduler loop — a replica dying mid-serve),
+``admission.decide`` (the admission controller's per-request decision),
+``fleet.respawn_factory`` (the engine factory during a respawn — an ``exc``
+here must book the replica dead, never unwind the dispatcher) — and the
+training step path: ``step.grads`` (``nan`` poisons the step's gradient
+computation) and ``step.dispatch`` (``sleep`` models a hung collective the
+guardian's watchdog must deadline out).
 
 Introspection: :func:`fired`/:func:`armed`/:func:`sites` read the per-site
 accounting (fired counts persist after a one-shot fault disarms, so a test
@@ -72,9 +82,9 @@ class _Fault:
 
     def __init__(self, kind: str, site: str, arg: float = 0.0,
                  count: int = 1, after: int = 0):
-        if kind not in ("exc", "host_loss", "sleep"):
+        if kind not in ("exc", "host_loss", "sleep", "nan"):
             raise ValueError(f"unknown fault kind {kind!r} "
-                             f"(expected exc|host_loss|sleep)")
+                             f"(expected exc|host_loss|sleep|nan)")
         self.kind = kind
         self.site = site
         self.arg = float(arg)
@@ -151,13 +161,17 @@ class FaultInjector:
 
     # ------------------------------------------------------------- firing
 
-    def fire(self, site: str, **ctx) -> None:
+    def fire(self, site: str, **ctx) -> Optional[str]:
         """Trip any fault armed at ``site`` (no-op when none is).  ``ctx``
-        is logged for attribution (step, tag, ...)."""
+        is logged for attribution (step, tag, ...).  Returns the kind that
+        fired for the NON-raising kinds (``"sleep"`` after the delay,
+        ``"nan"`` immediately — the site reads the return value and poisons
+        its own state) and None when nothing fired; ``exc`` raises and
+        ``host_loss`` never returns."""
         with self._lock:
             pending = self._faults.get(site)
             if not pending:
-                return
+                return None
             fault = None
             for f in pending:
                 if f.remaining <= 0:
@@ -168,7 +182,7 @@ class FaultInjector:
                 fault = f
                 break
             if fault is None:
-                return
+                return None
             fault.remaining -= 1
             fault.fired += 1
             self._fired_log[site] = self._fired_log.get(site, 0) + 1
@@ -177,7 +191,9 @@ class FaultInjector:
         logger.warning(f"fault injection: {fault.kind} at {site}{extra}")
         if fault.kind == "sleep":
             time.sleep(fault.arg)
-            return
+            return "sleep"
+        if fault.kind == "nan":
+            return "nan"
         if fault.kind == "host_loss":
             # the preemption/SIGKILL model: the process vanishes NOW —
             # no finally blocks, no atexit checkpoint fences, no cleanup
@@ -222,8 +238,8 @@ def inject(site: str, kind: str, arg: float = 0.0, count: int = 1,
     injector.inject(site, kind, arg, count, after)
 
 
-def fire(site: str, **ctx) -> None:
-    injector.fire(site, **ctx)
+def fire(site: str, **ctx) -> Optional[str]:
+    return injector.fire(site, **ctx)
 
 
 def clear() -> None:

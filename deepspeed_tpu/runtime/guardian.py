@@ -1,0 +1,644 @@
+"""Self-healing training — the guardian control loop.
+
+The numerics health layer (PR 2) can *see* a NaN burst, a loss spike, or a
+collapsing loss scale; the restore machinery (PR 6) can *undo* damage —
+but until now a human had to connect the two at 3am.  This module closes
+the loop: anomaly signals become automatic remediation, under a bounded
+retry budget that escalates to a postmortem dump + graceful drain when
+rollbacks stop helping.
+
+Control loop (one iteration per training step)::
+
+        ┌────────────────────────────────────────────────────────┐
+        │  batch ← cursor ──▶ engine.train_batch  (watchdog armed)│
+        └───────────────┬────────────────────────────────────────┘
+                        ▼
+                 assess health signals
+          (nonfinite loss, grad NaN/Inf counts,
+           loss-spike z, grad-norm explosion,
+           loss-scale collapse, overflow streak)
+            │ clean                         │ anomaly
+            ▼                               ▼
+      ring export at cadence;        ROLLBACK to the last
+      stamp exports whose            health-verified ring entry
+      trailing window proved         (checkpoint/ring.py), SKIP the
+      clean (rollback-eligible)      replayed data window (seed-stable
+                                     cursor advance), clamp LR/loss
+                                     scale on repeated retries
+                                            │ budget exhausted
+                                            ▼
+                                     ESCALATE: postmortem bundle +
+                                     graceful drain (EXIT_DRAINED)
+
+Trust chain: the guardian only ever rolls back to a **rollback-eligible**
+ring entry — one whose trailing ``clean_window`` steps showed no anomaly —
+so a checkpoint that silently captured poisoned moments is never a
+rollback target.  The data skip is **deterministic**: the cursor's
+post-rollback stream is a pure function of (batch_fn, skip set), so a
+guardian-healed run reaches bit-identical state to a run that never saw
+the fault but trained on the same effective batch sequence (pinned by the
+chaos e2e in tests/test_chaos.py).
+
+The **hang watchdog** is the remediation path for the failure the loop
+cannot observe from inside: a step that never completes (hung collective,
+straggler deadlock).  A monitor thread deadlines each step against an
+EMA-adaptive budget (gated on warm-up — the first step legitimately
+contains the XLA compile); on a trip it dumps a flight-recorder bundle
+with ALL-thread stacks, bumps ``hangs_total``, requests a drain through
+the preemption handler (if the step comes back within ``grace_s`` the loop
+drains gracefully), and otherwise hard-exits ``EXIT_DRAINED`` — a wedged
+process must never outlive its evidence.
+
+Metric families (docs/observability.md): ``rollbacks_total{reason}``,
+``rollback_recovery_ms``, ``hangs_total``, ``guardian_escalations_total``,
+``checkpoint_ring_size{eligible}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+from deepspeed_tpu.runtime.resilience import EXIT_DRAINED
+from deepspeed_tpu.utils.logging import logger
+
+ROLLBACKS = "rollbacks_total"
+HANGS = "hangs_total"
+ESCALATIONS = "guardian_escalations_total"
+RECOVERY_MS = "rollback_recovery_ms"
+
+
+class GuardianEscalation(RuntimeError):
+    """The retry budget is exhausted (or no eligible rollback source
+    exists): the guardian dumped a postmortem and drained.  ``run()``
+    catches this internally and reports ``status="escalated"``; it only
+    reaches callers driving remediation by hand."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"guardian escalation ({reason}): {detail}")
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class GuardianReport:
+    """What ``Guardian.run`` did: terminal status plus the counters a
+    caller (bench chaos leg, tests, a training script deciding its exit
+    code) needs without reading the metric registry."""
+
+    status: str = "completed"        # completed | drained | escalated
+    steps: int = 0                   # engine.global_steps at exit
+    rollbacks: int = 0
+    hangs: int = 0
+    escalations: int = 0
+    skipped_sources: List[int] = dataclasses.field(default_factory=list)
+    rollback_recovery_ms: List[float] = dataclasses.field(
+        default_factory=list)
+    final_loss: Optional[float] = None
+    exit_code: int = 0               # EXIT_DRAINED for drained/escalated
+
+
+def format_all_stacks() -> str:
+    """Every live thread's stack, watchdog-style — the flight-recorder
+    artifact that turns "it hung" into "it hung HERE"."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = []
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(tid, '?')} (tid={tid}) ---")
+        lines.extend(l.rstrip("\n")
+                     for l in traceback.format_stack(frame))
+        lines.append("")
+    return "\n".join(lines)
+
+
+class HangWatchdog:
+    """Step-deadline monitor thread.  ``arm(step)`` before dispatch,
+    ``disarm()`` after completion (feeds the EMA); the monitor trips when
+    an armed step outlives its deadline:
+
+    1. dump a postmortem bundle (``dump_fn``) carrying all-thread stacks,
+    2. bump ``hangs_total`` and call ``on_trip(step)`` (the guardian
+       requests a drain through the preemption handler there),
+    3. wait ``grace_s``; if the SAME step is still armed, ``exit_fn``
+       (default ``os._exit(EXIT_DRAINED)``) — a process wedged in a
+       collective cannot run its own drain, and the bundle is already on
+       disk.
+
+    Deadline: ``max(min_deadline_s, deadline_factor x EMA(step time))``,
+    and ``warmup_deadline_s`` until the first step completes (the cold
+    step legitimately contains the XLA compile — never book it a hang).
+    """
+
+    def __init__(self, config, *, registry=None,
+                 dump_fn: Optional[Callable[[str], Optional[str]]] = None,
+                 on_trip: Optional[Callable[[int], None]] = None,
+                 exit_fn: Optional[Callable[[int], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = config
+        self.registry = registry
+        self.dump_fn = dump_fn
+        self.on_trip = on_trip
+        self.exit_fn = exit_fn if exit_fn is not None else os._exit
+        self.clock = clock
+        self.ema_step_s: Optional[float] = None
+        # the first completed step after (re)warm-up is the compile-
+        # dominated one — never a representative step-time sample
+        self._skip_next_sample = True
+        self.trips = 0
+        self.last_bundle: Optional[str] = None
+        self._lock = threading.Lock()
+        self._armed: Optional[tuple] = None      # (step, t_armed)
+        self._tripped_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if bool(config.enabled):
+            self._thread = threading.Thread(
+                target=self._monitor, name="ds-guardian-watchdog",
+                daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------- arming
+
+    def arm(self, step: int) -> None:
+        with self._lock:
+            self._armed = (int(step), self.clock())
+
+    def disarm(self) -> None:
+        with self._lock:
+            armed, self._armed = self._armed, None
+            # a completed step retires the one-trip-per-step guard: step
+            # NUMBERS recur after a rollback, and a recurring number that
+            # wedges again must still trip
+            self._tripped_step = None
+        if armed is None:
+            return
+        if self._skip_next_sample:
+            # seeding the EMA from the compile step would inflate every
+            # deadline by deadline_factor x compile time for many steps;
+            # the NEXT step still runs under warmup_deadline_s, and the
+            # EMA seeds from the first steady step
+            self._skip_next_sample = False
+            return
+        dur = self.clock() - armed[1]
+        a = float(self.cfg.ema_alpha)
+        self.ema_step_s = (dur if self.ema_step_s is None
+                           else (1 - a) * self.ema_step_s + a * dur)
+
+    def deadline_s(self) -> float:
+        """The budget the CURRENTLY armed step runs under."""
+        if self.ema_step_s is None:
+            return float(self.cfg.warmup_deadline_s)
+        return max(float(self.cfg.min_deadline_s),
+                   float(self.cfg.deadline_factor) * self.ema_step_s)
+
+    def rewarm(self) -> None:
+        """Drop back to the warm-up deadline: the next step legitimately
+        contains an XLA compile (an LR clamp re-jits the step programs),
+        and a steady-state EMA deadline would book the recompile a hang
+        and hard-exit the run mid-remediation."""
+        self.ema_step_s = None
+        self._skip_next_sample = True
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------ monitor
+
+    def _monitor(self) -> None:
+        poll = float(self.cfg.poll_interval_s)
+        while not self._stop.wait(poll):
+            with self._lock:
+                armed = self._armed
+            if armed is None:
+                continue
+            step, t0 = armed
+            if self._tripped_step == step:
+                continue                       # one trip per wedged step
+            ddl = self.deadline_s()
+            if self.clock() - t0 <= ddl:
+                continue
+            self._tripped_step = step
+            self._trip(step, ddl)
+
+    def _trip(self, step: int, ddl: float) -> None:
+        self.trips += 1
+        logger.warning(
+            f"guardian watchdog: step {step} exceeded its deadline "
+            f"({ddl:.2f}s, ema={self.ema_step_s}); dumping stacks and "
+            f"initiating drain")
+        if self.registry is not None:
+            self.registry.counter(
+                HANGS, "training-step hang detections by the guardian "
+                "watchdog (step outlived its EMA-adaptive deadline)").inc(1)
+        if self.dump_fn is not None:
+            try:
+                self.last_bundle = self.dump_fn(
+                    f"step {step} hung past {ddl:.2f}s deadline")
+            except Exception as e:  # noqa: BLE001 — evidence is best-effort
+                logger.warning(f"guardian watchdog: hang dump failed: {e!r}")
+        if self.on_trip is not None:
+            try:
+                self.on_trip(step)
+            except Exception:  # noqa: BLE001 — drain request must not crash
+                pass
+        # grace: the step may come back (a straggler, not a deadlock) —
+        # then the training loop sees the drain request and exits cleanly
+        t_grace = self.clock()
+        while self.clock() - t_grace < float(self.cfg.grace_s):
+            with self._lock:
+                armed = self._armed
+            if armed is None or armed[0] != step:
+                logger.warning("guardian watchdog: step came back within "
+                               "grace; drain proceeds on the step loop")
+                return
+            if self._stop.wait(float(self.cfg.poll_interval_s)):
+                return
+        logger.warning(
+            f"guardian watchdog: step {step} still wedged after "
+            f"{self.cfg.grace_s}s grace — exiting EXIT_DRAINED "
+            f"(postmortem: {self.last_bundle})")
+        self.exit_fn(EXIT_DRAINED)
+
+
+class Guardian:
+    """The closed control loop (module docstring has the diagram).
+
+    ``batch_fn(source_index)`` must be pure/seed-stable — it is the
+    determinism anchor for the skip remediation; alternatively pass a
+    prepared :class:`~deepspeed_tpu.runtime.prefetch.DataCursor`.
+    ``handler`` (a ``PreemptionHandler``) folds external preemption into
+    the same drain path the watchdog uses.  Requires
+    ``telemetry.health.enabled`` — the anomaly signals are the health
+    monitor's.
+    """
+
+    def __init__(self, engine, run_dir: str, *, batch_fn=None, cursor=None,
+                 handler=None, config=None, watchdog_exit_fn=None):
+        from deepspeed_tpu.checkpoint.ring import CheckpointRing
+        from deepspeed_tpu.runtime.prefetch import DataCursor
+        if not engine._health_enabled:
+            raise ValueError(
+                "the guardian needs telemetry.health.enabled: true — its "
+                "anomaly signals (NaN/Inf counts, loss-spike z, overflow "
+                "streaks) are the health monitor's outputs")
+        if (cursor is None) == (batch_fn is None):
+            raise ValueError("pass exactly one of batch_fn / cursor")
+        self.engine = engine
+        self.run_dir = run_dir
+        self.cfg = config if config is not None else engine.config.guardian
+        if not bool(self.cfg.enabled):
+            raise ValueError(
+                "guardian.enabled is false: the self-healing control loop "
+                "was requested but its config block is disabled — set "
+                "guardian.enabled: true (or pass an explicit config=)")
+        self.handler = handler
+        self.cursor = cursor if cursor is not None else DataCursor(batch_fn)
+        # engine-step → cursor-position mapping: engine step s consumed
+        # cursor position s + _pos_offset.  The two count from different
+        # origins whenever the engine was resumed (global_steps > 0 with a
+        # fresh cursor) or the cursor arrived pre-consumed; conflating them
+        # would rewind to the wrong data window.  Ring entries whose
+        # position lands below 0 predate this cursor's history (a previous
+        # process under the same run_dir) and are never rollback targets —
+        # their skip window cannot be replayed deterministically.
+        self._pos_offset = self.cursor.consumed - engine.global_steps
+        self._closed = False
+        # set by a watchdog trip: the run loop drains on its next
+        # iteration even when no PreemptionHandler is wired
+        self._hang_drain = False
+        reg = engine.telemetry.registry
+        self.ring = CheckpointRing(run_dir, keep=int(self.cfg.ring_keep),
+                                   registry=reg)
+        self.report = GuardianReport()
+        self._rollback_on = set(self.cfg.rollback_on)
+        # pending eligibility stamps: ring exports whose trailing window is
+        # still accumulating clean steps
+        self._pending_stamps: List[tuple] = []      # (step, path)
+        # retry budget: rollbacks since the last NET step progress
+        self._retries = 0
+        self._progress_high_water = engine.global_steps
+        self._iter = None
+        self._c_rollbacks = reg.counter(
+            ROLLBACKS, "guardian rollbacks to a health-verified ring "
+            "checkpoint, by triggering anomaly reason")
+        self._c_escalations = reg.counter(
+            ESCALATIONS, "guardian escalations (postmortem + drain) after "
+            "the rollback budget stopped helping, by reason")
+        self._h_recovery = reg.histogram(
+            RECOVERY_MS, "anomaly detection to training-ready after a "
+            "guardian rollback (restore + cursor rewind + pipeline "
+            "rebuild)")
+        # every postmortem bundle from here on carries all-thread stacks
+        # (the hang-triage artifact; cheap for every other reason too)
+        engine.telemetry.recorder.add_bundle_writer(
+            "stacks.txt", self._write_stacks)
+        self.watchdog = HangWatchdog(
+            self.cfg.watchdog, registry=reg,
+            dump_fn=lambda note: engine.telemetry.dump_postmortem(
+                reason="hang", note=note),
+            on_trip=self._on_hang, exit_fn=watchdog_exit_fn)
+
+    # --------------------------------------------------------------- misc
+
+    @staticmethod
+    def _write_stacks(bundle_dir: str) -> None:
+        with open(os.path.join(bundle_dir, "stacks.txt"), "w") as f:
+            f.write(format_all_stacks())
+
+    def _on_hang(self, step: int) -> None:
+        self.report.hangs += 1
+        self._hang_drain = True
+        if self.handler is not None:
+            self.handler.request(reason="hang")
+
+    def close(self) -> None:
+        self._closed = True
+        self.watchdog.close()
+        if self._iter is not None and hasattr(self._iter, "close"):
+            self._iter.close()
+        # un-consume the staged-but-untrained prefetch lookahead so the
+        # cursor's consumed count matches what the engine actually
+        # trained: the staged tail re-enters in order for whoever drives
+        # the cursor next, and a later guardian segment over the same
+        # cursor computes a CONSISTENT step↔position offset (otherwise a
+        # rollback to a prior-segment ring entry would skip the wrong
+        # window and silently drop the staged sources)
+        trained = self.engine.global_steps + self._pos_offset
+        if 0 <= trained < len(self.cursor.history):
+            self.cursor.rewind(trained, skip_to=trained)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ---------------------------------------------------------- data feed
+
+    def _rebuild_iter(self):
+        """(Re)build the input pipeline over the cursor: prefetched when
+        the engine's data_pipeline block asks for it, plain otherwise."""
+        if self._iter is not None and hasattr(self._iter, "close"):
+            self._iter.close()               # sync-ok: joins the worker —
+            #                                  a rewind under a live
+            #                                  prefetcher would race it
+        depth = int(self.engine.config.data_pipeline.prefetch_depth)
+        if depth > 0:
+            self._iter = self.engine.prefetch_loader(self.cursor,
+                                                     depth=depth)
+        else:
+            self._iter = self.cursor
+
+    # ------------------------------------------------------------ run loop
+
+    def run(self, num_steps: int) -> GuardianReport:
+        """Drive training to ``num_steps`` engine steps under the control
+        loop.  Returns the :class:`GuardianReport`; ``status`` is
+        ``"completed"``, ``"drained"`` (preemption notice or watchdog
+        trip → graceful drain, ``exit_code == EXIT_DRAINED``), or
+        ``"escalated"`` (budget exhausted → postmortem + drain).
+
+        Single-shot: ``run`` tears down the hang watchdog on exit, so a
+        second call would train with no hang protection — it raises
+        instead; build a fresh ``engine.guardian(...)`` per segment."""
+        if self._closed:
+            raise RuntimeError(
+                "this Guardian is closed (run() tears down the hang "
+                "watchdog on exit): a second run() would train with no "
+                "hang protection — construct a fresh engine.guardian(...) "
+                "per training segment")
+        engine = self.engine
+        self._rebuild_iter()
+        try:
+            # ring entries at/after our start step belong to a previous
+            # process under a reused run_dir — this engine's state at the
+            # same step number is NOT theirs, so they must never be
+            # adopted by the run-entry export or become rollback targets
+            self.ring.discard_after(engine.global_steps - 1)
+            # run-entry ring entry: the loop must never be without a
+            # rollback source once its window proves clean.  Exported on
+            # resumed runs too — pre-resume ring entries are not
+            # replayable (the cursor's history starts here).
+            self._export_ring_entry()
+            while engine.global_steps < int(num_steps):
+                if self._hang_drain:
+                    # a watchdog trip whose step came back within grace:
+                    # the drain proceeds here even with no handler wired
+                    self._drain("hang")
+                    return self.report
+                if self.handler is not None and self.handler.requested:
+                    self._drain(self.handler.reason or "preemption")
+                    return self.report
+                step_id = engine.global_steps + 1
+                # the armed window covers the batch fetch, train_batch AND
+                # the health assessment: a wedged input pipeline blocks in
+                # next(), and (with telemetry off) train_batch returns
+                # right after the async dispatch — the device-side sync a
+                # hung collective actually wedges is _assess's metrics
+                # fetch.  Disarming around any of them would leave that
+                # hang un-deadlined.
+                self.watchdog.arm(step_id)
+                try:
+                    try:
+                        batch = next(self._iter)
+                    except StopIteration:
+                        break
+                    metrics = engine.train_batch(batch)
+                    reasons = self._assess()
+                finally:
+                    self.watchdog.disarm()
+                if reasons:
+                    try:
+                        self._remediate(reasons)
+                    except GuardianEscalation:
+                        return self.report
+                else:
+                    self._after_clean_step()
+                    self.report.final_loss = self._host_loss()
+            # a trip on the FINAL step (or right before the source dried
+            # up) exits the loop without another top-of-body check: a
+            # dumped hang bundle must never be reported as a clean
+            # completion, and a latched handler must drain here, not
+            # poison the next drain-aware component
+            if self._hang_drain:
+                self._drain("hang")
+                return self.report
+            if self.handler is not None and self.handler.requested:
+                self._drain(self.handler.reason or "preemption")
+                return self.report
+            self.report.status = "completed"
+            self.report.steps = engine.global_steps
+            return self.report
+        finally:
+            self.close()
+
+    def _host_loss(self) -> Optional[float]:
+        host = self.engine._last_metrics_host
+        return None if host is None else float(host.loss)
+
+    # ---------------------------------------------------------- assessment
+
+    def _assess(self) -> List[str]:
+        """Fold the health layer's per-step outputs into the remediation
+        verdict: the (ordered) anomaly reasons that are rollback-worthy
+        under ``guardian.rollback_on``."""
+        engine = self.engine
+        tel = engine.telemetry
+        host = engine._host_metrics()
+        reasons: List[str] = []
+        if host is not None and not math.isfinite(host.loss):
+            reasons.append("nonfinite_loss")
+        health = engine._last_health_host or {}
+        if any(rec.get("grad_nan", 0) or rec.get("grad_inf", 0)
+               for rec in health.values()):
+            reasons.append("grad_nan")
+        streak_cfg = int(tel.health_cfg.overflow_streak)
+        if streak_cfg > 0 and tel.overflow_streak >= streak_cfg:
+            reasons.append("overflow_streak")
+        reasons.extend(r for r in tel.last_anomalies if r not in reasons)
+        return [r for r in reasons if r in self._rollback_on]
+
+    # ------------------------------------------------- clean-step plumbing
+
+    def _after_clean_step(self) -> None:
+        engine = self.engine
+        step = engine.global_steps
+        if step > self._progress_high_water:
+            # NET progress: the run moved past everything it had reached
+            # before — the incident (if any) is over, the budget refills
+            self._progress_high_water = step
+            self._retries = 0
+        # stamp ring entries whose trailing window just completed clean
+        window = int(self.cfg.clean_window)
+        matured = [(s, p) for s, p in self._pending_stamps
+                   if step - s >= window]
+        self._pending_stamps = [(s, p) for s, p in self._pending_stamps
+                                if step - s < window]
+        for s, p in matured:
+            try:
+                self.ring.stamp(p, step=s, stamped_at_step=step,
+                                clean_window=window)
+                logger.info(f"guardian: ring entry step {s} verified "
+                            f"clean over {window} trailing step(s) — "
+                            f"rollback-eligible")
+            except (OSError, ValueError) as e:
+                logger.warning(f"guardian: stamping {p} failed: {e!r}")
+        if step % int(self.cfg.checkpoint_interval) == 0:
+            self._export_ring_entry()
+
+    def _export_ring_entry(self) -> None:
+        engine = self.engine
+        path = self.ring.export(engine)
+        self._pending_stamps.append((engine.global_steps, path))
+
+    # ----------------------------------------------------------- rollback
+
+    def _remediate(self, reasons: List[str]) -> None:
+        """One remediation round for an anomalous step: rollback to the
+        last health-verified ring entry, skip the replayed data window,
+        clamp on repeated retries — or escalate."""
+        engine = self.engine
+        reason = reasons[0]
+        failed_step = engine.global_steps
+        t0 = time.perf_counter()
+        # an anomaly taints every trailing window still accumulating: those
+        # exports must never earn their stamp
+        self._pending_stamps = []
+        self._retries += 1
+        if self._retries > int(self.cfg.max_rollbacks):
+            self._escalate(reason,
+                           f"{self._retries - 1} rollback(s) without net "
+                           f"progress past step {self._progress_high_water}")
+        entry = self.ring.latest_eligible(max_step=failed_step - 1)
+        if entry is None:
+            self._escalate("no_eligible_checkpoint",
+                           f"anomaly '{reason}' at step {failed_step} with "
+                           f"no health-verified rollback source in the "
+                           f"ring")
+        if entry.step + self._pos_offset < 0:
+            # eligible, but from before this cursor's history (a previous
+            # process under the same run_dir): its data window cannot be
+            # replayed deterministically, and every older entry is worse
+            self._escalate("no_eligible_checkpoint",
+                           f"anomaly '{reason}' at step {failed_step}: the "
+                           f"newest health-verified ring entry (step "
+                           f"{entry.step}) predates this cursor's history "
+                           f"— its data window is not replayable")
+        self.report.rollbacks += 1
+        logger.warning(
+            f"guardian: anomaly {reasons} at step {failed_step} — rolling "
+            f"back to verified step {entry.step} "
+            f"(retry {self._retries}/{self.cfg.max_rollbacks})")
+        # quiesce the input pipeline BEFORE touching the cursor
+        if self._iter is not None and hasattr(self._iter, "close"):
+            self._iter.close()               # sync-ok: rollback fence
+        # the PR 6 restore path: fences the host-step worker and any async
+        # checkpoint write, installs fragments, rewinds global_steps, and
+        # resyncs the numerics baseline
+        engine.load_universal_checkpoint(entry.path)  # sync-ok: rollback
+        # ring entries newer than the target belong to the abandoned
+        # timeline: the replayed run skips a data window, so a later
+        # re-export at the same step number must never reuse them
+        self.ring.discard_after(entry.step)
+        pos = entry.step + self._pos_offset
+        if bool(self.cfg.skip_data_window):
+            skipped = self.cursor.rewind(
+                pos, skip_to=failed_step + self._pos_offset)
+            self.report.skipped_sources.extend(skipped)
+            logger.warning(f"guardian: skipping data window "
+                           f"{skipped} (source indices; seed-stable)")
+        else:
+            self.cursor.rewind(pos, skip_to=pos)
+        if self._retries > int(self.cfg.clamp_after_rollbacks):
+            engine.clamp_loss_scale(float(self.cfg.loss_scale_clamp_factor))
+            try:
+                engine.clamp_lr(float(self.cfg.lr_clamp_factor))
+                # the clamp re-jit means the next step contains a compile:
+                # back to the warm-up deadline or the watchdog would book
+                # the recompile a hang and kill the run it is healing
+                self.watchdog.rewarm()
+            except ValueError as e:          # client optimizer: observe-only
+                logger.warning(f"guardian: LR clamp unavailable: {e}")
+        self._rebuild_iter()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._c_rollbacks.inc(1, reason=reason)
+        self._h_recovery.observe(dt_ms)
+        self.report.rollback_recovery_ms.append(dt_ms)
+        logger.warning(f"guardian: rollback complete in {dt_ms:.0f} ms — "
+                       f"resuming from step {engine.global_steps}")
+
+    # ---------------------------------------------------------- escalation
+
+    def _escalate(self, reason: str, detail: str) -> None:
+        engine = self.engine
+        self.report.escalations += 1
+        self._c_escalations.inc(1, reason=reason)
+        logger.error(f"guardian: ESCALATING ({reason}): {detail}")
+        engine.telemetry.dump_postmortem(reason="guardian_escalation",
+                                         note=f"{reason}: {detail}")
+        try:
+            engine.drain(self.run_dir, reason="guardian")  # sync-ok: drain
+        except Exception as e:  # noqa: BLE001 — the postmortem already
+            #                     landed; a failed final export must not
+            #                     mask the escalation itself
+            logger.error(f"guardian: drain during escalation failed: {e!r}")
+        self.report.status = "escalated"
+        self.report.steps = engine.global_steps
+        self.report.exit_code = EXIT_DRAINED
+        raise GuardianEscalation(reason, detail)
+
+    def _drain(self, reason: str) -> None:
+        engine = self.engine
+        logger.warning(f"guardian: drain requested ({reason})")
+        engine.drain(self.run_dir, reason=reason)        # sync-ok: drain
+        self.report.status = "drained"
+        self.report.steps = engine.global_steps
+        self.report.exit_code = EXIT_DRAINED

@@ -63,6 +63,76 @@ class PreparedBatch(NamedTuple):
 _STOP = object()          # end-of-source sentinel (also carries exceptions)
 
 
+class DataCursor:
+    """Seed-stable source-step cursor with deterministic skip windows — the
+    data-side half of the guardian's rollback remediation
+    (runtime/guardian.py).
+
+    ``batch_fn(source_index)`` must be a PURE function of the index (seeded
+    rng keyed on the index, an indexed dataset, ...), so the stream a
+    cursor yields is fully determined by its skip set: a replayed or
+    resumed run that installs the same skips sees bit-identical batches.
+    The cursor keeps a ``history`` of yielded source indices (position k =
+    the batch engine step k+1 consumed), which is what lets
+    :meth:`rewind` translate "roll back to step t, never replay the window
+    that poisoned us" into exact source indices.
+
+    NOT thread-safe against concurrent rewinds: the guardian closes the
+    prefetch worker (joining it) before rewinding, then rebuilds the
+    prefetcher over the same cursor.
+    """
+
+    def __init__(self, batch_fn: Callable[[int], Any], start: int = 0):
+        self.batch_fn = batch_fn
+        self.skipped: set = set()       # source indices never yielded again
+        self.history: list = []         # source index per consumed position
+        self._next = int(start)
+
+    @property
+    def consumed(self) -> int:
+        return len(self.history)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while self._next in self.skipped:
+            self._next += 1
+        i = self._next
+        self._next += 1
+        self.history.append(i)
+        return self.batch_fn(i)
+
+    def rewind(self, to_consumed: int, skip_to: Optional[int] = None) -> list:
+        """Rewind so the next yield is for consumed-position
+        ``to_consumed``, marking positions ``[to_consumed, skip_to)`` as a
+        skip window (their source indices are never yielded again — the
+        offending data window).  Positions at/after ``skip_to`` (e.g.
+        batches a prefetch worker staged past the failure but the engine
+        never trained on) re-enter in their original order.  Returns the
+        skipped source indices.  Deterministic: the post-rewind stream is a
+        pure function of (batch_fn, skip set)."""
+        if not 0 <= to_consumed <= len(self.history):
+            raise ValueError(
+                f"rewind to consumed-position {to_consumed} outside the "
+                f"cursor history (0..{len(self.history)})")
+        skip_to = len(self.history) if skip_to is None else int(skip_to)
+        if not to_consumed <= skip_to <= len(self.history):
+            raise ValueError(
+                f"skip_to={skip_to} outside [{to_consumed}, "
+                f"{len(self.history)}]")
+        window = self.history[to_consumed:skip_to]
+        self.skipped.update(window)
+        tail = self.history[skip_to:]    # staged-but-untrained lookahead
+        self.history = self.history[:to_consumed]
+        if tail:
+            self._next = tail[0]
+        elif window:
+            self._next = window[0]       # __next__'s skip loop walks past it
+        # else: nothing consumed past to_consumed — _next already correct
+        return window
+
+
 class _InlinePrefetch:
     """``prefetch_depth=0`` degenerate form: the same iterator surface with
     no worker thread — each ``__next__`` prepares synchronously.  Keeps

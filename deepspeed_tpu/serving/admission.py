@@ -36,23 +36,56 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Tuple
 
+from pydantic import model_validator
+
 from deepspeed_tpu.config import DeepSpeedConfigModel
 from deepspeed_tpu.runtime import faults
 
 
 class AdmissionConfig(DeepSpeedConfigModel):
     """``admission`` block of the fleet config.  The ``*_queue_depth``
-    band is in requests; the ``*_kv_failures_per_tick`` band is the DELTA
-    of the fleet-wide ``kv_alloc_failures_total`` sum between control
-    ticks (a rate, robust to the counter's monotonic growth)."""
+    band is in requests; the ``*_kv_failures_per_s`` band is the RATE of
+    the fleet-wide ``kv_alloc_failures_total`` sum — the counter delta
+    normalized by elapsed wall time, measured over spans of at least
+    ``rate_window_s``.  The tick period is load-variable (the dispatcher
+    tick stretches under exactly the conditions admission exists for), so
+    a raw per-tick delta would make the effective threshold drift with
+    load (PR 8 finding); per-second is load-invariant, and the minimum
+    window keeps back-to-back event-driven ticks from reading one
+    isolated failure as an instantaneous thousands/s burst.  The legacy ``*_kv_failures_per_tick`` spellings are
+    rejected with a rename hint instead of being silently swallowed by the
+    extra="allow" base config."""
 
     enabled: bool = True
     high_queue_depth: int = 64
     low_queue_depth: int = 16
-    high_kv_failures_per_tick: float = 32.0
-    low_kv_failures_per_tick: float = 1.0
+    high_kv_failures_per_s: float = 128.0
+    low_kv_failures_per_s: float = 4.0
+    # minimum wall-time span a kv-failure rate is measured over: the
+    # dispatcher tick is EVENT-driven (back-to-back ticks can be <1 ms
+    # apart), so an instantaneous delta/dt estimate would let one isolated
+    # failure between two such ticks read as thousands/s and trip
+    # fleet-wide shedding; ticks inside the window reuse the last
+    # full-window rate
+    rate_window_s: float = 0.25
     retry_after_s: float = 0.25
     max_rejections: int = 0          # 0 = unbounded client retries
+
+    @model_validator(mode="after")
+    def _reject_legacy_per_tick(self):
+        if self.rate_window_s <= 0:
+            raise ValueError(
+                f"admission.rate_window_s must be > 0, got "
+                f"{self.rate_window_s}")
+        extras = getattr(self, "__pydantic_extra__", None) or {}
+        legacy = [k for k in extras if k.endswith("_kv_failures_per_tick")]
+        if legacy:
+            raise ValueError(
+                f"admission config keys {legacy} were renamed: the "
+                f"threshold is now normalized by elapsed time — use "
+                f"high_kv_failures_per_s / low_kv_failures_per_s "
+                f"(failures per SECOND, not per load-variable tick)")
+        return self
 
 
 class AdmissionController:
@@ -67,17 +100,22 @@ class AdmissionController:
                 f"admission hysteresis band inverted: low_queue_depth="
                 f"{cfg.low_queue_depth} > high_queue_depth="
                 f"{cfg.high_queue_depth}")
-        if cfg.low_kv_failures_per_tick > cfg.high_kv_failures_per_tick:
+        if cfg.low_kv_failures_per_s > cfg.high_kv_failures_per_s:
             raise ValueError(
                 f"admission hysteresis band inverted: "
-                f"low_kv_failures_per_tick={cfg.low_kv_failures_per_tick} "
-                f"> high_kv_failures_per_tick="
-                f"{cfg.high_kv_failures_per_tick}")
+                f"low_kv_failures_per_s={cfg.low_kv_failures_per_s} "
+                f"> high_kv_failures_per_s="
+                f"{cfg.high_kv_failures_per_s}")
         self.config = cfg
         self.clock = clock
         self.registry = registry
         self.shedding = False
-        self._last_kv_total: Optional[float] = None
+        # kv-failure rate measured over >= rate_window_s spans (see
+        # AdmissionConfig.rate_window_s); ticks inside an open window
+        # reuse the last full-window rate
+        self._rate = 0.0
+        self._win_start_t: Optional[float] = None
+        self._win_start_total: Optional[float] = None
         self.c_rejections = registry.counter(
             "admission_rejections_total", "requests shed (429-style, with "
             "retry-after) by the fleet admission controller before "
@@ -102,23 +140,36 @@ class AdmissionController:
         """One control tick: fold the current signals through the
         hysteresis band and return the (possibly new) shedding state.
         ``kv_failures_total`` is injectable for tests; by default it is
-        read from the shared registry."""
+        read from the shared registry.  The kv signal is the counter delta
+        NORMALIZED by wall time (failures/s): the dispatcher tick
+        stretches under load, and an un-normalized per-tick delta would
+        raise the effective trip threshold exactly when shedding matters
+        most.  The rate is measured over at least ``rate_window_s`` of
+        wall time (not tick-to-tick): ticks are event-driven and can land
+        back-to-back, where an instantaneous delta/dt would let a single
+        failure read as thousands/s."""
         cfg = self.config
         if not cfg.enabled:
             return False
         total = (self.kv_failures_total() if kv_failures_total is None
                  else float(kv_failures_total))
-        if self._last_kv_total is None:
-            self._last_kv_total = total
-        delta = total - self._last_kv_total
-        self._last_kv_total = total
+        now = self.clock()
+        if self._win_start_t is None:
+            self._win_start_t = now
+            self._win_start_total = total
+        elapsed = now - self._win_start_t
+        if elapsed >= float(cfg.rate_window_s):
+            self._rate = max(0.0, total - self._win_start_total) / elapsed
+            self._win_start_t = now
+            self._win_start_total = total
+        rate = self._rate
         if not self.shedding:
             if (queue_depth > cfg.high_queue_depth
-                    or delta >= cfg.high_kv_failures_per_tick):
+                    or rate >= cfg.high_kv_failures_per_s):
                 self.shedding = True
         else:
             if (queue_depth <= cfg.low_queue_depth
-                    and delta <= cfg.low_kv_failures_per_tick):
+                    and rate <= cfg.low_kv_failures_per_s):
                 self.shedding = False
         self.g_shedding.set(1.0 if self.shedding else 0.0)
         return self.shedding

@@ -89,14 +89,22 @@ class FleetDrained(RuntimeError):
 class FleetConfig(DeepSpeedConfigModel):
     """Top-level fleet config.  ``heartbeat_deadline_s`` only applies to
     BUSY replicas (an idle worker beats from its wait loop without the
-    chaos site).  ``max_respawns`` bounds death-respawns per replica;
-    drain-respawns are planned events and bypass it
-    (``respawn_after_drain``).  ``share_compile_cache`` hands every
-    replica one jitted-step dict, so the fleet compiles each program
+    chaos site) that have completed WARM-UP — until an incarnation's first
+    ``generate`` completes, the (more generous) ``warmup_deadline_s``
+    governs instead: a replica's first call legitimately stalls on the
+    on-the-fly XLA compile, and a steady-state deadline would book a cold
+    replica dead (the PR 8 review finding; bench_serving used to paper
+    over it with a 120 s override).  ``max_respawns`` bounds
+    death-respawns per replica; drain-respawns are planned events and
+    bypass it (``respawn_after_drain``).  ``share_compile_cache`` hands
+    every replica one jitted-step dict, so the fleet compiles each program
     once and a respawned replica fast-resumes warm."""
 
     num_replicas: int = 2
     heartbeat_deadline_s: float = 10.0
+    # deadline for a not-yet-warm incarnation's first busy period (covers
+    # the first-call compile); never below heartbeat_deadline_s
+    warmup_deadline_s: float = 180.0
     respawn: bool = True
     max_respawns: int = 2
     respawn_after_drain: bool = True
@@ -137,6 +145,10 @@ class Replica:
         self.cond = threading.Condition()
         self.busy = False
         self.last_beat = fleet.clock()
+        # warm-up gate: False until this incarnation completes a generate
+        # (its first call contains the on-the-fly compile) — the supervisor
+        # deadlines it on warmup_deadline_s, not heartbeat_deadline_s
+        self.warmed = False
         self.worker: Optional[threading.Thread] = None
 
     def beat(self) -> None:
@@ -211,7 +223,7 @@ class ServingFleet:
         self.c_deaths = self.registry.counter(
             "fleet_replica_deaths_total", "replica deaths booked by the "
             "supervisor, per reason (replica_death / heartbeat_timeout / "
-            "drain)")
+            "drain / respawn_failed)")
         self.c_respawns = self.registry.counter(
             "fleet_respawns_total", "replica respawns (fresh engine against "
             "the warm shared compile cache) after a death or drain")
@@ -249,9 +261,30 @@ class ServingFleet:
             self.g_state.set(1.0 if s == state else 0.0,
                              replica=rep.name, state=s)
 
-    def _spawn(self, rep: Replica, *, is_respawn: bool) -> None:
+    def _spawn(self, rep: Replica, *, is_respawn: bool) -> bool:
         self._set_state(rep, "spawning")
-        engine = self._engine_factory(rep.name)
+        try:
+            if is_respawn:
+                # chaos site: an exc here models the factory itself failing
+                # (OOM building the engine, a torn shared cache, ...)
+                faults.fire("fleet.respawn_factory", replica=rep.name)
+            engine = self._engine_factory(rep.name)
+        except Exception as e:  # noqa: BLE001 — a respawn-factory failure
+            if not is_respawn:
+                raise          # construction-time errors surface to the user
+            # books THIS replica dead and keeps the dispatcher alive: one
+            # replica that cannot be rebuilt must degrade the fleet to
+            # N-1, never unwind the whole control plane (PR 8 finding)
+            logger.error(f"fleet: respawn factory for {rep.name} failed "
+                         f"({e!r}); booking the replica dead")
+            with rep.cond:
+                rep.incarnation += 1     # no worker runs this incarnation
+                rep.busy = False
+                rep.queue.clear()
+            rep.engine = None
+            self._set_state(rep, "dead")
+            self.c_deaths.inc(1, reason="respawn_failed")
+            return False
         if hasattr(engine, "clear_drain"):
             engine.clear_drain()
         rep.engine = engine
@@ -259,6 +292,17 @@ class ServingFleet:
             rep.incarnation += 1
             inc = rep.incarnation
             rep.busy = False
+            # a respawn against an already-populated shared compile cache
+            # performs no first-call compile: it runs under the
+            # steady-state deadline immediately — the warm-up budget
+            # would let a wedged respawn (and its queued requests) sit
+            # undetected for warmup_deadline_s with no compile to excuse.
+            # The cache maps engine fingerprint → compiled-program dict,
+            # and engines eagerly create their (empty) sub-dict at
+            # construction: only a sub-dict with actual programs counts.
+            rep.warmed = bool(
+                is_respawn and self._steps_cache
+                and any(self._steps_cache.values()))
             rep.queue.clear()
 
         def _beat(rep=rep, inc=inc):
@@ -277,6 +321,7 @@ class ServingFleet:
         self._set_state(rep, "healthy")
         if is_respawn:
             self.c_respawns.inc(1)
+        return True
 
     # ------------------------------------------------------ replica worker
     def _worker(self, rep: Replica, engine, incarnation: int) -> None:
@@ -308,6 +353,8 @@ class ServingFleet:
                 with rep.cond:
                     if rep.incarnation == incarnation:
                         rep.busy = False
+                        rep.warmed = True    # first generate done: the
+                        #                      compile is behind us
             except EngineDrained:
                 self._events.put(("drained", rep.name, incarnation,
                                   batch[0].gen,
@@ -576,16 +623,22 @@ class ServingFleet:
 
     # ---------------------------------------------------------- supervision
     def _check_health(self, now: float) -> None:
-        ddl = self.config.heartbeat_deadline_s
-        if ddl <= 0:
+        base = self.config.heartbeat_deadline_s
+        if base <= 0:
             return
+        # a not-yet-warm incarnation's first call contains the on-the-fly
+        # compile: deadline it on the warm-up budget, never the steady-state
+        # one (a cold replica must not be booked dead — PR 8 finding)
+        warmup = max(base, self.config.warmup_deadline_s)
         for rep in list(self.replicas.values()):
+            ddl = base if rep.warmed else warmup
             if rep.state in ("healthy", "draining") and rep.busy \
                     and now - rep.last_beat > ddl:
                 logger.warning(
-                    f"fleet: replica {rep.name} missed its heartbeat "
-                    f"deadline ({now - rep.last_beat:.2f}s > {ddl}s); "
-                    f"declaring dead and migrating its requests")
+                    f"fleet: replica {rep.name} missed its "
+                    f"{'steady-state' if rep.warmed else 'warm-up'} "
+                    f"heartbeat deadline ({now - rep.last_beat:.2f}s > "
+                    f"{ddl}s); declaring dead and migrating its requests")
                 self._retire_replica(rep, "heartbeat_timeout")
 
     def _retire_replica(self, rep: Replica, reason: str) -> None:
@@ -618,8 +671,7 @@ class ServingFleet:
                 and rep.respawns < self.config.max_respawns \
                 and not self._fleet_draining
             rep.respawns += 1 if allowed else 0
-        if allowed:
-            self._spawn(rep, is_respawn=True)
+        if allowed and self._spawn(rep, is_respawn=True):
             self.h_recovery.observe((self.clock() - t_detect) * 1e3)
 
     # ------------------------------------------------------------- control

@@ -90,6 +90,11 @@ class StepTelemetry:
         self._config = config
         self._prev_skipped: Optional[int] = 0
         self._overflow_streak = 0
+        # hook-out for the guardian control loop (runtime/guardian.py):
+        # the anomaly rules that fired on the LAST health_step, and the
+        # dump-trigger reason (None when nothing tripped)
+        self.last_anomalies: list = []
+        self.last_dump_reason: Optional[str] = None
         if self.health_enabled:
             from deepspeed_tpu.telemetry.flight_recorder import (
                 FlightRecorder, install_crash_handler)
@@ -326,6 +331,8 @@ class StepTelemetry:
               and self._overflow_streak
               >= int(self.health_cfg.overflow_streak)):
             reason = "overflow_streak"
+        self.last_anomalies = list(fired)
+        self.last_dump_reason = reason
         rec = {
             "step": int(step),
             "unix_time": time.time(),
@@ -372,6 +379,12 @@ class StepTelemetry:
             return self.recorder.dump(reason, note=f"step {step}")
         return None
 
+    @property
+    def overflow_streak(self) -> int:
+        """Consecutive overflow-skipped steps so far — the guardian reads
+        this alongside ``last_anomalies`` after each step."""
+        return self._overflow_streak
+
     def reset_numerics_baseline(self) -> None:
         """Called after a checkpoint restore: the cumulative skipped_steps
         counter may have jumped in either direction, so the overflow-streak
@@ -379,6 +392,8 @@ class StepTelemetry:
         of counting the jump as an overflow (or missing a real one)."""
         self._prev_skipped = None
         self._overflow_streak = 0
+        self.last_anomalies = []
+        self.last_dump_reason = None
 
     def dump_postmortem(self, reason: str = "manual",
                         note: Optional[str] = None) -> Optional[str]:

@@ -44,6 +44,16 @@ Scan targets (each file gets the pattern matching its hazard class):
   behind one device, the worst possible place to serialize.  Replica
   worker bodies (``_worker`` and friends) are the sanctioned blocking
   site (each blocks only its own replica) and are not scanned.
+- ``deepspeed_tpu/runtime/guardian.py`` control loop + watchdog
+  (``run``/assessment/remediation/escalation + the monitor thread) —
+  the ROLLBACK path's fences (prefetcher join, ``load_universal_
+  checkpoint``, ``engine.drain``) are the point of a remediation and are
+  sanctioned, but each must be a disclosed ``# sync-ok`` site: an
+  undisclosed fence creeping into the per-step half of the loop
+  (``_assess``/``_after_clean_step``) would serialize EVERY step on the
+  remediation machinery that exists for the rare bad one.  (Ring exports
+  go through ``CheckpointRing.export`` → the crash-safe universal export,
+  which is synchronous by design at its checkpoint cadence.)
 
 Allowed on any line: ``device_get`` in engine.py (an explicit, visible
 host fetch — the sanctioned way to cross the boundary there) and a
@@ -78,6 +88,8 @@ RESILIENCE_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
                                "resilience.py")
 ROUTER_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "router.py")
 FLEET_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "fleet.py")
+GUARDIAN_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
+                             "guardian.py")
 
 # the v2 serving hot loop: scheduler + every dispatch helper.  Nested defs
 # (materialize/_append inside generate) are the sanctioned bulk-fetch
@@ -128,6 +140,23 @@ FLEET_FUNCS = {
     "drain_all",
 }
 
+# the guardian control loop: the per-step half (run/_assess/
+# _after_clean_step) plus the remediation half whose fences must all be
+# disclosed; the watchdog monitor thread rides along (its deliberate
+# blocking is the stop-event wait, anything device-touching must disclose)
+GUARDIAN_FUNCS = {
+    "run",
+    "_assess",
+    "_after_clean_step",
+    "_export_ring_entry",
+    "_remediate",
+    "_escalate",
+    "_drain",
+    "_rebuild_iter",
+    "_monitor",
+    "_trip",
+}
+
 # the engine's per-step hot path: batch in → dispatch → reporting
 STEP_PATH_FUNCS = {
     "train_batch",
@@ -157,6 +186,12 @@ TRANSFER_PATTERN = re.compile(r"device_get|block_until_ready")
 RESILIENCE_PATTERN = re.compile(
     r"wait_for_checkpoint|_join_host_step|wait_until_finished"
     r"|device_get|block_until_ready|\.compile\(")
+# guardian: the rollback/escalation fences (prefetcher join, restore,
+# drain) plus the generic transfer class
+GUARDIAN_PATTERN = re.compile(
+    r"load_universal_checkpoint|engine\.drain\(|wait_for_checkpoint"
+    r"|_join_host_step|device_get|block_until_ready|\.compile\("
+    r"|_iter\.close\(|time\.sleep")
 # engine.py: device_get is itself the sanctioned idiom; everywhere a
 # '# sync-ok' comment discloses a reviewed, intentional sync
 ENGINE_ALLOW = re.compile(r"device_get|#\s*sync-ok")
@@ -172,6 +207,7 @@ SCAN_TARGETS = [
      RESILIENCE_PATTERN, ALLOW_PATTERN),
     (ROUTER_PATH, ROUTER_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (FLEET_PATH, FLEET_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
+    (GUARDIAN_PATH, GUARDIAN_FUNCS, GUARDIAN_PATTERN, ALLOW_PATTERN),
 ]
 
 

@@ -1,0 +1,86 @@
+"""Guardian hang-watchdog worker for the chaos suite.
+
+Runs a short guardian-supervised training loop with the REAL hard-exit
+path live (no injected exit_fn): the test arms
+``DSTPU_FAULTS=sleep@step.dispatch:<long>+<after>`` in this process's
+environment, the watchdog trips on the wedged step, dumps the postmortem
+bundle (all-thread stacks included), and — because the step never comes
+back within grace — the monitor thread ``os._exit``s ``EXIT_DRAINED``.
+The parent test asserts the exit code, the bundle contents, and that the
+exit landed within deadline + grace (NOT after the full sleep): a wedged
+process must never outlive its evidence.
+
+Marker files under DSTPU_RUN_DIR: ``armed_at.txt`` is written right
+before the step that will hang dispatches, so the parent can bound
+(exit time - armed time) by deadline + grace + slack precisely.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models import GPT, GPTConfig  # noqa: E402
+
+VOCAB, SEQ = 64, 16
+HANG_AT = int(os.environ.get("DSTPU_HANG_AT", "8"))   # engine step that hangs
+
+
+def main():
+    run_dir = os.environ["DSTPU_RUN_DIR"]
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        # no prefetch: the armed_at marker must stamp the hanging step's
+        # dispatch, not a lookahead prepare
+        "data_pipeline": {"prefetch_depth": 0},
+        "telemetry": {"enabled": False,
+                      "health": {"enabled": True,
+                                 "dump_path": os.path.join(run_dir, "pm")}},
+        "guardian": {
+            "enabled": True,
+            "checkpoint_interval": 3,
+            "clean_window": 1,
+            "watchdog": {"deadline_factor": 2.0, "min_deadline_s": 0.3,
+                         "warmup_deadline_s": 300.0, "grace_s": 0.5,
+                         "poll_interval_s": 0.02},
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ)),
+        config=config,
+        example_batch={"input_ids": np.zeros((1, SEQ), np.int32)})
+
+    batch = int(engine.train_batch_size)
+
+    def marked_batch_fn(i):
+        # the batch for engine step i+1 is requested right before its
+        # dispatch: stamp the wall clock so the parent can bound the
+        # watchdog's reaction time
+        if i + 1 == HANG_AT:
+            with open(os.path.join(run_dir, "armed_at.txt"), "w") as f:
+                f.write(repr(time.time()))
+        rng = np.random.default_rng(1000 + i)
+        return {"input_ids": rng.integers(0, VOCAB,
+                                          size=(batch, SEQ)).astype(np.int32)}
+
+    g = engine.guardian(run_dir, batch_fn=marked_batch_fn)
+    report = g.run(HANG_AT + 4)
+    # only reachable if the hang never happened / resolved: surface it
+    print(f"guardian report: {report.status} steps={report.steps}",
+          flush=True)
+    return 0 if report.status == "completed" else report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

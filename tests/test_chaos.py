@@ -25,6 +25,8 @@ from deepspeed_tpu.checkpoint import (CheckpointCorrupt, CheckpointNotFound,
 from deepspeed_tpu.checkpoint.universal import load_universal
 from deepspeed_tpu.models import GPT, GPTConfig
 from deepspeed_tpu.runtime import faults
+from deepspeed_tpu.runtime.resilience import \
+    EXIT_DRAINED as resilience_EXIT_DRAINED
 
 VOCAB, SEQ = 64, 16
 
@@ -378,3 +380,272 @@ class TestFastResume:
             with open(bad, "w") as f:
                 json.dump({"format": "other"}, f)
             load_fingerprints(bad)
+
+
+# ---------------------------------------------------------------------------
+# nan@ fault kind + guardian self-healing (runtime/guardian.py)
+# ---------------------------------------------------------------------------
+
+def _guardian_build(tmp, **guardian_over):
+    """fp32 engine (exact universal roundtrip — the bitwise legs compare
+    restored fp32 params, no low-precision cast in the way) with health
+    monitoring on and a fast guardian ring cadence."""
+    g = {"enabled": True, "checkpoint_interval": 2, "ring_keep": 4,
+         "clean_window": 1, "max_rollbacks": 2,
+         # watchdog stays armed but far out of the way (no false trips on
+         # a loaded CI box); the hang legs configure it tight explicitly
+         "watchdog": {"warmup_deadline_s": 600.0, "min_deadline_s": 120.0,
+                      "deadline_factor": 100.0}}
+    g.update(guardian_over)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        "data_pipeline": {"prefetch_depth": 2},
+        "telemetry": {"enabled": False,
+                      "health": {"enabled": True, "dump_path": str(tmp),
+                                 "overflow_streak": 3}},
+        "guardian": g,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ)),
+        config=cfg,
+        example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+    return engine
+
+
+def _guardian_batch_fn(i):
+    rng = np.random.default_rng(1000 + i)
+    return {"input_ids": rng.integers(0, VOCAB,
+                                      size=(16, SEQ)).astype(np.int32)}
+
+
+class TestNanFaultKind:
+    """Satellite: the ``nan`` fault kind — spec parsing, fired/armed
+    accounting, and the engine-site injection at ``step.grads``."""
+
+    def test_spec_parsing_and_signal_return(self):
+        inj = faults.FaultInjector()
+        inj.configure("nan@step.grads*2+1")
+        assert inj.armed("step.grads") == 2
+        assert inj.fire("step.grads") is None        # +1: first call passes
+        assert inj.fire("step.grads") == "nan"
+        assert inj.fire("step.grads") == "nan"
+        assert inj.fire("step.grads") is None        # disarmed
+        assert inj.fired("step.grads") == 2
+
+    def test_fire_return_values_by_kind(self):
+        inj = faults.FaultInjector()
+        assert inj.fire("unarmed") is None
+        inj.inject("s", "sleep", arg=0.0)
+        assert inj.fire("s") == "sleep"
+
+    def test_engine_site_injection(self, devices, tmp_path):
+        """nan@step.grads drives the step's loss and grads non-finite and
+        the corruption persists — only a rollback heals it."""
+        e = _guardian_build(tmp_path)
+        e.train_batch(_guardian_batch_fn(0))
+        assert np.isfinite(float(e._host_metrics().loss))
+        faults.inject("step.grads", "nan")
+        e.train_batch(_guardian_batch_fn(1))
+        assert faults.fired("step.grads") == 1
+        host = e._host_metrics()
+        assert not np.isfinite(host.loss)
+        health = e._last_health_host
+        assert any(rec.get("grad_nan", 0) + rec.get("grad_inf", 0) > 0
+                   for rec in health.values())
+        # fault disarmed, but the poison persists in the live state: the
+        # NEXT (fault-free) step is still non-finite
+        e.train_batch(_guardian_batch_fn(2))
+        assert not np.isfinite(float(e._host_metrics().loss))
+
+
+class TestGuardianSelfHealing:
+    """Tentpole e2e: poisoned step → rollback to the health-verified ring
+    entry → seed-stable skip → trajectory BITWISE equal to a run that
+    never saw the fault (same effective batch sequence)."""
+
+    def test_rollback_skip_bitwise_trajectory(self, devices, tmp_path):
+        run_dir = str(tmp_path / "run")
+        e = _guardian_build(tmp_path / "pm")
+        reg = e.telemetry.registry
+
+        def _val(name, **labels):
+            # the default registry is process-shared: assert DELTAS
+            m = reg._metrics.get(name)
+            return m.value(**labels) if m is not None else 0.0
+
+        rb0 = _val("rollbacks_total", reason="nonfinite_loss")
+        pm0 = _val("postmortem_dumps_total", reason="nonfinite_loss")
+        faults.inject("step.grads", "nan", after=5)   # poisons step 6
+        g = e.guardian(run_dir, batch_fn=_guardian_batch_fn)
+        report = g.run(10)
+        assert report.status == "completed"
+        assert report.steps == 10
+        assert report.rollbacks == 1
+        # ring exports at 0,2,4 were stamped (clean_window=1); the anomaly
+        # at step 6 rolled back to step 4 and skipped sources 4,5
+        assert report.skipped_sources == [4, 5]
+        assert g.cursor.history[:10] == [0, 1, 2, 3, 6, 7, 8, 9, 10, 11]
+        assert report.rollback_recovery_ms and \
+            report.rollback_recovery_ms[0] > 0
+        assert _val("rollbacks_total", reason="nonfinite_loss") == rb0 + 1
+        # the nonfinite step also dumped a postmortem (flight recorder)
+        assert _val("postmortem_dumps_total",
+                    reason="nonfinite_loss") == pm0 + 1
+
+        # clean reference: a fresh engine trained on the guardian run's
+        # EFFECTIVE source sequence, never seeing the fault
+        faults.reset()
+        e2 = _guardian_build(tmp_path / "pm2")
+        for i in g.cursor.history[:10]:
+            m = e2.train_batch(_guardian_batch_fn(i))
+        assert float(m.loss) == report.final_loss      # bitwise
+        p1 = jax.device_get(e.state.params)
+        p2 = jax.device_get(e2.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_double_fault_rolls_back_to_fresh_reexport(self, devices,
+                                                       tmp_path):
+        """Second incident after a heal: the rollback target is a ring
+        entry RE-exported at a step number the abandoned timeline had
+        also exported.  The stale (pre-skip) entry was discarded at the
+        first rollback, so the second restore is the fresh-timeline state
+        — pinned, as always, bitwise against a clean run on the effective
+        sequence."""
+        run_dir = str(tmp_path / "run")
+        e = _guardian_build(tmp_path / "pm", max_rollbacks=2,
+                            clamp_after_rollbacks=10)
+        # fire-call schedule (one call per train_batch, incl. replays; a
+        # call that fires one fault does NOT decrement a co-armed fault's
+        # +after): call 5 = timeline-1 step 5; call 10 = timeline-2 step 7
+        faults.inject("step.grads", "nan", after=4)
+        faults.inject("step.grads", "nan", after=8)
+        g = e.guardian(run_dir, batch_fn=_guardian_batch_fn)
+        report = g.run(10)
+        assert report.status == "completed"
+        assert report.rollbacks == 2
+        # incident 1: step 5 → rollback to 2 (ring_4's window was
+        # tainted), skip sources 2,3,4; incident 2: step 7 → rollback to
+        # the RE-exported, re-stamped step-4 entry, skip the replayed
+        # sources 7,8,9
+        assert report.skipped_sources == [2, 3, 4, 7, 8, 9]
+        assert g.cursor.history[:10] == [0, 1, 5, 6, 10, 11, 12, 13, 14, 15]
+
+        faults.reset()
+        e2 = _guardian_build(tmp_path / "pm2")
+        for i in g.cursor.history[:10]:
+            m = e2.train_batch(_guardian_batch_fn(i))
+        assert float(m.loss) == report.final_loss      # bitwise
+        p1 = jax.device_get(e.state.params)
+        p2 = jax.device_get(e2.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(p2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_repeated_poison_escalates_to_drain(self, devices, tmp_path):
+        """When rollbacks stop helping (every replay re-poisons), the
+        bounded budget escalates: postmortem bundle + graceful drain."""
+        run_dir = str(tmp_path / "run")
+        pm = tmp_path / "pm"
+        e = _guardian_build(pm, max_rollbacks=2,
+                            clamp_after_rollbacks=10)   # keep re-jits out
+        reg = e.telemetry.registry
+        m = reg._metrics.get("guardian_escalations_total")
+        esc0 = m.value(reason="nonfinite_loss") if m is not None else 0.0
+        faults.inject("step.grads", "nan", count=10, after=4)
+        g = e.guardian(run_dir, batch_fn=_guardian_batch_fn)
+        report = g.run(12)
+        assert report.status == "escalated"
+        assert report.exit_code == resilience_EXIT_DRAINED
+        assert report.rollbacks == 2                    # budget honored
+        assert reg._metrics["guardian_escalations_total"].value(
+            reason="nonfinite_loss") == esc0 + 1
+        # the escalation bundle landed, with all-thread stacks riding along
+        bundles = [d for d in os.listdir(str(pm))
+                   if "guardian_escalation" in d]
+        assert bundles
+        assert os.path.exists(os.path.join(str(pm), bundles[0],
+                                           "stacks.txt"))
+        # ...and the drain committed a final export for the postmortem loop
+        assert latest_universal(run_dir) is not None
+
+    def test_clamp_down_on_second_rollback(self, devices, tmp_path):
+        """From the (clamp_after_rollbacks+1)-th retry of one incident the
+        guardian clamps LR and loss scale down."""
+        run_dir = str(tmp_path / "run")
+        e = _guardian_build(tmp_path / "pm", max_rollbacks=3,
+                            clamp_after_rollbacks=1)
+        lr0 = e.get_lr()[0]
+        faults.inject("step.grads", "nan", count=2, after=4)
+        g = e.guardian(run_dir, batch_fn=_guardian_batch_fn)
+        report = g.run(10)
+        assert report.status == "completed"
+        assert report.rollbacks == 2
+        # first rollback: no clamp; second: LR halved (default factor)
+        assert e.get_lr()[0] == pytest.approx(lr0 * 0.5)
+
+    def test_no_eligible_checkpoint_escalates(self, devices, tmp_path):
+        """An anomaly before any ring entry earned its stamp has no
+        rollback source: immediate escalation, never a crash loop."""
+        run_dir = str(tmp_path / "run")
+        e = _guardian_build(tmp_path / "pm")
+        reg = e.telemetry.registry
+        m = reg._metrics.get("guardian_escalations_total")
+        esc0 = (m.value(reason="no_eligible_checkpoint")
+                if m is not None else 0.0)
+        faults.inject("step.grads", "nan")              # poison step 1
+        g = e.guardian(run_dir, batch_fn=_guardian_batch_fn)
+        report = g.run(6)
+        assert report.status == "escalated"
+        assert report.rollbacks == 0
+        assert reg._metrics["guardian_escalations_total"].value(
+            reason="no_eligible_checkpoint") == esc0 + 1
+
+
+class TestGuardianHang:
+    """Tentpole e2e: a hung step (sleep@step.dispatch beyond the adaptive
+    deadline) produces a postmortem bundle with all-thread stacks and a
+    clean EXIT_DRAINED — within deadline + grace, not after the sleep."""
+
+    def test_hang_dumps_bundle_and_exits_drained(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        script = os.path.join(os.path.dirname(__file__),
+                              "guardian_train_script.py")
+        run_dir = str(tmp_path)
+        env = dict(os.environ,
+                   DSTPU_RUN_DIR=run_dir,
+                   DSTPU_HANG_AT="8",
+                   # the wedged step sleeps 120 s — a process that waits it
+                   # out fails the wall-clock bound below
+                   DSTPU_FAULTS="sleep@step.dispatch:120+7",
+                   JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        t0 = time.time()
+        proc = subprocess.run([_sys.executable, script], env=env,
+                              capture_output=True, text=True, timeout=300)
+        wall = time.time() - t0
+        assert proc.returncode == resilience_EXIT_DRAINED, proc.stderr[-2000:]
+        # the watchdog reacted at deadline+grace, it did not sit out the
+        # sleep: bound (exit - the hanging step's dispatch stamp).  The
+        # deadline is ~2x the EMA step time (sub-second post-compile) and
+        # grace is 0.5 s; 30 s covers slow-CI noise with a 4x margin while
+        # still proving the 120 s sleep was not awaited.
+        with open(os.path.join(run_dir, "armed_at.txt")) as f:
+            armed_at = float(f.read())
+        assert (t0 + wall) - armed_at < 30.0
+        pm = os.path.join(run_dir, "pm")
+        bundles = [d for d in os.listdir(pm) if d.endswith("-hang")]
+        assert bundles, os.listdir(pm)
+        bundle = os.path.join(pm, bundles[0])
+        stacks = open(os.path.join(bundle, "stacks.txt")).read()
+        assert "ds-guardian-watchdog" in stacks    # all threads captured
+        assert "train_batch" in stacks             # incl. the wedged one
+        assert os.path.exists(os.path.join(bundle, "records.jsonl"))
+        # hangs_total reached the bundle's own metric snapshot
+        prom = open(os.path.join(bundle, "snapshot.prom")).read()
+        assert "hangs_total" in prom

@@ -1,0 +1,685 @@
+"""Guardian unit suite — the self-healing building blocks in isolation.
+
+The chaos-driven end-to-end legs (poisoned batch → rollback → skip →
+bitwise-clean trajectory; hang → bundle → EXIT_DRAINED) live in
+tests/test_chaos.py; this file pins the pieces: the seed-stable skip
+cursor, the guarded checkpoint ring's eligibility/prune semantics, the
+hang watchdog's deadline/trip/grace machine, the engine clamp-down hooks,
+and the config surface.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import universal_complete
+from deepspeed_tpu.checkpoint.ring import (ELIGIBLE_FILE, CheckpointRing,
+                                           is_eligible)
+from deepspeed_tpu.config import (GuardianConfig, GuardianWatchdogConfig,
+                                  parse_config)
+from deepspeed_tpu.models import GPT, GPTConfig
+from deepspeed_tpu.runtime import faults
+from deepspeed_tpu.runtime.guardian import (Guardian, HangWatchdog,
+                                            format_all_stacks)
+from deepspeed_tpu.runtime.prefetch import DataCursor
+from deepspeed_tpu.runtime.resilience import EXIT_DRAINED
+
+VOCAB, SEQ = 64, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _build(tmp, health=True, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": False,
+                      "health": {"enabled": health, "dump_path": str(tmp)}},
+        "guardian": {"enabled": True},
+    }
+    cfg.update(over)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(GPTConfig.tiny(vocab_size=VOCAB, max_seq_len=SEQ)),
+        config=cfg,
+        example_batch={"input_ids": np.zeros((2, SEQ), np.int32)})
+    return engine
+
+
+def _batch_fn(i):
+    rng = np.random.default_rng(1000 + i)
+    return {"input_ids": rng.integers(0, VOCAB,
+                                      size=(16, SEQ)).astype(np.int32)}
+
+
+@pytest.fixture(scope="module")
+def engine(devices, tmp_path_factory):
+    return _build(tmp_path_factory.mktemp("pm"))
+
+
+# ---------------------------------------------------------------------------
+# DataCursor: seed-stable skip semantics
+# ---------------------------------------------------------------------------
+
+class TestDataCursor:
+    def test_order_and_history(self):
+        c = DataCursor(lambda i: i * 10)
+        assert [next(c) for _ in range(4)] == [0, 10, 20, 30]
+        assert c.history == [0, 1, 2, 3]
+        assert c.consumed == 4
+
+    def test_rewind_skips_window_and_keeps_lookahead(self):
+        c = DataCursor(lambda i: i)
+        for _ in range(6):               # positions 0..5 (sources 0..5)
+            next(c)
+        # roll back to position 2; positions 2..3 are the offending window;
+        # positions 4..5 were prefetch lookahead and must re-enter in order
+        skipped = c.rewind(2, skip_to=4)
+        assert skipped == [2, 3]
+        assert c.skipped == {2, 3}
+        assert [next(c) for _ in range(4)] == [4, 5, 6, 7]
+        assert c.history == [0, 1, 4, 5, 6, 7]
+
+    def test_rewind_without_lookahead(self):
+        c = DataCursor(lambda i: i)
+        for _ in range(5):
+            next(c)
+        skipped = c.rewind(3)            # skip everything replayed
+        assert skipped == [3, 4]
+        assert next(c) == 5
+
+    def test_rewind_noop_window(self):
+        c = DataCursor(lambda i: i)
+        for _ in range(3):
+            next(c)
+        assert c.rewind(3) == []
+        assert next(c) == 3
+
+    def test_stream_is_pure_function_of_skips(self):
+        """Two cursors with the same skip set yield identical streams —
+        the determinism anchor of the skip remediation."""
+        a = DataCursor(lambda i: i * 7)
+        for _ in range(6):
+            next(a)
+        a.rewind(2, skip_to=5)
+        replay_a = [next(a) for _ in range(5)]
+        b = DataCursor(lambda i: i * 7)
+        b.skipped.update({2, 3, 4})
+        stream_b = [next(b) for _ in range(7)]
+        assert stream_b[2:] == replay_a
+        assert stream_b[:2] == [0, 7]
+
+    def test_rewind_bounds_checked(self):
+        c = DataCursor(lambda i: i)
+        next(c)
+        with pytest.raises(ValueError, match="outside the"):
+            c.rewind(5)
+        with pytest.raises(ValueError, match="skip_to"):
+            c.rewind(0, skip_to=9)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointRing: eligibility stamps + pruning
+# ---------------------------------------------------------------------------
+
+class TestCheckpointRing:
+    def test_export_stamp_latest_eligible(self, engine, tmp_path):
+        ring = CheckpointRing(str(tmp_path), keep=4)
+        p0 = ring.export(engine)
+        assert universal_complete(p0)
+        assert not is_eligible(p0)
+        assert ring.latest_eligible() is None
+        ring.stamp(p0, step=engine.global_steps,
+                   stamped_at_step=engine.global_steps + 2, clean_window=2)
+        assert is_eligible(p0)
+        entry = ring.latest_eligible()
+        assert entry.path == p0 and entry.eligible
+        with open(os.path.join(p0, ELIGIBLE_FILE)) as f:
+            stamp = json.load(f)
+        assert stamp["clean_window"] == 2
+
+    def test_stamp_refuses_incomplete(self, tmp_path):
+        ring = CheckpointRing(str(tmp_path))
+        torn = os.path.join(str(tmp_path), "ring_00000007")
+        os.makedirs(torn)
+        with pytest.raises(ValueError, match="COMPLETE"):
+            ring.stamp(torn, step=7, stamped_at_step=9, clean_window=2)
+
+    def test_torn_stamp_is_ineligible(self, engine, tmp_path):
+        ring = CheckpointRing(str(tmp_path))
+        p = ring.export(engine)
+        with open(os.path.join(p, ELIGIBLE_FILE), "w") as f:
+            f.write("{not json")            # torn stamp bytes
+        assert not is_eligible(p)
+        assert ring.latest_eligible() is None
+
+    def test_prune_keeps_newest_k_plus_newest_eligible(self, engine,
+                                                       tmp_path):
+        run_dir = str(tmp_path)
+        ring = CheckpointRing(run_dir, keep=2)
+        # first export earns its stamp; later (unstamped) exports push it
+        # far off the keep tail — prune must retain it anyway: the
+        # guardian must never be left without a rollback source
+        p0 = ring.export(engine)
+        ring.stamp(p0, step=engine.global_steps,
+                   stamped_at_step=engine.global_steps + 1, clean_window=1)
+        paths = [p0]
+        for _ in range(4):
+            engine.train_batch(_batch_fn(engine.global_steps))
+            paths.append(ring.export(engine))
+        left = ring.entries()
+        assert len(left) == 3              # newest 2 + the eligible one
+        assert p0 in [e.path for e in left]
+        assert ring.latest_eligible().path == p0
+        # pruned dirs are GONE (marked torn first, then removed)
+        kept = [e.path for e in left]
+        for p in paths:
+            if p not in kept:
+                assert not os.path.exists(p)
+
+    def test_discard_after_drops_abandoned_timeline(self, engine,
+                                                    tmp_path):
+        """Entries newer than a rollback target are a dead timeline: a
+        later re-export at the same step number must get a FRESH entry,
+        never silently reuse the stale one."""
+        ring = CheckpointRing(str(tmp_path), keep=5)
+        p1 = ring.export(engine)
+        s1 = engine.global_steps
+        engine.train_batch(_batch_fn(engine.global_steps))
+        p2 = ring.export(engine)
+        ring.stamp(p1, step=s1, stamped_at_step=s1 + 1, clean_window=1)
+        deleted = ring.discard_after(s1)
+        assert deleted == [p2]
+        assert not os.path.exists(p2)
+        assert [e.path for e in ring.entries()] == [p1]
+
+    def test_latest_eligible_max_step(self, engine, tmp_path):
+        ring = CheckpointRing(str(tmp_path), keep=5)
+        p1 = ring.export(engine)
+        s1 = engine.global_steps
+        engine.train_batch(_batch_fn(engine.global_steps))
+        p2 = ring.export(engine)
+        for p, s in ((p1, s1), (p2, engine.global_steps)):
+            ring.stamp(p, step=s, stamped_at_step=s + 1, clean_window=1)
+        assert ring.latest_eligible().path == p2
+        assert ring.latest_eligible(max_step=engine.global_steps - 1
+                                    ).path == p1
+
+    def test_reexport_clears_stale_stamp(self, engine, tmp_path):
+        """A dir left torn by a crash mid-prune/discard can still carry
+        rollback_eligible.json: a fresh commit at that step must not be
+        born eligible — eligibility is earned by the new export's own
+        trailing window."""
+        ring = CheckpointRing(str(tmp_path), keep=3)
+        p = ring.path_for(engine.global_steps)
+        os.makedirs(p)
+        with open(os.path.join(p, ELIGIBLE_FILE), "w") as f:
+            json.dump({"step": engine.global_steps, "stamped_at_step": 999,
+                       "clean_window": 1}, f)
+        out = ring.export(engine)
+        assert out == p and universal_complete(out)
+        assert not is_eligible(out)
+
+    def test_ring_size_gauge(self, engine, tmp_path):
+        from deepspeed_tpu.telemetry.registry import MetricRegistry
+        reg = MetricRegistry()
+        ring = CheckpointRing(str(tmp_path), keep=3, registry=reg)
+        p = ring.export(engine)
+        ring.stamp(p, step=engine.global_steps,
+                   stamped_at_step=engine.global_steps + 1, clean_window=1)
+        g = reg._metrics["checkpoint_ring_size"]
+        assert g.value(eligible="true") == 1.0
+        assert g.value(eligible="false") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# HangWatchdog: deadline machine, trip, grace
+# ---------------------------------------------------------------------------
+
+def _wd_cfg(**over):
+    base = dict(deadline_factor=2.0, min_deadline_s=0.05,
+                warmup_deadline_s=60.0, grace_s=0.15, ema_alpha=0.5,
+                poll_interval_s=0.01)
+    base.update(over)
+    return GuardianWatchdogConfig(**base)
+
+
+class TestHangWatchdog:
+    def test_warmup_deadline_gates_first_step(self):
+        wd = HangWatchdog(_wd_cfg(enabled=False))
+        assert wd.deadline_s() == 60.0     # no completed step yet: warm-up
+        wd.arm(1)
+        wd.disarm()
+        # the compile-dominated first step is never a step-time sample —
+        # seeding the EMA from it would inflate every deadline by
+        # deadline_factor x compile time
+        assert wd.ema_step_s is None
+        assert wd.deadline_s() == 60.0     # still the warm-up deadline
+        wd.arm(2)
+        wd.disarm()
+        assert wd.ema_step_s is not None   # seeded from a steady step
+        assert wd.deadline_s() >= 0.05     # EMA-adaptive now
+
+    def test_ema_update(self):
+        wd = HangWatchdog(_wd_cfg(enabled=False))
+        # the skipped compile-step disarm never reads the clock
+        clock = iter([0.0, 300.0, 300.5, 301.0, 301.25]).__next__
+        wd.clock = clock
+        wd.arm(1)
+        wd.disarm()                        # 300 s compile step: skipped
+        wd.arm(2)
+        wd.disarm()                        # 0.5 s: seeds the EMA
+        wd.arm(3)
+        wd.disarm()                        # 0.25 s
+        assert wd.ema_step_s == pytest.approx(0.375)  # alpha 0.5
+        assert wd.deadline_s() == pytest.approx(0.75)  # factor 2
+
+    def test_trip_dumps_and_hard_exits_after_grace(self, tmp_path):
+        dumps, trips, exits = [], [], []
+        wd = HangWatchdog(
+            _wd_cfg(warmup_deadline_s=0.08),
+            dump_fn=lambda note: dumps.append(note) or "bundle",
+            on_trip=trips.append, exit_fn=exits.append)
+        try:
+            wd.arm(3)                      # never disarmed: a wedged step
+            deadline = time.monotonic() + 5.0
+            while not exits and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            wd.close()
+        assert trips == [3]
+        assert dumps and "step 3" in dumps[0]
+        assert exits == [EXIT_DRAINED]
+        assert wd.last_bundle == "bundle"
+
+    def test_step_back_within_grace_avoids_exit(self):
+        exits, trips = [], []
+        wd = HangWatchdog(
+            _wd_cfg(warmup_deadline_s=0.08, grace_s=1.0),
+            on_trip=trips.append, exit_fn=exits.append)
+        try:
+            wd.arm(1)
+            deadline = time.monotonic() + 5.0
+            while not trips and time.monotonic() < deadline:
+                time.sleep(0.01)
+            wd.disarm()                    # the straggler came back
+            time.sleep(0.3)
+        finally:
+            wd.close()
+        assert trips == [1]
+        assert exits == []                 # grace honored: no hard exit
+
+    def test_one_trip_per_wedged_step(self):
+        trips = []
+        wd = HangWatchdog(
+            _wd_cfg(warmup_deadline_s=0.05, grace_s=0.05),
+            on_trip=trips.append, exit_fn=lambda code: None)
+        try:
+            wd.arm(7)
+            time.sleep(0.5)
+        finally:
+            wd.close()
+        assert trips == [7]
+
+    def test_recurring_step_number_can_trip_again(self):
+        """Step NUMBERS recur after a rollback: completing a step retires
+        the one-trip guard, so the same number wedging later still
+        trips."""
+        trips = []
+        wd = HangWatchdog(
+            _wd_cfg(warmup_deadline_s=0.06, min_deadline_s=0.06,
+                    deadline_factor=1.0, grace_s=0.3),
+            on_trip=trips.append, exit_fn=lambda code: None)
+        try:
+            wd.arm(5)
+            deadline = time.monotonic() + 5.0
+            while len(trips) < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            wd.disarm()                  # the step (eventually) completed
+            time.sleep(0.05)             # let the grace loop observe it
+            wd.arm(5)                    # same number, post-rollback
+            deadline = time.monotonic() + 5.0
+            while len(trips) < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            wd.close()
+        assert trips == [5, 5]
+
+    def test_rewarm_restores_warmup_deadline(self):
+        """An LR clamp re-jits the step programs: the next step contains
+        a compile and must run under the warm-up deadline again, not the
+        steady-state EMA deadline (which would book it a hang)."""
+        wd = HangWatchdog(_wd_cfg(enabled=False))
+        wd.arm(1)
+        wd.disarm()                        # compile step: skipped
+        wd.arm(2)
+        wd.disarm()
+        assert wd.deadline_s() < 60.0      # EMA-adaptive now
+        wd.rewarm()
+        assert wd.deadline_s() == 60.0     # back to warm-up
+        wd.arm(3)
+        wd.disarm()                        # the recompile step: skipped
+        assert wd.ema_step_s is None       # still under warm-up deadline
+
+    def test_format_all_stacks_sees_this_thread(self):
+        text = format_all_stacks()
+        assert "format_all_stacks" in text
+        assert "thread" in text
+
+
+# ---------------------------------------------------------------------------
+# engine clamp-down hooks
+# ---------------------------------------------------------------------------
+
+class TestClamp:
+    def test_clamp_lr_scales_effective_rate(self, devices, tmp_path):
+        e = _build(tmp_path)
+        lr0 = e.get_lr()[0]
+        scale = e.clamp_lr(0.5)
+        assert scale == pytest.approx(0.5)
+        assert e.get_lr()[0] == pytest.approx(lr0 * 0.5)
+        e.clamp_lr(0.5)
+        assert e.get_lr()[0] == pytest.approx(lr0 * 0.25)
+        # the rebuilt chain still trains (opt_state structure unchanged)
+        m = e.train_batch(_batch_fn(0))
+        assert np.isfinite(float(m.loss))
+
+    def test_clamp_lr_validates_factor(self, engine):
+        with pytest.raises(ValueError, match="factor"):
+            engine.clamp_lr(0.0)
+        with pytest.raises(ValueError, match="factor"):
+            engine.clamp_lr(1.5)
+
+    def test_clamp_loss_scale_noop_off_fp16(self, engine):
+        before = float(jax.device_get(engine.state.loss_scale.scale))
+        engine.clamp_loss_scale(0.5)       # fp32 run: frozen unit scale
+        assert float(jax.device_get(engine.state.loss_scale.scale)) == before
+
+    def test_clamp_loss_scale_halves_dynamic_fp16(self, devices, tmp_path):
+        e = _build(tmp_path, **{"fp16": {"enabled": True,
+                                         "initial_scale_power": 8}})
+        before = float(jax.device_get(e.state.loss_scale.scale))
+        e.clamp_loss_scale(0.5)
+        after = float(jax.device_get(e.state.loss_scale.scale))
+        assert after == pytest.approx(before * 0.5)
+
+
+# ---------------------------------------------------------------------------
+# config + construction surface
+# ---------------------------------------------------------------------------
+
+class TestGuardianSurface:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="ring_keep"):
+            parse_config({"guardian": {"ring_keep": 0}})
+        with pytest.raises(ValueError, match="rollback_on"):
+            parse_config({"guardian": {"rollback_on": ["nope"]}})
+        with pytest.raises(ValueError, match="ema_alpha"):
+            parse_config({"guardian": {"watchdog": {"ema_alpha": 2.0}}})
+        with pytest.raises(ValueError, match="lr_clamp_factor"):
+            parse_config({"guardian": {"lr_clamp_factor": 0.0}})
+        # a clean_window no export can survive to (pruned off the keep
+        # tail before its trailing window matures) would silently disable
+        # rollback: rejected at parse time
+        with pytest.raises(ValueError, match="clean_window"):
+            parse_config({"guardian": {"checkpoint_interval": 2,
+                                       "ring_keep": 3, "clean_window": 8}})
+
+    def test_guardian_requires_health(self, devices, tmp_path):
+        e = _build(tmp_path, health=False)
+        with pytest.raises(ValueError, match="telemetry.health"):
+            e.guardian(str(tmp_path), batch_fn=_batch_fn)
+
+    def test_guardian_requires_one_source(self, engine, tmp_path):
+        with pytest.raises(ValueError, match="exactly one"):
+            Guardian(engine, str(tmp_path))
+        with pytest.raises(ValueError, match="exactly one"):
+            Guardian(engine, str(tmp_path), batch_fn=_batch_fn,
+                     cursor=DataCursor(_batch_fn))
+
+    def test_guardian_honors_enabled_flag(self, engine, tmp_path):
+        """guardian.enabled must not be a dead knob: a disabled block
+        refuses to build the control loop instead of silently running
+        rollbacks/watchdog anyway."""
+        with pytest.raises(ValueError, match="guardian.enabled"):
+            Guardian(engine, str(tmp_path), batch_fn=_batch_fn,
+                     config=GuardianConfig())      # enabled defaults False
+
+
+def _relaxed_guardian(**over):
+    """Guardian cfg with the watchdog far out of the way (no false trips
+    on a loaded CI box) and a fast ring cadence."""
+    base = {"enabled": True, "checkpoint_interval": 2, "ring_keep": 4,
+            "clean_window": 1, "max_rollbacks": 2,
+            "watchdog": {"warmup_deadline_s": 600.0, "min_deadline_s": 120.0,
+                         "deadline_factor": 100.0}}
+    base.update(over)
+    return base
+
+
+class TestGuardianRunSemantics:
+    """Engine-step ↔ cursor-position mapping and run() lifecycle: ring
+    entry steps are ENGINE step numbers, cursor rewind positions count
+    CONSUMED batches — they only coincide for a fresh engine driving a
+    fresh cursor."""
+
+    def test_watchdog_armed_through_assessment(self, engine, tmp_path):
+        """The device-side sync a hung collective wedges is the health
+        assessment's metrics fetch, not train_batch (async dispatch): the
+        armed window must cover _assess or a real hang never deadlines."""
+        g = engine.guardian(str(tmp_path), batch_fn=_batch_fn,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        armed_during_assess = []
+        orig = g._assess
+
+        def probe():
+            armed_during_assess.append(g.watchdog._armed is not None)
+            return orig()
+
+        g._assess = probe
+        report = g.run(engine.global_steps + 2)
+        assert report.status == "completed"
+        assert armed_during_assess and all(armed_during_assess)
+
+    def test_run_is_single_shot(self, engine, tmp_path):
+        g = engine.guardian(str(tmp_path), batch_fn=_batch_fn,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        report = g.run(engine.global_steps + 1)
+        assert report.status == "completed"
+        # run() tore down the hang watchdog: a second segment would train
+        # with no hang protection — it must refuse, not silently comply
+        with pytest.raises(RuntimeError, match="closed"):
+            g.run(engine.global_steps + 1)
+
+    def test_rollback_on_resumed_engine_maps_steps_to_positions(
+            self, devices, tmp_path):
+        """An engine that trained before the guardian attached (resume,
+        warm-up, any pre-guardian phase) has global_steps ahead of the
+        cursor: the rollback target step and the skip window must be
+        translated to consumed positions, not used as positions raw."""
+        e = _build(tmp_path / "pm", guardian=_relaxed_guardian())
+        for i in range(100, 103):        # pre-guardian phase: steps 1..3
+            e.train_batch(_batch_fn(i))
+        assert e.global_steps == 3
+        faults.inject("step.grads", "nan", after=2)  # poisons engine step 6
+        g = e.guardian(str(tmp_path / "run"), batch_fn=_batch_fn)
+        report = g.run(8)
+        assert report.status == "completed"
+        assert report.steps == 8
+        assert report.rollbacks == 1
+        # rollback target: the verified ring entry at engine step 4 =
+        # cursor position 1; the skip window is the consumed SOURCES 1..2
+        # (steps 5..6), not raw step numbers 4..5
+        assert report.skipped_sources == [1, 2]
+        assert g.cursor.history[:5] == [0, 3, 4, 5, 6]
+        assert report.final_loss is not None
+        assert np.isfinite(report.final_loss)
+
+    def test_pre_resume_ring_entry_is_not_a_rollback_target(
+            self, devices, tmp_path):
+        """An eligible entry from a PREVIOUS process under the same
+        run_dir predates this cursor's history: its data window cannot be
+        replayed deterministically — the guardian must escalate, never
+        rewind to a bogus window."""
+        run_dir = str(tmp_path / "run")
+        e = _build(tmp_path / "pm", guardian=_relaxed_guardian())
+        ring = CheckpointRing(run_dir, keep=4)
+        p0 = ring.export(e)              # "previous process" entry, step 0
+        ring.stamp(p0, step=0, stamped_at_step=1, clean_window=1)
+        for i in range(2):               # this cursor never saw these
+            e.train_batch(_batch_fn(i))
+        faults.inject("step.grads", "nan")   # first guardian step poisons
+        g = e.guardian(run_dir, batch_fn=_batch_fn)
+        report = g.run(5)
+        assert report.status == "escalated"
+        assert report.rollbacks == 0
+        assert report.escalations == 1
+        assert report.exit_code == EXIT_DRAINED
+
+    def test_run_entry_discards_previous_process_entries(
+            self, engine, tmp_path):
+        """A reused run_dir can hold complete — even stamped — ring
+        entries from a crashed previous run at or past our start step:
+        they hold FOREIGN state and must be discarded at run entry, never
+        adopted by the run-entry export (which would make them instantly
+        rollback-eligible via the leftover stamp)."""
+        run_dir = str(tmp_path)
+        ring = CheckpointRing(run_dir, keep=4)
+        leftover = ring.export(engine)      # "dead run", same step number
+        ring.stamp(leftover, step=engine.global_steps,
+                   stamped_at_step=999, clean_window=1)
+        g = engine.guardian(run_dir, batch_fn=_batch_fn,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        report = g.run(engine.global_steps + 2)
+        assert report.status == "completed"
+        entry = g.ring.latest_eligible()
+        with open(os.path.join(entry.path, ELIGIBLE_FILE)) as f:
+            stamp = json.load(f)
+        assert stamp["stamped_at_step"] != 999   # fresh stamp, not adopted
+
+    def test_watchdog_armed_over_batch_fetch(self, engine, tmp_path):
+        """A wedged input pipeline blocks in next(): the armed window
+        must cover the batch fetch or an input stall never deadlines."""
+        g = engine.guardian(str(tmp_path), batch_fn=_batch_fn,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        armed = []
+        inner_rebuild = g._rebuild_iter
+
+        class _Probe:
+            def __init__(self, it):
+                self._it = it
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                armed.append(g.watchdog._armed is not None)
+                return next(self._it)
+
+            def close(self):
+                if hasattr(self._it, "close"):
+                    self._it.close()
+
+        def rebuild():
+            inner_rebuild()
+            g._iter = _Probe(g._iter)
+
+        g._rebuild_iter = rebuild
+        report = g.run(engine.global_steps + 2)
+        assert report.status == "completed"
+        assert armed and all(armed)
+
+    def test_close_unconsumes_staged_lookahead(self, engine, tmp_path):
+        """Teardown rewinds the staged-but-untrained prefetch lookahead
+        out of the cursor: consumed matches the trained steps, so a
+        second guardian segment over the SAME cursor computes the same
+        step↔position offset and no staged source is silently dropped."""
+        start = engine.global_steps
+        c = DataCursor(_batch_fn)
+        g = engine.guardian(str(tmp_path), cursor=c,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        report = g.run(start + 3)
+        assert report.status == "completed"
+        assert c.consumed == 3
+        assert c.history == [0, 1, 2]
+        g2 = engine.guardian(str(tmp_path), cursor=c,
+                             config=GuardianConfig(**_relaxed_guardian()))
+        assert g2._pos_offset == g._pos_offset
+        report2 = g2.run(start + 6)
+        assert report2.status == "completed"
+        assert c.history[:6] == [0, 1, 2, 3, 4, 5]   # nothing dropped
+
+    def test_hang_trip_without_handler_drains(self, engine, tmp_path):
+        """A watchdog trip whose step comes back within grace must drain
+        the run even when no PreemptionHandler is wired — never silently
+        keep training after a detected hang."""
+        g = engine.guardian(str(tmp_path), batch_fn=_batch_fn,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        g._on_hang(engine.global_steps + 1)  # trip; step later returned
+        report = g.run(engine.global_steps + 5)
+        assert report.status == "drained"
+        assert report.exit_code == EXIT_DRAINED
+        assert report.hangs == 1
+
+    def test_hang_trip_on_final_step_still_drains(self, engine, tmp_path):
+        """A trip whose step was the LAST one exits the loop without
+        another top-of-body check: the post-loop check must still drain
+        instead of reporting a clean completion over a dumped hang
+        bundle."""
+        g = engine.guardian(str(tmp_path), batch_fn=_batch_fn,
+                            config=GuardianConfig(**_relaxed_guardian()))
+        g._on_hang(engine.global_steps)
+        report = g.run(engine.global_steps)   # loop body never runs
+        assert report.status == "drained"
+        assert report.exit_code == EXIT_DRAINED
+
+
+# ---------------------------------------------------------------------------
+# check_no_sync guardian target (satellite)
+# ---------------------------------------------------------------------------
+
+class TestGuardianNoSyncLint:
+    def _load(self):
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "check_no_sync.py")
+        spec = importlib.util.spec_from_file_location("check_no_sync", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_guardian_is_a_scan_target_and_clean(self):
+        mod = self._load()
+        assert any(p.endswith(os.path.join("runtime", "guardian.py"))
+                   for p, _, _, _ in mod.SCAN_TARGETS)
+        assert mod.check_file(mod.GUARDIAN_PATH, mod.GUARDIAN_FUNCS,
+                              mod.GUARDIAN_PATTERN,
+                              mod.ALLOW_PATTERN) == []
+
+    def test_guardian_target_catches_undisclosed_fence(self, tmp_path):
+        """Stripping one sync-ok disclosure from the rollback path must
+        produce a violation — the target is live, not decorative."""
+        mod = self._load()
+        src = open(mod.GUARDIAN_PATH).read()
+        needle = ("engine.load_universal_checkpoint(entry.path)"
+                  "  # sync-ok: rollback")
+        assert needle in src
+        bad = src.replace(needle,
+                          "engine.load_universal_checkpoint(entry.path)")
+        p = tmp_path / "guardian_bad.py"
+        p.write_text(bad)
+        violations = mod.check_file(str(p), mod.GUARDIAN_FUNCS,
+                                    mod.GUARDIAN_PATTERN,
+                                    mod.ALLOW_PATTERN)
+        assert any("load_universal_checkpoint" in v for v in violations)

@@ -251,43 +251,103 @@ class TestRouterPolicies:
 # admission controller: hysteresis, rejection, chaos site
 # ---------------------------------------------------------------------------
 
+class _TickClock:
+    """Deterministic clock for the admission controller: the test advances
+    ``t`` explicitly, so kv-failure RATES (per second) are exact."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+        return self.t
+
+
 class TestAdmission:
     def _ctl(self, **kw):
         base = dict(high_queue_depth=10, low_queue_depth=3,
-                    high_kv_failures_per_tick=1e9,
-                    low_kv_failures_per_tick=0.0, retry_after_s=0.1)
+                    high_kv_failures_per_s=1e9,
+                    low_kv_failures_per_s=0.0, retry_after_s=0.1)
         base.update(kw)
+        clk = _TickClock()
         return AdmissionController(AdmissionConfig(**base),
                                    registry=MetricRegistry(),
-                                   clock=time.monotonic)
+                                   clock=clk), clk
 
     def test_hysteresis_band_does_not_flap(self):
-        ac = self._ctl()
+        ac, clk = self._ctl()
         assert ac.update(5) is False
+        clk.tick()
         assert ac.update(11) is True          # trips above high
         # hovering INSIDE the band keeps the current state — no flapping
         for depth in (9, 5, 8, 4, 10):
+            clk.tick()
             assert ac.update(depth) is True
+        clk.tick()
         assert ac.update(3) is False          # releases at/below low
         for depth in (5, 9, 10):              # inside band again: stays off
+            clk.tick()
             assert ac.update(depth) is False
 
     def test_kv_failure_rate_trips_shedding(self):
-        ac = self._ctl(high_kv_failures_per_tick=5.0,
-                       low_kv_failures_per_tick=1.0)
+        ac, clk = self._ctl(high_kv_failures_per_s=5.0,
+                            low_kv_failures_per_s=1.0)
+        # 1 s ticks: rate == delta
         assert ac.update(0, kv_failures_total=0.0) is False
-        assert ac.update(0, kv_failures_total=3.0) is False   # delta 3 < 5
-        assert ac.update(0, kv_failures_total=10.0) is True   # delta 7 >= 5
+        clk.tick()
+        assert ac.update(0, kv_failures_total=3.0) is False   # 3/s < 5
+        clk.tick()
+        assert ac.update(0, kv_failures_total=10.0) is True   # 7/s >= 5
         # queue is fine but the rate must drop below low to release
-        assert ac.update(0, kv_failures_total=14.0) is True   # delta 4
-        assert ac.update(0, kv_failures_total=14.5) is False  # delta .5
+        clk.tick()
+        assert ac.update(0, kv_failures_total=14.0) is True   # 4/s
+        clk.tick()
+        assert ac.update(0, kv_failures_total=14.5) is False  # 0.5/s
+
+    def test_kv_threshold_normalized_by_elapsed_time(self):
+        """The PR 8 finding: the same counter delta over a STRETCHED tick
+        (exactly what a loaded dispatcher produces) is a lower rate and
+        must NOT trip — and a short tick with the same delta must."""
+        ac, clk = self._ctl(high_kv_failures_per_s=5.0,
+                            low_kv_failures_per_s=1.0)
+        ac.update(0, kv_failures_total=0.0)
+        clk.tick(4.0)                         # slow tick: 12 over 4 s = 3/s
+        assert ac.update(0, kv_failures_total=12.0) is False
+        clk.tick(0.5)                         # fast tick: 12 over .5 s = 24/s
+        assert ac.update(0, kv_failures_total=24.0) is True
+
+    def test_subsecond_ticks_use_minimum_rate_window(self):
+        """Dispatcher ticks are EVENT-driven and can land back-to-back:
+        one isolated failure between two <1 ms ticks must not read as an
+        instantaneous thousands/s burst and trip fleet-wide shedding —
+        the rate is measured over at least ``rate_window_s``."""
+        ac, clk = self._ctl(high_kv_failures_per_s=5.0,
+                            low_kv_failures_per_s=1.0)
+        assert ac.update(0, kv_failures_total=0.0) is False
+        clk.tick(0.001)                      # back-to-back event tick
+        assert ac.update(0, kv_failures_total=1.0) is False  # not 1000/s
+        clk.tick(0.3)                        # window matures: ~3.3/s < 5
+        assert ac.update(0, kv_failures_total=1.0) is False
+        # a sustained burst still trips once its window matures
+        clk.tick(0.3)
+        assert ac.update(0, kv_failures_total=4.0) is True   # 10/s
+
+    def test_legacy_per_tick_keys_rejected(self):
+        with pytest.raises(ValueError, match="per_s"):
+            AdmissionConfig(high_kv_failures_per_tick=5.0)
+        with pytest.raises(ValueError, match="rate_window_s"):
+            AdmissionConfig(rate_window_s=0.0)
 
     def test_rejection_counts_and_retry_after(self):
-        ac = self._ctl()
+        ac, clk = self._ctl()
         req = FleetRequest(index=0, prompt=np.zeros(4, np.int32),
                            max_new_tokens=4)
         ok, ra = ac.decide(req)
         assert ok and ra == 0.0
+        clk.tick()
         ac.update(11)
         ok, ra = ac.decide(req)
         assert not ok and ra == pytest.approx(0.1)
@@ -300,7 +360,7 @@ class TestAdmission:
             self._ctl(low_queue_depth=20)
 
     def test_decide_fires_chaos_site(self):
-        ac = self._ctl()
+        ac, _ = self._ctl()
         req = FleetRequest(index=0, prompt=np.zeros(4, np.int32),
                            max_new_tokens=4)
         faults.inject("admission.decide", "exc")
@@ -507,6 +567,30 @@ class TestFleetServing:
             for o, want in zip(outs, reference):
                 np.testing.assert_array_equal(o, want)
 
+    def test_respawn_factory_exception_books_dead_not_unwind(
+            self, cfg, params, workload, reference):
+        """PR 8 review finding: a respawn-factory exception must book THE
+        replica dead and keep the dispatcher alive — a fleet that cannot
+        rebuild one replica degrades to N-1, it does not unwind the whole
+        control plane."""
+        prompts, budgets = workload
+        faults.inject("replica.mid_decode", "exc", after=3)
+        faults.inject("fleet.respawn_factory", "exc")
+        with make_fleet(cfg, params,
+                        {"num_replicas": 2, "respawn": True,
+                         "max_respawns": 2}) as fleet:
+            outs = fleet.serve(prompts, max_new_tokens=budgets,
+                               max_wall_s=300)
+            reg = fleet.registry._metrics
+            assert faults.fired("fleet.respawn_factory") == 1
+            assert reg["fleet_replica_deaths_total"].value(
+                reason="respawn_failed") == 1.0
+            states = sorted(r.state for r in fleet.replicas.values())
+            assert states == ["dead", "healthy"]
+            # no lost work, no unwind: the survivor finished everything
+            for o, want in zip(outs, reference):
+                np.testing.assert_array_equal(o, want)
+
     def test_drain_replica_migrates_and_respawns(self, cfg, params,
                                                  workload):
         prompts = workload[0] * 2
@@ -637,6 +721,101 @@ class TestFleetServing:
             assert all(r.state == "dead" for r in fleet.replicas.values())
         finally:
             fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat warm-up gate (PR 8 review finding)
+# ---------------------------------------------------------------------------
+
+class _ColdStartEngine:
+    """Fake engine whose FIRST generate stalls ``cold_s`` (modelling the
+    on-the-fly XLA compile — no heartbeats land during it) and whose later
+    generates stall ``warm_s``."""
+
+    def __init__(self, cold_s, warm_s=0.0):
+        self.cold_s = cold_s
+        self.warm_s = warm_s
+        self.calls = 0
+        self.heartbeat_fn = lambda: None
+
+    def clear_drain(self):
+        pass
+
+    def request_drain(self):
+        pass
+
+    def export_pending_requests(self):
+        return {}, []
+
+    def generate(self, prompts, max_new_tokens):
+        delay = self.cold_s if self.calls == 0 else self.warm_s
+        self.calls += 1
+        time.sleep(delay)
+        self.heartbeat_fn()
+        return [np.arange(int(m), dtype=np.int32) for m in max_new_tokens]
+
+
+class TestHeartbeatWarmupGate:
+    def _fleet(self, engine, **over):
+        cfg = dict(num_replicas=1, respawn=False,
+                   heartbeat_deadline_s=0.2, warmup_deadline_s=5.0,
+                   poll_interval_s=0.005)
+        cfg.update(over)
+        return ServingFleet(engine_factory=lambda name: engine, config=cfg,
+                            registry=MetricRegistry())
+
+    def test_cold_first_call_survives_steady_deadline(self):
+        """A first generate stalling WAY past heartbeat_deadline_s (the
+        compile) must complete under the warm-up budget — a cold replica
+        is never booked dead (the finding bench papered over with 120s)."""
+        eng = _ColdStartEngine(cold_s=0.6)
+        with self._fleet(eng) as fleet:
+            outs = fleet.serve([np.zeros(4, np.int32)], max_new_tokens=4,
+                               max_wall_s=60)
+            assert len(outs[0]) == 4
+            reg = fleet.registry._metrics
+            assert reg["fleet_replica_deaths_total"].value(
+                reason="heartbeat_timeout") == 0.0
+            assert fleet.replicas["r0"].warmed
+
+    def test_respawn_with_populated_shared_cache_is_warm(self):
+        """A respawned incarnation reusing an already-populated shared
+        compile cache performs no first-call compile: it must run under
+        the steady-state deadline immediately — the warm-up budget would
+        hide a wedged respawn (and its queued requests) for
+        warmup_deadline_s with no compile to excuse it."""
+        eng = _ColdStartEngine(cold_s=0.0)
+        with self._fleet(eng) as fleet:
+            rep = fleet.replicas["r0"]
+            # the cache maps fingerprint → program dict; engines create
+            # their sub-dict EAGERLY at construction, so an empty sub-dict
+            # means the first incarnation died before compiling anything —
+            # the replacement still pays the compile and must stay on the
+            # warm-up budget
+            fleet._steps_cache["fp"] = {}
+            fleet._spawn(rep, is_respawn=True)
+            assert not rep.warmed
+            fleet._steps_cache["fp"]["sig"] = object()   # compiled program
+            fleet._spawn(rep, is_respawn=True)
+            assert rep.warmed
+            fleet._steps_cache.clear()             # torn cache: assume cold
+            fleet._spawn(rep, is_respawn=True)
+            assert not rep.warmed
+
+    def test_warmed_replica_still_deadlined(self):
+        """The gate covers ONLY the cold call: once warm, the same stall
+        is a real hang and the steady-state deadline books it dead."""
+        eng = _ColdStartEngine(cold_s=0.0, warm_s=0.8)
+        with self._fleet(eng) as fleet:
+            fleet.serve([np.zeros(4, np.int32)], max_new_tokens=4,
+                        max_wall_s=60)          # warms the incarnation
+            outs = fleet.serve([np.zeros(4, np.int32)], max_new_tokens=4,
+                               raise_on_failure=False, max_wall_s=60)
+            assert outs == [None]
+            reg = fleet.registry._metrics
+            assert reg["fleet_replica_deaths_total"].value(
+                reason="heartbeat_timeout") == 1.0
+            assert fleet.last_failures[0].reason == "no_healthy_replicas"
 
 
 # ---------------------------------------------------------------------------
