@@ -81,6 +81,20 @@ def _log(name: str, wire_bytes: int, axis: AxisName, chunked: bool = False):
                       dcn_fraction=axis_dcn_fraction(axis))
 
 
+def log_wire(name: str, wire_bytes: int, axis: AxisName) -> None:
+    """Public trace-time wire-byte hook for collectives issued OUTSIDE the
+    wrappers below — the quantized pipeline (runtime/zero._qwire_exchange,
+    ops/quantization.qag_local/qrs_local) calls ``jax.lax`` collectives on
+    its int-code + scale buffers directly, and logs here at the **wire
+    dtype width**: ``wire_bytes`` is the per-participant ring bytes of the
+    int8/int4 codes PLUS the fp32 block scales actually moved, not the
+    logical full-width payload.  Kind names carry the wire format as a
+    suffix (``all_gather_q8``, ``all_to_all_q4``) so
+    ``collective_bytes_total{kind=...}`` separates quantized trains from
+    full-width ones and the ici/dcn link split stays byte-accurate."""
+    _log(name, wire_bytes, axis)
+
+
 # --------------------------------------------------------------------------
 # per-link attribution (ici vs dcn)
 # --------------------------------------------------------------------------
@@ -117,7 +131,7 @@ def _current_physical_mesh():
         return None
 
 
-def axis_dcn_fraction(axis: AxisName) -> float:
+def axis_dcn_fraction(axis: AxisName, mesh=None) -> float:
     """Fraction of a mesh axis's cyclic ring hops that cross a host
     (process) boundary — 0.0 on a single host or when no physical mesh is
     bound (the wire cost is then all-ICI by definition of 'one host').
@@ -126,8 +140,12 @@ def axis_dcn_fraction(axis: AxisName) -> float:
     crosses DCN when the two devices live on different processes; the
     fraction is averaged over every ring the mesh contains.  Multi-name
     axes flatten in axis-major order (the order ``lax`` collectives use).
+    ``mesh`` overrides the context lookup — the pipeline's hierarchy layer
+    (runtime/zero.resolve_wire_bits) plans wire formats AHEAD of entering
+    any mesh context.
     """
-    mesh = _current_physical_mesh()
+    if mesh is None:
+        mesh = _current_physical_mesh()
     if mesh is None:
         return 0.0
     names = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)

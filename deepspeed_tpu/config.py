@@ -103,6 +103,49 @@ class OffloadConfig(DeepSpeedConfigModel):
     overlap_step: bool = True
 
 
+class ZeroPPConfig(DeepSpeedConfigModel):
+    """Wire-format knobs of the composable collective pipeline
+    (runtime/zero.py; ZeRO++ arXiv:2306.10209, T3 arXiv:2401.16677,
+    EQuARX arXiv:2506.17615).
+
+    ``zero_quantized_weights`` / ``zero_quantized_gradients`` stay the
+    on/off switches (reference parity); this block says HOW:
+
+    - ``weight_bits``: int wire width of the qwZ forward param all-gather
+      (8 = ZeRO++ default; 4 = nibble-packed, half the bytes again).
+    - ``grad_bits``: int wire width of the qgZ gradient reduce (the
+      chunked gather's transposed reduce-scatter at stage 3, and the
+      data-axis all-to-all / EQuARX allreduce).
+    - ``block_size``: values per quantization block (one fp32 scale each).
+    - ``hierarchical``: per-axis wire policy — axes whose ring stays
+      inside one host (all-ICI) keep full-width values, host-crossing
+      axes quantize (the hpZ hierarchical design; pairs with
+      ``zero_hpz_partition_size`` which keeps params intra-host).
+    - ``quantized_allreduce``: block-quantized allreduce for the
+      stage-0/1 dp grad path (EQuARX-style), where
+      ``zero_quantized_gradients`` is rejected for lack of a scatter
+      target.
+    """
+
+    weight_bits: int = 8
+    grad_bits: int = 8
+    block_size: int = 256
+    hierarchical: bool = False
+    quantized_allreduce: bool = False
+
+    @model_validator(mode="after")
+    def _check(self):
+        for name in ("weight_bits", "grad_bits"):
+            if getattr(self, name) not in (2, 4, 8):
+                raise ValueError(
+                    f"zeropp.{name} must be 2, 4, or 8 "
+                    f"(got {getattr(self, name)})")
+        if self.block_size < 8:
+            raise ValueError(
+                f"zeropp.block_size must be >= 8, got {self.block_size}")
+        return self
+
+
 class ZeroConfig(DeepSpeedConfigModel):
     """reference: runtime/zero/config.py (DeepSpeedZeroConfig).
 
@@ -127,6 +170,8 @@ class ZeroConfig(DeepSpeedConfigModel):
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_hpz_partition_size: int = 1
+    # wire-format knobs for the quantized/hierarchical collective pipeline
+    zeropp: ZeroPPConfig = Field(default_factory=ZeroPPConfig)
     # MiCS subgroup sharding (reference runtime/zero/mics.py): shard params
     # within groups of this many chips, replicate across groups; 0 = off
     mics_shard_size: int = 0

@@ -189,19 +189,29 @@ class DeepSpeedTPUEngine:
         # ---- qgZ: quantized gradient reduce (reference ZeRO++ qgZ,
         # runtime/zero/stage3.py:1497 quantized gradient reduction; config
         # runtime/zero/config.py zero_quantized_gradients).  Grads are
-        # computed per-device inside shard_map over the data axis and reduced
-        # with an int8-wire all-to-all (_qgz_grads) instead of the
-        # partitioner's implicit fp32 reduce-scatter.
+        # computed per-device inside a collective-free shard_map over the
+        # data axis, stacked, and reduced by the quantized pipeline
+        # (runtime/zero.pipeline_grad_reduce: int-wire all-to-all
+        # reduce-scatter / EQuARX-style quantized allreduce) instead of the
+        # partitioner's implicit fp32 reduce.  ``zeropp.quantized_allreduce``
+        # opens the same path at stage 0/1, where the dp grad exchange is a
+        # plain allreduce (no scatter target needed — arXiv:2506.17615).
         self._qgz_axis = None
-        if config.zero_optimization.zero_quantized_gradients:
+        zpp = config.zero_optimization.zeropp
+        if (config.zero_optimization.zero_quantized_gradients
+                or zpp.quantized_allreduce):
             nested_axes = {a: mesh.shape[a] for a in ("sp", "ep", "pp")
                            if mesh.shape[a] > 1}
             data_axes = [a for a in ("dp", "fsdp") if mesh.shape[a] > 1]
-            if self.zero_stage < 2:
+            if (self.zero_stage < 2
+                    and config.zero_optimization.zero_quantized_gradients
+                    and not zpp.quantized_allreduce):
                 raise ValueError(
                     "zero_quantized_gradients requires zero stage >= 2 "
                     "(gradients must be partitioned for the quantized "
-                    "reduce-scatter to have a scatter target)")
+                    "reduce-scatter to have a scatter target); at stage "
+                    "0/1 set zero_optimization.zeropp.quantized_allreduce "
+                    "for the block-quantized allreduce instead")
             if nested_axes:
                 # sp/ep/pp express their collectives with their OWN
                 # shard_map (ring/Ulysses/MoE route/pipeline) — shardy
@@ -229,14 +239,22 @@ class DeepSpeedTPUEngine:
                     "zero_quantized_gradients set but the data-parallel "
                     "world is 1 — there is no gradient reduce to quantize; "
                     "flag is inert on this mesh")
+            elif config.zero_optimization.zero_quantized_gradients:
+                # stage 3 with dp=1: no cross-replica reduce — the ONLY
+                # gradient exchange is the fsdp reduce-scatter riding the
+                # param-gather transpose, which the composable pipeline
+                # quantizes (runtime/zero._qwire_exchange bwd); no manual
+                # data-axis region needed
+                log_dist(
+                    "qgZ at stage 3 with dp=1: gradient quantization rides "
+                    "the chunked gather's transpose (quantized "
+                    "reduce-scatter over 'fsdp')", ranks=[0])
             else:
                 logger.warning(
-                    "zero_quantized_gradients at stage 3 with dp=1: the "
-                    "only gradient reduce is the intra-group fsdp "
-                    "reduce-scatter fused with the param gather — "
-                    "nothing to quantize; flag is inert on this mesh "
-                    "(add a dp axis / MiCS grouping for cross-group "
-                    "compression)")
+                    "zeropp.quantized_allreduce at stage 3 with dp=1: the "
+                    "only gradient reduce is the fsdp reduce-scatter fused "
+                    "with the param gather — set zero_quantized_gradients "
+                    "to quantize it; the allreduce knob is inert here")
             if self._qgz_axis:
                 auto = [a for a in ("fsdp", "tp")
                         if mesh.shape[a] > 1 and a != self._qgz_axis]
@@ -524,9 +542,11 @@ class DeepSpeedTPUEngine:
                      f"({sorted(set(s.kind for s in self._pruning_specs))})",
                      ranks=[0])
 
-        # ZeRO++ qwZ: per-leaf fsdp-sharded dim for the quantized weight
-        # all-gather (None = leaf not fsdp-sharded) — built once from the
-        # sharding specs, consumed in _loss
+        # ZeRO++ qwZ: per-leaf fsdp-sharded dims (None = flag off / inert
+        # mesh).  The pipeline recomputes its own dims (partition.
+        # sharded_dim inside pipeline_param_gather); this tree survives as
+        # the qwZ-active gate for the wire plan below and as the
+        # introspection surface (tests/serving probes read it)
         self._qwz_dims = None
         if (config.zero_optimization.zero_quantized_weights
                 and self.zero_stage >= 3 and mesh.shape["fsdp"] > 1):
@@ -540,36 +560,66 @@ class DeepSpeedTPUEngine:
                            "is 1 — there is no weight all-gather to quantize; "
                            "flag is inert on this mesh")
 
-        # overlap.num_chunks: decompose the stage-3 param all-gather (and,
-        # via the transpose, the grad reduce-scatter) into per-layer-group
-        # chunks the latency-hiding scheduler can interleave with matmuls
-        # (runtime/zero.chunked_param_gather)
+        # ---- composable collective pipeline (runtime/zero.py, ISSUE 14):
+        # chunking (overlap.num_chunks), block quantization (qwZ fwd / qgZ
+        # bwd wire bits from the zeropp block), and hierarchy
+        # (zeropp.hierarchical per-axis wire policy) compose on ONE
+        # stage-3 gather/reduce path.  The former either/or conflict gates
+        # (chunks × qwZ, chunks × qgZ) are gone: quantization runs INSIDE
+        # the chunk bodies, and the qgZ data-axis reduce consumes stacked
+        # per-replica grads in its own full-manual region, so nothing
+        # nests inside the manual grad shard_map anymore.
         ov = config.overlap
+        # qgZ proper (zero_quantized_gradients) quantizes BOTH gradient
+        # exchanges: the gather-transpose reduce-scatter (grad_bits in the
+        # wire plan) and the data-axis reduce.  zeropp.quantized_allreduce
+        # is scoped to the DATA-AXIS reduce only (its stage-0/1 reason for
+        # existing) — it must never flip the fsdp reduce-scatter to lossy
+        # wire on a config that didn't ask for qgZ, so it feeds
+        # _dp_reduce_plan below but not this plan's grad_bits.
+        qgz_on = bool(config.zero_optimization.zero_quantized_gradients)
+        self._wire_plan = zero.WirePlan(
+            num_chunks=max(1, int(ov.num_chunks) if ov.enabled else 1),
+            weight_bits=(int(zpp.weight_bits)
+                         if self._qwz_dims is not None else 0),
+            grad_bits=int(zpp.grad_bits) if qgz_on else 0,
+            block_size=int(zpp.block_size),
+            hierarchical=bool(zpp.hierarchical),
+        )
+        self._dp_reduce_plan = self._wire_plan._replace(
+            grad_bits=(int(zpp.grad_bits)
+                       if (qgz_on or zpp.quantized_allreduce) else 0))
+        # the explicit gather engages when ANY pipeline layer asks for it;
+        # otherwise the partitioner's implicit per-consumer gathers stand
+        # (the seed behavior)
         self._gather_chunks = 0
-        if ov.enabled and ov.num_chunks > 1:
+        self._pipeline_active = False
+        want_pipeline = (self._wire_plan.num_chunks > 1
+                         or self._wire_plan.weight_bits > 0
+                         or (qgz_on and self.zero_stage >= 3))
+        if want_pipeline:
             if self.zero_stage < 3 or mesh.shape["fsdp"] <= 1:
-                logger.warning(
-                    "overlap.num_chunks=%d set but there is no stage-3 "
-                    "param all-gather to chunk (stage %d, fsdp=%d) — "
-                    "chunking is inert on this config; the XLA scheduler "
-                    "flags still apply", ov.num_chunks, self.zero_stage,
-                    mesh.shape["fsdp"])
-            elif self._qwz_dims is not None:
-                raise ValueError(
-                    "overlap.num_chunks > 1 and zero_quantized_weights both "
-                    "take over the stage-3 param gather — chunking the int8 "
-                    "qwZ gather is not wired; pick one")
-            elif self._qgz_axis is not None:
-                raise NotImplementedError(
-                    "overlap.num_chunks > 1 with zero_quantized_gradients: "
-                    "the chunked-gather shard_map cannot nest inside the "
-                    "manual-dp qgZ gradient region")
+                if ov.enabled and ov.num_chunks > 1:
+                    logger.warning(
+                        "overlap.num_chunks=%d set but there is no stage-3 "
+                        "param all-gather to chunk (stage %d, fsdp=%d) — "
+                        "chunking is inert on this config; the XLA "
+                        "scheduler flags still apply", ov.num_chunks,
+                        self.zero_stage, mesh.shape["fsdp"])
             else:
-                self._gather_chunks = int(ov.num_chunks)
+                self._pipeline_active = True
+                self._gather_chunks = self._wire_plan.num_chunks
+                wb, gb = zero.resolve_wire_bits(self._wire_plan, mesh,
+                                                "fsdp")
                 log_dist(
-                    f"overlap: stage-3 param gather decomposed into "
-                    f"{self._gather_chunks} per-layer-group chunks over "
-                    f"'fsdp' ({mesh.shape['fsdp']} ways)", ranks=[0])
+                    f"pipeline: stage-3 param gather in "
+                    f"{self._wire_plan.num_chunks} per-layer-group "
+                    f"chunk(s) over 'fsdp' ({mesh.shape['fsdp']} ways), "
+                    f"wire={'q%d' % wb if wb else 'full'} gather / "
+                    f"{'q%d' % gb if gb else 'full'} reduce-scatter"
+                    + (" [hierarchical]"
+                       if self._wire_plan.hierarchical else ""),
+                    ranks=[0])
 
         # numerics health monitor (telemetry.health): per-group stats are
         # traced INTO the step programs, so the flags must exist before
@@ -888,8 +938,15 @@ class DeepSpeedTPUEngine:
             )
         return init
 
-    def _loss(self, params, batch, rng, scale, step=None,
-              deterministic=False):
+    def _prepare_params(self, params, step):
+        """Differentiable param-side half of the loss: compute-dtype cast,
+        staged QDQ/pruning, then the composable pipeline gather
+        (runtime/zero.pipeline_param_gather — chunked, optionally
+        quantized, hierarchy-aware).  Split out of ``_loss`` so the qgZ
+        path can run it (and, via ``jax.vjp``, its transposed chunked/
+        quantized reduce-scatter) OUTSIDE the manual data-axis region —
+        shard_maps cannot nest, and this split is what lets chunking ×
+        quantization × the manual qgZ reduce compose."""
         if not self.use_master_weights:
             params = _cast_params(params, self.compute_dtype)
         if self._compression_specs and step is not None:
@@ -901,24 +958,18 @@ class DeepSpeedTPUEngine:
         if self._pruning_specs and step is not None:
             from deepspeed_tpu.compression.pruning import scheduled_pruning
             params = scheduled_pruning(params, self._pruning_specs, step)
-        if self._qwz_dims is not None:
-            # ZeRO++ qwZ: explicit int8 weight all-gather (s8 on the wire)
-            # instead of the partitioner's implicit bf16 gather
-            from deepspeed_tpu.ops.quantization import quantized_weight_gather
-            mesh = self.mesh
+        if self._pipeline_active:
+            # explicit per-layer-group gather replaces the partitioner's
+            # per-consumer all-gathers; the autodiff transpose is the
+            # chunked (and, under qgZ, quantized) grad reduce-scatter
+            params = zero.pipeline_param_gather(
+                params, self.param_shardings, self.mesh, self._wire_plan)
+        return params
 
-            def gather(p, d):
-                if d < 0 or p.shape[d] % mesh.shape["fsdp"]:
-                    return p
-                return quantized_weight_gather(p, mesh, "fsdp", d)
-            params = jax.tree_util.tree_map(gather, params, self._qwz_dims)
-        if self._gather_chunks:
-            # overlap.num_chunks: explicit per-layer-group chunked gather
-            # replaces the partitioner's per-consumer all-gathers; its
-            # autodiff transpose is the chunked grad reduce-scatter
-            from deepspeed_tpu.runtime.zero import chunked_param_gather
-            params = chunked_param_gather(params, self.param_shardings,
-                                          self.mesh, self._gather_chunks)
+    def _loss(self, params, batch, rng, scale, step=None,
+              deterministic=False, prepared=False):
+        if not prepared:
+            params = self._prepare_params(params, step)
         if self.pld is not None and step is not None:
             # theta is a pure function of the step — computed in-graph, so
             # PLD adds zero host↔device traffic (reference updates it on the
@@ -940,32 +991,38 @@ class DeepSpeedTPUEngine:
         return grads, loss
 
     def _qgz_grads(self, state: TrainState, batch, rng):
-        """qgZ grad computation: per-device grads inside ``shard_map`` over
-        the data axis, explicitly reduced with an all-to-all of int8 values +
-        fp32 block scales (ops/quantization.qrs_local) — ~4x fewer bytes on
-        the wire than the partitioner's implicit fp32 reduce-scatter
-        (reference runtime/zero/stage3.py:1497 quantized gradient reduction).
+        """qgZ grad computation, restructured as three composable stages
+        (reference runtime/zero/stage3.py:1497 quantized gradient
+        reduction; EQuARX, arXiv:2506.17615, for the allreduce form):
 
-        Leaves whose ZeRO-2 sharding has a scatter dim land directly in their
-        partitioned layout (quantized reduce-scatter); replicated leaves
-        (scalars, tiny vectors) take a quantized allreduce when blockable,
-        else a plain fp32 psum (negligible bytes).
+        1. **param pipeline** (outside any manual region): ``jax.vjp`` over
+           ``_prepare_params`` — cast/QDQ/pruning plus, at stage 3, the
+           chunked/quantized pipeline gather.  Its pullback, applied in
+           stage 3b, is the chunked (and under qgZ quantized)
+           reduce-scatter over fsdp.
+        2. **per-replica grads** (partial-manual shard_map over the data
+           axis, fsdp/tp auto): each replica computes grads on its own
+           batch shard and emits them STACKED on a new leading axis — the
+           region contains no manual-axis collectives beyond the loss
+           pmean, which is what keeps it lowerable on every jax this
+           package supports (utils/compat.shard_map legacy caveat).
+        3. **quantized data-axis reduce** (full-manual
+           runtime/zero.pipeline_grad_reduce): int codes + fp32 block
+           scales on the wire — all-to-all reduce-scatter into partitioned
+           layouts, EQuARX-style quantized allreduce for replicated
+           leaves, plain psum for scalars — then (3b) the pipeline
+           pullback maps the reduced cotangent to sharded-param space.
         """
         from deepspeed_tpu.utils.compat import shard_map
-        from deepspeed_tpu.ops.quantization import qpsum_local, qrs_local
+        from deepspeed_tpu.parallel.mesh import auto_axes_spec
         mesh, axis = self.mesh, self._qgz_axis
         size = mesh.shape[axis]
-        # partial-manual: ONLY the data axis is manual — fsdp/tp stay
-        # auto, so GSPMD still inserts the intra-group param gathers /
-        # grad reduce-scatters / tp activation collectives inside the body
 
-        def scatter_dim(sh):
-            for d, ax in enumerate(sh.spec):
-                if ax == axis or (isinstance(ax, tuple) and axis in ax):
-                    return d
-            return -1
-        dims = jax.tree_util.tree_map(scatter_dim, self.grad_shardings)
-        pspecs = jax.tree_util.tree_map(lambda _: P(), state.params)
+        # -- stage 1: param-side pipeline + its pullback, outside the
+        #    manual region (shard_maps cannot nest)
+        prepared, prep_vjp = jax.vjp(
+            lambda p: self._prepare_params(p, state.step), state.params)
+
         def bspec(x):
             if getattr(x, "ndim", 0) < 1:
                 return P()                       # scalars replicate
@@ -978,45 +1035,74 @@ class DeepSpeedTPUEngine:
                     f"leaf's leading dim divides the data-parallel size")
             return P(axis)
         bspecs = jax.tree_util.tree_map(bspec, batch)
-        gspecs = jax.tree_util.tree_map(
-            lambda d, g: (P(*[axis if i == d else None
-                              for i in range(g.ndim)]) if d >= 0 else P()),
-            dims, state.params)
+        pspecs = jax.tree_util.tree_map(lambda _: P(), prepared)
+        # stacked out_specs name ONLY the manual axis (legal on both
+        # shard_map APIs); fsdp/tp layout rides the in-body anchor below +
+        # the exit constraint
+        stack_specs = jax.tree_util.tree_map(
+            lambda g: P(axis, *([None] * getattr(g, "ndim", 0))), prepared)
 
-        # in-body binding (round-4 verdict item 4): re-anchor each reduced
-        # grad to the AUTO part of its target sharding inside the manual
-        # region, so GSPMD lays out the fsdp/tp dims there instead of
-        # deferring every layout choice to the exit constraint
-        from jax.sharding import NamedSharding
-        from deepspeed_tpu.parallel.mesh import auto_axes_spec
+        # in-body anchor (round-4 verdict item 4): each replica's cotangent
+        # re-anchors to the AUTO part of its target layout inside the
+        # region, so GSPMD emits the intra-replica reduce as a
+        # reduce-scatter into that layout rather than an allreduce.  For
+        # gathered (pipeline) leaves the anchor is the raw param sharding's
+        # auto part (fsdp dims re-sharded for storage); otherwise the grad
+        # sharding's.
+        anchor_tree = (self.param_shardings if self._pipeline_active
+                       else self.grad_shardings)
         auto_shardings = jax.tree_util.tree_map(
             lambda sh: NamedSharding(mesh, auto_axes_spec(sh.spec,
                                                           manual={axis})),
-            self.grad_shardings)
+            anchor_tree)
 
+        # -- stage 2: per-replica grads, stacked over the data axis
         def local(params, mb, rng, scale, step):
             # decorrelate dropout masks across data shards (the global-batch
             # path gets this for free from position-dependent masking)
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
             (_, loss), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, mb, rng, scale, step)
-
-            def red(g, d):
-                g = g.astype(jnp.float32)
-                if d >= 0:
-                    return qrs_local(g, axis, size, d) / size
-                if (g.ndim >= 1 and g.shape[0] % size == 0
-                        and g.size >= 64):   # blockable replicated leaf
-                    return qpsum_local(g, axis, size, 0) / size
-                return jax.lax.psum(g, axis) / size
-            grads = jax.tree_util.tree_map(red, grads, dims)
+                self._loss, has_aux=True)(params, mb, rng, scale, step,
+                                          prepared=True)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
             grads = jax.lax.with_sharding_constraint(grads, auto_shardings)
-            return grads, jax.lax.pmean(loss, axis)
+            return (jax.tree_util.tree_map(lambda g: g[None], grads),
+                    jax.lax.pmean(loss, axis))
 
-        grads, loss = shard_map(
+        stacked, loss = shard_map(
             local, mesh=mesh, in_specs=(pspecs, bspecs, P(), P(), P()),
-            out_specs=(gspecs, P()), check_vma=False, axis_names={axis})(
-                state.params, batch, rng, state.loss_scale.scale, state.step)
+            out_specs=(stack_specs, P()), check_vma=False,
+            axis_names={axis})(
+                prepared, batch, rng, state.loss_scale.scale, state.step)
+
+        # -- stage 3: quantized data-axis reduce of the stacks, then the
+        #    pipeline pullback (chunked/quantized fsdp reduce-scatter).
+        #    Reduce target: with the pipeline active the cotangents live in
+        #    GATHERED space (fsdp dims dropped by the gather — the dp
+        #    reduce is an allreduce there and the pullback re-scatters);
+        #    without it they live in raw-param space and scatter straight
+        #    into the ZeRO grad partitioning (the qgZ-axis dims of
+        #    grad_shardings).
+        from deepspeed_tpu.parallel.partition import spec_without_axis
+        if self._pipeline_active:
+            target = jax.tree_util.tree_map(
+                lambda sh: NamedSharding(
+                    mesh, spec_without_axis(sh.spec, "fsdp")),
+                self.param_shardings)
+        else:
+            target = self.grad_shardings
+        stacked = jax.lax.with_sharding_constraint(
+            stacked, jax.tree_util.tree_map(
+                lambda sh: NamedSharding(
+                    mesh, P(axis, *spec_without_axis(sh.spec, axis))),
+                target))
+        reduced = zero.pipeline_grad_reduce(
+            stacked, target, mesh, axis, self._dp_reduce_plan, mean=True)
+        (grads,) = prep_vjp(jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), reduced, prepared))
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
         grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
         return grads, loss
 

@@ -160,20 +160,76 @@ def _wire_block(n: int, block_size: int) -> int:
     return b
 
 
+def _log_qwire(kind: str, bits: int, payload_bytes: int, axis: str,
+               size: int, ring_factor) -> None:
+    """Trace-time wire accounting for the quantized collective bodies: the
+    telemetry byte counters see the int codes + fp32 scales at their WIRE
+    width, tagged with the format (``all_gather_q8``, ``all_to_all_q4``) —
+    comm/collectives.log_wire.  ``ring_factor(payload, n)`` maps payload to
+    per-participant ring bytes per the convention in collectives.py."""
+    from deepspeed_tpu.comm.collectives import log_wire
+    log_wire(f"{kind}_q{bits}", ring_factor(payload_bytes, size), axis)
+
+
+def _qb_bytes(qb: QuantizedBlocks) -> int:
+    return (int(qb.values.size) * qb.values.dtype.itemsize
+            + int(qb.scales.size) * qb.scales.dtype.itemsize)
+
+
+def q_gather_rows(flat, axis: str, size: int, *, bits: int = 8,
+                  block_size: int = 256):
+    """Quantized stacked all-gather of one flat buffer, inside
+    ``shard_map`` over ``axis``: ``[B] -> [size, B]``.  Int codes + fp32
+    block scales on the wire, per-member dequant back to ``flat.dtype``.
+    THE quantized-gather wire core — ``qag_local`` and the composable
+    pipeline's ``_qwire_exchange`` forward (runtime/zero.py) both run
+    through here, so the wire format and its byte accounting live once."""
+    qb = quantize_blockwise(flat, bits=bits,
+                            block_size=_wire_block(flat.size, block_size))
+    _log_qwire("all_gather", bits, _qb_bytes(qb), axis, size,
+               lambda b, n: b * (n - 1))
+    vg = jax.lax.all_gather(qb.values, axis)             # int8 on the wire
+    sg = jax.lax.all_gather(qb.scales, axis)
+    return jnp.stack([
+        dequantize_blockwise(qb._replace(values=vg[i], scales=sg[i]))
+        for i in range(size)])
+
+
+def q_reduce_rows(rows, axis: str, size: int, *, bits: int = 8,
+                  block_size: int = 256):
+    """Quantized reduce-scatter of pre-split rows, inside ``shard_map``
+    over ``axis``: ``rows[j]`` is this device's contribution to member j;
+    returns the sum over devices of their row for THIS member (``[size,
+    B] -> [B]``, ``rows.dtype``).  Each row quantizes independently
+    (blocks never straddle member boundaries), one all-to-all moves the
+    codes + scales.  THE quantized-reduce wire core — ``qrs_local`` and
+    ``_qwire_exchange``'s backward both run through here."""
+    bs = _wire_block(rows.shape[1], block_size)
+    qbs = [quantize_blockwise(rows[i], bits=bits, block_size=bs)
+           for i in range(size)]
+    _log_qwire("all_to_all", bits, sum(_qb_bytes(q) for q in qbs), axis,
+               size, lambda b, n: b * (n - 1) // n)
+    v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),
+                           axis, 0, 0, tiled=False)
+    s = jax.lax.all_to_all(jnp.stack([q.scales for q in qbs]),
+                           axis, 0, 0, tiled=False)
+    total = jnp.zeros(rows.shape[1:], jnp.float32)
+    for i in range(size):
+        qi = qbs[0]._replace(values=v[i], scales=s[i])
+        total = total + dequantize_blockwise(qi).astype(jnp.float32)
+    return total.astype(rows.dtype)
+
+
 def qag_local(xs, axis: str, size: int, gather_dim: int = 0, *,
               bits: int = 8, block_size: int = 256):
     """Per-device body of a quantized all-gather (inside ``shard_map`` over
     ``axis``): int values + fp32 block scales on the wire, per-member dequant,
     concat along ``gather_dim``.  Shared by ``quantized_all_gather`` and
     ``qpsum_local``."""
-    qb = quantize_blockwise(xs, bits=bits,
-                            block_size=_wire_block(xs.size, block_size))
-    vg = jax.lax.all_gather(qb.values, axis)             # int8 on the wire
-    sg = jax.lax.all_gather(qb.scales, axis)
-    parts = [
-        dequantize_blockwise(qb._replace(values=vg[i], scales=sg[i]))
-        for i in range(size)]
-    return jnp.concatenate(parts, axis=gather_dim)
+    rows = q_gather_rows(xs.reshape(-1), axis, size, bits=bits,
+                         block_size=block_size)
+    return jnp.concatenate([rows[i].reshape(xs.shape) for i in range(size)],
+                           axis=gather_dim)
 
 
 def qrs_local(xs, axis: str, size: int, scatter_dim: int = 0, *,
@@ -190,18 +246,10 @@ def qrs_local(xs, axis: str, size: int, scatter_dim: int = 0, *,
     Returns this device's reduced slice (shape[scatter_dim] / size).
     """
     parts = jnp.split(xs, size, axis=scatter_dim)
-    block_size = _wire_block(parts[0].size, block_size)
-    qbs = [quantize_blockwise(p, bits=bits, block_size=block_size)
-           for p in parts]
-    v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),
-                           axis, 0, 0, tiled=False)
-    s = jax.lax.all_to_all(jnp.stack([q.scales for q in qbs]),
-                           axis, 0, 0, tiled=False)
-    total = jnp.zeros(parts[0].shape, jnp.float32)
-    for i in range(size):
-        qi = qbs[0]._replace(values=v[i], scales=s[i])
-        total = total + dequantize_blockwise(qi).astype(jnp.float32)
-    return total.astype(xs.dtype)
+    rows = jnp.stack([p.reshape(-1) for p in parts])
+    total = q_reduce_rows(rows, axis, size, bits=bits,
+                          block_size=block_size)
+    return total.reshape(parts[0].shape)
 
 
 def qpsum_local(xs, axis: str, size: int, scatter_dim: int = 0, *,

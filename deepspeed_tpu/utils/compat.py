@@ -103,12 +103,19 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
     if check_vma is not None:
         kw["check_rep"] = check_vma
     if axis_names is not None:
-        # partial-manual over `auto` on legacy shard_map has been observed
-        # to wedge XLA's partitioner (test_qgz hangs multi-minutes) — fail
-        # fast rather than eat a CI run's whole time budget
-        raise NotImplementedError(
-            "partial-manual shard_map (axis_names=...) needs a jax with "
-            "top-level jax.shard_map; this jax "
-            "only has the legacy experimental API")
+        # partial-manual: the legacy API spells the MANUAL axes as their
+        # complement (`auto` = every mesh axis not named).  CAVEAT, load-
+        # bearing for every caller: on this jax the SPMD partitioner can
+        # only lower psum/pmean over the manual axes while a >1-sized auto
+        # axis exists — all_gather / all_to_all / ppermute in the body trip
+        # a FATAL partitioner check (spmd_partitioner.cc IsManualSubgroup
+        # mismatch, aborts the process).  The engine's qgZ path therefore
+        # keeps its manual regions collective-free (psum for the loss only)
+        # and runs every quantized exchange in a separate FULL-manual
+        # region (runtime/zero.pipeline_grad_reduce), where all collectives
+        # lower fine on both APIs.
+        manual = (set(axis_names) if not isinstance(axis_names, str)
+                  else {axis_names})
+        kw["auto"] = frozenset(set(mesh.axis_names) - manual)
     return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    **kw)

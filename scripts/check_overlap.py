@@ -42,9 +42,13 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def demo_hlo(num_chunks: int = 4, devices: int = 4) -> str:
+def demo_hlo(num_chunks: int = 4, devices: int = 4,
+             quantized: bool = False) -> str:
     """Compile a toy chunked-gather-matmul step (the shape
-    runtime/zero.chunked_param_gather produces) and return its HLO text."""
+    runtime/zero.pipeline_param_gather produces) and return its HLO text.
+    ``quantized`` routes each chunk through the int8 wire
+    (runtime/zero._qwire_exchange) — the values + scale companion
+    collectives the quantized chunk train emits."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -74,8 +78,14 @@ def demo_hlo(num_chunks: int = 4, devices: int = 4) -> str:
         c = wl.shape[0] // num_chunks
         acc = jnp.zeros((xl.shape[0], wl.shape[1]), jnp.float32)
         for i in range(num_chunks):
-            g = lax.all_gather(wl[i * c:(i + 1) * c], "fsdp", axis=0,
-                               tiled=True)
+            chunk = wl[i * c:(i + 1) * c]
+            if quantized:
+                from deepspeed_tpu.runtime.zero import _qwire_exchange
+                rows = _qwire_exchange("fsdp", n, 8, 8, 64)(
+                    chunk.reshape(-1))
+                g = rows.reshape(n * c, chunk.shape[1])
+            else:
+                g = lax.all_gather(chunk, "fsdp", axis=0, tiled=True)
             acc = acc + xl[:, i * c * n:(i + 1) * c * n] @ g
         return acc
 
@@ -95,6 +105,10 @@ def report(stats: dict) -> str:
         f"  sync collectives ........ {stats['sync_collectives']} "
         f"({stats['interleaved']} chunk-interleaved, "
         f"{stats['interleaved_bytes']} bytes)",
+        f"  companions .............. "
+        f"{stats.get('companion_collectives', 0)} "
+        f"({stats.get('companion_bytes', 0)} bytes — quantized-train "
+        f"scale legs riding their values collective's window)",
     ]
     for kind, cnt in sorted(stats["per_kind_interleaved"].items()):
         lines.append(f"    interleaved[{kind}] = {cnt}")
@@ -121,6 +135,9 @@ def main(argv: Optional[list] = None) -> int:
                     "virtual CPU devices and analyze it")
     ap.add_argument("--num-chunks", type=int, default=4,
                     help="demo: chunk count (default 4)")
+    ap.add_argument("--quantized", action="store_true",
+                    help="demo: route each chunk through the int8 wire "
+                    "(values + scale companion collectives)")
     ap.add_argument("--assert-overlap", action="store_true",
                     help="exit 1 unless overlap evidence is present")
     ap.add_argument("--min-chunks", type=int, default=2,
@@ -142,7 +159,8 @@ def main(argv: Optional[list] = None) -> int:
                   file=sys.stderr)
             return 2
     else:
-        text = demo_hlo(num_chunks=args.num_chunks)
+        text = demo_hlo(num_chunks=args.num_chunks,
+                        quantized=args.quantized)
 
     from deepspeed_tpu.comm.comm import hlo_overlap_stats
     stats = hlo_overlap_stats(text)

@@ -261,10 +261,24 @@ class TestChunkedGather:
         losses = [float(eng.train_batch(_batch(eng)).loss)
                   for _ in range(3)]
         assert np.isfinite(losses).all()
-        # qwZ conflict is a loud error
-        with pytest.raises(ValueError, match="qwZ|zero_quantized_weights"):
-            _build_engine(chunks=4,
-                          extra_zero={"zero_quantized_weights": True})
+
+    def test_qwz_composes_with_chunks(self, devices):
+        """The former hard conflict (ISSUE 14): chunking and the qwZ int8
+        gather now COMPOSE on one pipeline — the compiled step shows a
+        chunk train of s8 all-gathers, and the engine trains."""
+        import re
+        eng = _build_engine(chunks=4,
+                            extra_zero={"zero_quantized_weights": True})
+        assert eng._pipeline_active and eng._gather_chunks == 4
+        assert eng._wire_plan.weight_bits == 8
+        losses = [float(eng.train_batch(_batch(eng)).loss)
+                  for _ in range(3)]
+        assert np.isfinite(losses).all()
+        txt = _step_hlo(eng)
+        s8_ags = [ln for ln in txt.splitlines()
+                  if re.search(r" all-gather(-start)?\(", ln)
+                  and "s8[" in ln]
+        assert len(s8_ags) >= 4, f"expected >=4 s8 chunk gathers, got {len(s8_ags)}"
 
     def test_num_chunks_clamped_to_leaf_count(self, devices):
         """More chunks than gatherable leaves: every group still gathers
