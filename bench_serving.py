@@ -60,6 +60,7 @@ def pad_batch(chunk, length=None, rows=None):
 
 def make_v2(cfg, params, block_size=64, kv_quant=None, quant_weights=False,
             quant_bits=8, telemetry=True, stream_sync=False, spec=None,
+            prefix_cache=False, prefill_chunk_tokens=None, token_budget=None,
             **eng_kwargs):
     """One construction point for every v2 leg so the config shape (and the
     telemetry block) stays consistent across them."""
@@ -71,11 +72,13 @@ def make_v2(cfg, params, block_size=64, kv_quant=None, quant_weights=False,
     quant = {"enabled": bool(quant_weights), "bits": quant_bits}
     config = {"state_manager": {
         "max_tracked_sequences": SLOTS,
-        "max_ragged_batch_size": TOKEN_BUDGET,
+        "max_ragged_batch_size": int(token_budget or TOKEN_BUDGET),
         "max_ragged_sequence_count": SLOTS,
-        "max_q_per_seq": 512,
+        "max_q_per_seq": min(512, int(token_budget or 512)),
         "kv_block_size": block_size,
-        "kv_quant": kv_quant},
+        "kv_quant": kv_quant,
+        "prefix_cache": bool(prefix_cache),
+        "prefill_chunk_tokens": prefill_chunk_tokens},
         "quant": quant,
         "generation": {"do_sample": False},
         "telemetry": {"enabled": bool(telemetry),
@@ -155,6 +158,137 @@ def run_open_loop(cfg, params, prompts, budgets, rate, slo_ttft_ms,
         "open_loop_slo": f"ttft<={slo_ttft_ms:g}ms,tpot<={slo_tpot_ms:g}ms",
         "serving_telemetry_dir": out_dir,
     }
+
+
+def run_shared_prefix(cfg, params, block_size=64, smoke=False, seed=5):
+    """Shared-prefix leg ([serving_scale] radix KV cache): N requests share
+    one long system prompt (the fleet-scale workload shape) and are served
+    twice — prefix cache OFF, then ON.  The ON engine is primed by its
+    warm pass, so every timed request aliases the shared blocks and skips
+    that prefill entirely; greedy outputs must be byte-identical between
+    the runs (the cache's correctness invariant), and the acceptance bar
+    is ≥1.5× tokens/s ON vs OFF.  ``prefix_hit_rate`` = cache-served
+    prompt tokens / total prompt tokens in the timed ON pass."""
+    rng = np.random.default_rng(seed)
+    # block-aligned shared prefix (kv_block_size 64): the radix matches
+    # FULL blocks only, so alignment makes the hit rate read cleanly
+    shared_len = 256 if smoke else 448
+    suf_lo, suf_hi = (8, 17) if smoke else (16, 65)
+    nreq = 2 * SLOTS
+    budget = 4 if smoke else 8
+    shared = rng.integers(0, cfg.vocab_size,
+                          size=shared_len).astype(np.int32)
+    prompts = [np.concatenate([shared, rng.integers(
+        0, cfg.vocab_size,
+        size=int(rng.integers(suf_lo, suf_hi))).astype(np.int32)])
+        for _ in range(nreq)]
+    budgets = [budget] * nreq
+    tps, outputs, hit_rate = {}, {}, 0.0
+    for label, pc in (("off", False), ("on", True)):
+        eng = make_v2(cfg, params, block_size=block_size, prefix_cache=pc)
+        # warm pass: compiles every program AND (ON) inserts the shared
+        # prefix into the radix — the steady state a long-lived server is
+        # always in
+        eng.generate(prompts, max_new_tokens=budgets)
+        stel = reset_telemetry(eng)
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=budgets)
+        dt = time.perf_counter() - t0
+        outputs[label] = outs
+        tps[label] = sum(len(o) for o in outs) / dt
+        if pc:
+            hits = stel.value("kv_prefix_hit_tokens_total")
+            hit_rate = hits / max(1, sum(len(p) for p in prompts))
+    for a, b in zip(outputs["off"], outputs["on"]):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "prefix cache changed greedy output (must be byte-identical)"
+    return {
+        "shared_prefix_tokens_per_sec": round(tps["on"], 1),
+        "shared_prefix_off_tokens_per_sec": round(tps["off"], 1),
+        "shared_prefix_speedup_x": round(tps["on"] / max(tps["off"], 1e-9),
+                                         3),
+        "prefix_hit_rate": round(hit_rate, 3),
+        "shared_prefix_len": shared_len,
+    }
+
+
+def run_arrival_sweep(cfg, params, prompts, budgets, base_rate, slo_ttft_ms,
+                      slo_tpot_ms, out_dir, block_size=64,
+                      base_result=None):
+    """Arrival-rate sweep: the open-loop Poisson leg at 0.5×/1×/2× the
+    base rate — the goodput-vs-load curve the [serving_scale] acceptance
+    asks for (goodput holds under capacity, then degrades gracefully as
+    queueing pushes TTFT past the SLO; a cliff means admission or
+    scheduling is broken).  ``base_result`` reuses main()'s already-
+    measured 1× leg instead of re-running it (the open-loop leg is one of
+    the slowest in the bench)."""
+    import os
+    out = {}
+    for i, mult in enumerate((0.5, 1.0, 2.0), start=1):
+        rate = base_rate * mult
+        if mult == 1.0 and base_result:
+            res = base_result
+        else:
+            res = run_open_loop(cfg, params, prompts, budgets, rate,
+                                slo_ttft_ms, slo_tpot_ms,
+                                os.path.join(out_dir, f"sweep_r{i}"),
+                                block_size=block_size)
+        out[f"sweep_r{i}_arrival_rate_rps"] = round(rate, 3)
+        out[f"sweep_r{i}_load_x"] = mult
+        out[f"sweep_r{i}_goodput_tokens_per_sec"] = \
+            res["open_loop_goodput_tokens_per_sec"]
+        out[f"sweep_r{i}_tokens_per_sec"] = res["open_loop_tokens_per_sec"]
+        out[f"sweep_r{i}_ttft_p99_ms"] = res["open_loop_ttft_p99_ms"]
+    return out
+
+
+def run_chunked_tpot(cfg, params, block_size=64, smoke=False, seed=9):
+    """Chunked-prefill (SplitFuse) TPOT leg: long prompts streaming into a
+    busy decode set under a TIGHT per-round token budget (the
+    monopolization regime — without chunking, one prompt's chunk fills the
+    whole round and every decoder's next token waits behind it).  Three
+    legs, all in streaming mode (fenced dispatches, device-true
+    timestamps): short-prompt baseline, long prompts UNCHUNKED, and long
+    prompts with ``prefill_chunk_tokens`` bounding the per-round prompt
+    freight.  Acceptance: chunked long-prompt p99 TPOT ≤ short baseline
+    × 1.5.  The chunked-vs-unchunked pair isolates the knob itself.  NOTE
+    the contrast is compute-bound by design (big mixed dispatches); on an
+    overhead-bound host (smoke's 2-layer CPU model, ~flat ms per dispatch
+    regardless of tokens) all three legs read alike — judge the knob on
+    hardware."""
+    rng = np.random.default_rng(seed)
+    nreq = 2 * SLOTS
+    budget = 8 if smoke else 16
+    round_budget = 96 if smoke else 512
+    chunk = 32 if smoke else 128
+    lo_s, hi_s = (24, 49) if smoke else (32, 65)
+    hi_cap = cfg.max_seq_len - budget - 1
+    lo_l, hi_l = ((256, min(400, hi_cap)) if smoke
+                  else (1024, min(1537, hi_cap)))
+    out = {}
+    legs = (("short_prompt_tpot_p99_ms", (lo_s, hi_s), None),
+            ("long_unchunked_tpot_p99_ms", (lo_l, hi_l), None),
+            ("chunked_prefill_tpot_p99_ms", (lo_l, hi_l), chunk))
+    for key, (lo, hi), ck in legs:
+        prompts = [rng.integers(0, cfg.vocab_size,
+                                size=int(rng.integers(lo, hi))
+                                ).astype(np.int32) for _ in range(nreq)]
+        budgets = [budget] * nreq
+        eng = make_v2(cfg, params, block_size=block_size, stream_sync=True,
+                      prefill_chunk_tokens=ck, token_budget=round_budget)
+        eng.generate(prompts, max_new_tokens=budgets)     # warm the compiles
+        stel = reset_telemetry(eng)
+        eng.generate(prompts, max_new_tokens=budgets)
+        out[key] = round(stel.quantile("serving_tpot_ms", 0.99), 2)
+        if ck:
+            out["prefill_chunks"] = stel.value("prefill_chunks_total")
+    out["chunked_tpot_vs_short_x"] = round(
+        out["chunked_prefill_tpot_p99_ms"]
+        / max(out["short_prompt_tpot_p99_ms"], 1e-9), 3)
+    out["chunked_tpot_vs_unchunked_x"] = round(
+        out["chunked_prefill_tpot_p99_ms"]
+        / max(out["long_unchunked_tpot_p99_ms"], 1e-9), 3)
+    return out
 
 
 def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
@@ -606,6 +740,20 @@ def main(argv=None):
     open_loop = leg("open_loop", lambda: run_open_loop(
         cfg, params, prompts, budgets, rate, args.slo_ttft_ms,
         args.slo_tpot_ms, args.telemetry_out)) or {}
+    # goodput-vs-load curve: the same open-loop leg at 0.5x/1x/2x the base
+    # arrival rate ([serving_scale] acceptance)
+    sweep = leg("arrival_sweep", lambda: run_arrival_sweep(
+        cfg, params, prompts, budgets, rate, args.slo_ttft_ms,
+        args.slo_tpot_ms, args.telemetry_out,
+        base_result=open_loop if open_loop.get(
+            "open_loop_goodput_tokens_per_sec") is not None else None)) or {}
+    # radix shared-prefix cache leg: ON-vs-OFF tokens/s on a shared system
+    # prompt, byte-identical greedy outputs asserted inside
+    prefix_leg = leg("shared_prefix", lambda: run_shared_prefix(
+        cfg, params, smoke=smoke)) or {}
+    # SplitFuse chunked-prefill leg: long prompts must not blow p99 TPOT
+    chunk_leg = leg("chunked_prefill", lambda: run_chunked_tpot(
+        cfg, params, smoke=smoke)) or {}
     # multi-replica chaos leg: same open-loop workload through the fleet
     # router, one replica killed mid-load (no respawn) — goodput must
     # degrade toward (N-1)/N, not cliff, with zero lost/duplicated requests
@@ -632,6 +780,9 @@ def main(argv=None):
              "model": ("llama-style 2L/128H (smoke)" if smoke
                        else "llama-style 12L/1024H GQA4, bf16")}
     extra.update(open_loop)
+    extra.update(sweep)
+    extra.update(prefix_leg)
+    extra.update(chunk_leg)
     extra.update(fleet_leg)
     try:
         extra.update(spec_leg(smoke=smoke))

@@ -7,15 +7,19 @@ put/query/flush engine with a Dynamic SplitFuse generate driver (engine_v2.py).
 from deepspeed_tpu.inference.v2.engine_v2 import (DSStateManagerConfig,
                                                   EngineDrained,
                                                   InferenceEngineV2,
-                                                  RaggedInferenceEngineConfig)
+                                                  RaggedInferenceEngineConfig,
+                                                  SchedulerV2Config,
+                                                  SLAClassConfig)
 from deepspeed_tpu.inference.v2.model import PagedKVCache, ragged_forward
 from deepspeed_tpu.inference.v2.ragged import (BlockedAllocator,
-                                               DSStateManager, RaggedBatch,
+                                               DSStateManager, RadixKVCache,
+                                               RaggedBatch,
                                                SequenceDescriptor,
                                                build_ragged_batch)
 
 __all__ = ["InferenceEngineV2", "RaggedInferenceEngineConfig",
            "DSStateManagerConfig", "EngineDrained",
+           "SchedulerV2Config", "SLAClassConfig",
            "PagedKVCache", "ragged_forward",
-           "DSStateManager", "BlockedAllocator", "SequenceDescriptor",
-           "RaggedBatch", "build_ragged_batch"]
+           "DSStateManager", "BlockedAllocator", "RadixKVCache",
+           "SequenceDescriptor", "RaggedBatch", "build_ragged_batch"]

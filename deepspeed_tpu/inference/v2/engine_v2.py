@@ -68,6 +68,45 @@ class DSStateManagerConfig(DeepSpeedConfigModel):
     # bandwidth bound) and doubles cache capacity for ~6% scale overhead
     # (the ZeRO-Inference trade applied to the KV side).  None = native dtype.
     kv_quant: Optional[str] = None
+    # radix shared-prefix KV cache (ragged.RadixKVCache): new prompts alias
+    # the pool blocks of every previously-served block-aligned prefix and
+    # skip prefill for the matched tokens; retired blocks stay cached until
+    # LRU eviction reclaims them under allocation pressure.  Greedy output
+    # is token-exact with the cache on or off.  Off by default: it changes
+    # pool-accounting observables (a flush no longer returns prompt blocks
+    # to the free list immediately), so it is an explicit serving opt-in.
+    prefix_cache: bool = False
+    # SplitFuse round cap on TOTAL prompt-chunk tokens co-scheduled with
+    # decode per forward (None = the full remaining token budget, the
+    # pre-PR-15 behavior).  Bounding it keeps the mixed dispatch short so
+    # in-flight decoders' TPOT stays flat while long prompts stream in.
+    prefill_chunk_tokens: Optional[int] = None
+
+
+class SLAClassConfig(DeepSpeedConfigModel):
+    """One serving SLA class (``scheduler.sla_classes`` values).  Higher
+    ``priority`` admits first and may preempt lower-priority decoders;
+    ``ttft_slo_ms`` is the time-to-first-token objective that ARMS
+    preemption (0 = no SLO: the class never preempts anyone)."""
+
+    priority: int = 0
+    ttft_slo_ms: float = 0.0
+
+
+class SchedulerV2Config(DeepSpeedConfigModel):
+    """``scheduler`` block: SLA-aware admission + preemption over the
+    SplitFuse loop.  A request names its class via ``generate(...,
+    sla=[...])``; unnamed requests ride the implicit ``default`` class
+    (priority 0, no SLO).  When a waiting request with a TTFT SLO has
+    burned ``preempt_margin`` of it and cannot be admitted (no sequence
+    slot / no KV blocks even after cache eviction), the scheduler
+    recompute-preempts the most recently admitted lower-priority running
+    request — the PR 7 token-exact fold-back machinery, now driven by a
+    policy instead of only pool deadlock."""
+
+    sla_classes: Dict[str, SLAClassConfig] = Field(default_factory=dict)
+    sla_preempt: bool = True
+    preempt_margin: float = 0.5     # fraction of ttft_slo_ms before preempting
 
 
 class V2TPConfig(DeepSpeedConfigModel):
@@ -115,6 +154,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     tensor_parallel: V2TPConfig = Field(default_factory=V2TPConfig)
     state_manager: DSStateManagerConfig = Field(
         default_factory=DSStateManagerConfig)
+    scheduler: SchedulerV2Config = Field(default_factory=SchedulerV2Config)
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     quant: V2QuantConfig = Field(default_factory=V2QuantConfig)
@@ -171,6 +211,12 @@ class _Request:
     # fenced first, so they reflect device completion; without it they
     # reflect host submission (a lower bound, disclosed in the docs).
     track: int = 0                         # trace tid for this request
+    # ---- SLA class (scheduler.sla_classes, named per request via
+    # generate(sla=[...])): priority orders admission and arms preemption
+    # of lower-priority running decoders when ttft_slo_ms is at risk
+    sla: str = "default"
+    priority: int = 0
+    ttft_slo_ms: float = 0.0
     t_arrival: Optional[float] = None
     t_admit: Optional[float] = None
     t_prefill_end: Optional[float] = None
@@ -356,7 +402,8 @@ class InferenceEngineV2:
         self.state = DSStateManager(
             max_tracked_sequences=sm.max_tracked_sequences,
             num_blocks=num_blocks, block_size=eff_bs,
-            max_seq_len=model_cfg.max_seq_len)
+            max_seq_len=model_cfg.max_seq_len,
+            prefix_cache=sm.prefix_cache)
         self.cache = PagedKVCache.create(model_cfg, num_blocks, eff_bs, dt,
                                          quant=sm.kv_quant)
         # ---- speculative decoding draft (greedy draft-and-verify) ----
@@ -462,6 +509,7 @@ class InferenceEngineV2:
         [S, vocab] so generate() can sample on device and ship only token ids
         over the wire (the logits row is 200 KB; a token id is 4 bytes)."""
         sm = self.config.state_manager
+        bs = self.state.block_size
         # validate BEFORE mutating any state (slots/blocks), so a rejected put
         # leaves the manager clean
         if len(set(uids)) != len(uids):
@@ -470,10 +518,17 @@ class InferenceEngineV2:
             # same KV slots, silently corrupting the sequence
             raise ValueError(f"duplicate uids in one put(): {list(uids)}")
         toks_np = [np.asarray(t, np.int32).reshape(-1) for t in tokens_list]
-        for uid, toks in zip(uids, toks_np):
-            if len(toks) > sm.max_q_per_seq:
+        # radix prefix match (peek only — nothing is acquired until the
+        # validation below passes): matched tokens of a NEW sequence alias
+        # cached blocks and never enter the scheduled batch, so every
+        # effective length/budget check uses the post-match suffix
+        matches, pinned, paths = self.state.peek_prefix_batch(
+            [None if self.state.get(uid) is not None else toks
+             for uid, toks in zip(uids, toks_np)])
+        for uid, toks, m in zip(uids, toks_np, matches):
+            if len(toks) - m > sm.max_q_per_seq:
                 raise ValueError(
-                    f"uid {uid}: {len(toks)} tokens exceeds max_q_per_seq="
+                    f"uid {uid}: {len(toks) - m} tokens exceeds max_q_per_seq="
                     f"{sm.max_q_per_seq}; split the prompt (SplitFuse) or use "
                     f"generate()")
             seen = (self.state.get(uid).seen_tokens
@@ -481,7 +536,7 @@ class InferenceEngineV2:
             if seen + len(toks) > self.model_config.max_seq_len:
                 raise ValueError(f"uid {uid} exceeds max_seq_len "
                                  f"{self.model_config.max_seq_len}")
-        total = sum(len(t) for t in toks_np)
+        total = sum(len(t) - m for t, m in zip(toks_np, matches))
         if total > sm.max_ragged_batch_size:
             raise ValueError(f"batch of {total} tokens exceeds ragged budget "
                              f"{sm.max_ragged_batch_size}; check query() first")
@@ -494,20 +549,44 @@ class InferenceEngineV2:
             raise RuntimeError(
                 f"{len(new_uids)} new sequences but only "
                 f"{self.state.free_sequence_slots} free slots; flush() first")
-        blocks_needed = sum(
-            (self.state.get(u).kv_blocks_needed(len(t), self.state.block_size)
-             if self.state.get(u) else -(-len(t) // self.state.block_size))
-            for u, t in zip(uids, toks_np))
-        if blocks_needed > self.state.allocator.free_blocks:
+        # fresh blocks plus the evictable supply the batch's matches would
+        # pin (unique across shared prefixes) — both come out of
+        # available_blocks
+        blocks_needed = pinned + sum(
+            (self.state.get(u).kv_blocks_needed(len(t), bs)
+             if self.state.get(u) else -(-len(t) // bs) - m // bs)
+            for u, t, m in zip(uids, toks_np, matches))
+        if blocks_needed > self.state.available_blocks:
             self.telemetry.alloc_failure("put")
             raise RuntimeError(
                 f"batch needs {blocks_needed} KV blocks but only "
-                f"{self.state.allocator.free_blocks} free; check query() first")
+                f"{self.state.available_blocks} free; check query() first")
         schedule = []
-        for uid, toks in zip(uids, toks_np):
-            seq = self.state.get(uid) or self.state.create(uid)
-            self.state.ensure_blocks(seq, len(toks))
+        for uid, toks, path in zip(uids, toks_np, paths):
+            seq = self.state.get(uid)
+            if seq is None:
+                seq = self.state.create(uid)
+                if self.state.radix is not None:
+                    seq.host_tokens = toks
+                    # reuse the validation walk: nothing mutated the trie
+                    # since peek_prefix_batch (creates only)
+                    matched = self.state.match_prefix(seq, toks, path=path)
+                    self.telemetry.prefix_lookup(matched)
+                    toks = toks[matched:]
+            elif (self.state.radix is not None
+                  and len(seq.host_tokens) == seq.seen_tokens):
+                # contiguous host-known content (prompt chunks, put-fed
+                # decode tokens) keeps extending the radix insert key; a
+                # device-fed gap permanently stops it.  (Cache off: no
+                # tracking at all — per-decode np.concatenate would make
+                # a long put()-driven generation quadratic for nothing.)
+                seq.host_tokens = np.concatenate([seq.host_tokens, toks])
             schedule.append((seq, toks))
+        # blocks are reserved only after EVERY match acquired its holders:
+        # an eviction triggered for one sequence must never reclaim blocks
+        # another sequence in this batch just matched
+        for seq, toks in schedule:
+            self.state.ensure_blocks(seq, len(toks))
         for _, toks in schedule:
             self.telemetry.tokens("prefill" if len(toks) > 1 else "decode",
                                   len(toks))
@@ -516,6 +595,10 @@ class InferenceEngineV2:
         logits = self._run(rb)
         for seq, toks in schedule:
             seq.seen_tokens += len(toks)
+            # index newly completed full blocks (content is host-known; the
+            # forward filling them is already in the dispatch chain, so any
+            # later reader is ordered behind the writer)
+            self.state.cache_insert(seq)
         self.telemetry.kv_sample(self.state)
         return logits
 
@@ -955,9 +1038,13 @@ class InferenceEngineV2:
         self.telemetry.kv_sample(self.state)
         used = (self.state.allocator.num_blocks
                 - self.state.allocator.free_blocks)
+        radix = self.state.radix
         return {
             "free_kv_blocks": self.state.allocator.free_blocks,
             "used_kv_blocks": used,
+            # supply a scheduler can count on: free + LRU-evictable cached
+            "available_kv_blocks": self.state.available_blocks,
+            "cached_kv_blocks": radix.node_count if radix is not None else 0,
             "free_sequence_slots": self.state.free_sequence_slots,
             "token_budget": sm.max_ragged_batch_size,
             "max_q_per_seq": sm.max_q_per_seq,
@@ -984,7 +1071,7 @@ class InferenceEngineV2:
                 blocks += -(-n // self.state.block_size)
             else:
                 blocks += seq.kv_blocks_needed(n, self.state.block_size)
-        ok = (blocks <= self.state.allocator.free_blocks
+        ok = (blocks <= self.state.available_blocks
               and slots <= self.state.free_sequence_slots)
         if not ok:
             self.telemetry.alloc_failure("can_schedule")
@@ -994,6 +1081,18 @@ class InferenceEngineV2:
         """reference engine_v2.flush :242."""
         for uid in uids:
             self.state.flush(uid)
+
+    def prefix_cached_tokens(self, prompt) -> int:
+        """Longest radix-cached block-aligned prefix of ``prompt`` resident
+        on THIS engine (tokens; 0 with the cache off).  Read-only — no LRU
+        stamps freshened, no references taken — and a pure host dict walk,
+        so the fleet router may probe it cross-thread for residency-aware
+        routing (``prefix_affinity``): a concurrent insert/evict can only
+        make the answer stale, never corrupt the walk."""
+        radix = self.state.radix
+        if radix is None:
+            return 0
+        return radix.peek(np.asarray(prompt, np.int32).reshape(-1))
 
     # ------------------------------- continuous batching (Dynamic SplitFuse)
     def _stream_fence(self, value) -> None:
@@ -1084,6 +1183,7 @@ class InferenceEngineV2:
                  max_new_tokens=32, seed: int = 0,
                  arrival_times: Optional[Sequence[float]] = None,
                  now_fn=None, stream: Optional[bool] = None,
+                 sla: Optional[Sequence[str]] = None,
                  **gen_overrides) -> List[np.ndarray]:
         """Serve a set of prompts to completion with continuous batching.
 
@@ -1115,6 +1215,13 @@ class InferenceEngineV2:
         must advance or an idle open loop spins).  ``stream`` fences each
         dispatch before timestamping (defaults to ``telemetry.stream_sync``)
         so TTFT/TPOT histograms reflect device completion.
+
+        sla: one ``scheduler.sla_classes`` name per prompt (default: the
+        implicit ``default`` class, priority 0, no SLO).  Priority orders
+        admission; a waiting request that has burned
+        ``scheduler.preempt_margin`` of its ``ttft_slo_ms`` and still
+        cannot be admitted preempts the most recently admitted
+        lower-priority running request (token-exact recompute fold-back).
         """
         gen = self.config.generation.model_copy(update=gen_overrides)
         self._serve_ctx = None   # never expose a PREVIOUS call's requests
@@ -1132,11 +1239,28 @@ class InferenceEngineV2:
         if (arrival_times is not None
                 and len(arrival_times) != len(prompts)):
             raise ValueError("arrival_times must match prompts")
+        sched_cfg = self.config.scheduler
+        classes = dict(sched_cfg.sla_classes)
+        classes.setdefault("default", SLAClassConfig())
+        if sla is not None and len(sla) != len(prompts):
+            raise ValueError("sla list must match prompts")
+        for name in (sla or ()):
+            if name not in classes:
+                raise ValueError(f"unknown SLA class {name!r}; expected one "
+                                 f"of {sorted(classes)}")
         t_start = now_fn()
         waiting = [
             _Request(uid=-(i + 1), prompt=np.asarray(p, np.int32).reshape(-1),
-                     max_new_tokens=m)
+                     max_new_tokens=m,
+                     sla=(sla[i] if sla is not None else "default"),
+                     priority=classes[sla[i] if sla is not None
+                                      else "default"].priority,
+                     ttft_slo_ms=classes[sla[i] if sla is not None
+                                         else "default"].ttft_slo_ms)
             for i, (p, m) in enumerate(zip(prompts, max_list))]
+        # SLA machinery only engages when some request actually differs from
+        # the default class — the legacy FIFO paths stay byte-identical
+        has_sla = any(r.priority != 0 or r.ttft_slo_ms > 0 for r in waiting)
         pool_blocks = self.state.allocator.num_blocks
         for i, r in enumerate(waiting):
             r.track = stel.new_track(f"req {i}")
@@ -1218,6 +1342,43 @@ class InferenceEngineV2:
                 self._finish_request(r)
             pending_finish.clear()
 
+        def preempt(victim: _Request, reason: str) -> None:
+            """Recompute-preempt one RUNNING request (the vLLM/FastGen
+            policy): free its blocks and re-queue it with its full folded
+            context; its re-prefill logits are not re-sampled (resume).
+            ``reason`` is ``starvation`` (pool deadlock — the only
+            pre-PR-15 trigger) or ``sla`` (a higher-priority waiting
+            request would miss its TTFT SLO).  Callers materialize first
+            so ``generated`` is exact at the fold."""
+            running.remove(victim)
+            kind = ("mid_prefill" if not victim.decode_ready
+                    else "decode_ready")
+            self.preempt_stats[kind] += 1
+            stel.preemption(kind)
+            if reason == "sla":
+                stel.sla_preemption(victim.sla)
+            victim.preempts += 1
+            if victim.decode_ready:
+                # fold generated-but-not-yet-refed tokens into the prompt
+                # exactly once (folded tracks prior preemptions; the last
+                # sampled token is NOT folded — it replays as a decode via
+                # held_token)
+                keep = victim.sampled - 1
+                new_ctx = victim.generated[victim.folded:keep]
+                if new_ctx:
+                    victim.prompt = np.concatenate(
+                        [victim.prompt, np.asarray(new_ctx, np.int32)])
+                victim.folded = keep
+                victim.resume = True
+                victim.held_token = victim.generated[keep]
+                victim.decode_ready = False
+            # else: preempted mid-(re-)prefill — folded/resume/held_token
+            # already describe everything sampled; recycle the request
+            # unchanged (a second fold here would reset the state and
+            # duplicate the held continuation token)
+            self.state.flush(victim.uid)
+            waiting.insert(0, victim)
+
         burst_sizes = (64, 32, 16, 8)
         while waiting or running or incoming:
             # ---- fleet hooks, once per scheduler round: the chaos site a
@@ -1253,6 +1414,43 @@ class InferenceEngineV2:
                 continue
             stel.kv_sample(self.state)
             stel.occupancy(len(running), S)
+            # ---- SLA-aware admission order + preemption.  Waiting sorts
+            # by priority (stable: FIFO within a class, and a preemption
+            # victim re-queued at the front keeps resuming first among its
+            # peers).  When the head has burned preempt_margin of its TTFT
+            # SLO and STILL cannot be admitted — no sequence slot, or no
+            # blocks even counting cache-evictable ones — the most recently
+            # admitted lower-priority running request is recompute-preempted
+            # for it (the policy behind serving_preemptions_total).
+            if has_sla and waiting:
+                waiting.sort(key=lambda r: -r.priority)
+                head = waiting[0]
+                lows = [r for r in running if r.priority < head.priority]
+                at_risk = (sched_cfg.sla_preempt and head.ttft_slo_ms > 0
+                           and (now - head.t_arrival) * 1e3
+                           >= sched_cfg.preempt_margin * head.ttft_slo_ms)
+                if lows and at_risk:
+                    m, pin = self.state.peek_prefix_pinned(head.prompt)
+                    # mirror the admission loop's chunk sizing exactly — a
+                    # probe sized to max_q_per_seq would preempt a victim
+                    # in rounds where the configured (smaller) chunk is
+                    # perfectly admissible
+                    first = min(len(head.prompt) - m, sm.max_q_per_seq,
+                                sm.max_ragged_batch_size,
+                                sm.prefill_chunk_tokens
+                                or sm.max_ragged_batch_size)
+                    need = (-(-(m + first) // self.state.block_size)
+                            - m // self.state.block_size + pin)
+                    if (self.state.free_sequence_slots == 0
+                            or need > self.state.available_blocks):
+                        if records:
+                            materialize()   # exact .generated at the fold
+                            continue        # (retirements may change sets)
+                        low_p = min(r.priority for r in lows)
+                        victim = [r for r in lows if r.priority == low_p][-1]
+                        stel.admission(head.sla, decision="preempted_for")
+                        preempt(victim, "sla")
+                        continue
             # ---- speculative draft-and-verify fast path: same eligibility
             # as the decode burst, preferred when a draft is loaded and
             # decoding is greedy.  Each outer step yields 1..gamma+1 tokens
@@ -1279,7 +1477,7 @@ class InferenceEngineV2:
                 while outer >= 1:
                     need = sum(self.state.get(r.uid).kv_blocks_needed(
                         outer * worst, self.state.block_size) for r in running)
-                    if need <= self.state.allocator.free_blocks:
+                    if need <= self.state.available_blocks:
                         break
                     outer //= 2
                 if outer >= 1:
@@ -1356,7 +1554,7 @@ class InferenceEngineV2:
                 while T >= burst_sizes[-1]:
                     need = sum(self.state.get(r.uid).kv_blocks_needed(
                         T, self.state.block_size) for r in running)
-                    if need <= self.state.allocator.free_blocks:
+                    if need <= self.state.available_blocks:
                         break
                     T //= 2
                 if T >= burst_sizes[-1]:
@@ -1388,6 +1586,11 @@ class InferenceEngineV2:
 
             budget = sm.max_ragged_batch_size
             seq_budget = sm.max_ragged_sequence_count   # per-step seq cap
+            # SplitFuse chunk bound: prompt-chunk tokens co-scheduled with
+            # decode this round — keeps the mixed dispatch short so live
+            # decoders' TPOT stays flat under long-prompt load
+            prefill_budget = (sm.prefill_chunk_tokens
+                              if sm.prefill_chunk_tokens else budget)
             sched_uids: List[int] = []
             sched_toks: List[np.ndarray] = []
             sched_fdev: List[bool] = []
@@ -1409,8 +1612,8 @@ class InferenceEngineV2:
                 # reserve the block NOW (allocator state advances with each
                 # reservation, so later checks see the true remaining pool);
                 # a decode that can't get a block defers to a later round
-                if (seq.kv_blocks_needed(1, self.state.block_size)
-                        > self.state.allocator.free_blocks):
+                need = seq.kv_blocks_needed(1, self.state.block_size)
+                if need and need > self.state.available_blocks:
                     stel.alloc_failure("decode")
                     continue
                 self.state.ensure_blocks(seq, 1)
@@ -1427,15 +1630,18 @@ class InferenceEngineV2:
                 budget -= 1
                 n_decode_toks += 1
 
-            # 2) prompt chunks fill the rest (running first, then admit new)
+            # 2) prompt chunks fill the rest (running first, then admit new),
+            #    bounded by the SplitFuse prefill_budget
             for r in list(running):
                 seq = self.state.get(r.uid)
                 if (seq is None or not seq.in_flight or budget <= 0
+                        or prefill_budget <= 0
                         or len(sched_uids) >= seq_budget):
                     continue
-                chunk = min(len(seq.pending), sm.max_q_per_seq, budget)
+                chunk = min(len(seq.pending), sm.max_q_per_seq, budget,
+                            prefill_budget)
                 need = seq.kv_blocks_needed(chunk, self.state.block_size)
-                if need > self.state.allocator.free_blocks:
+                if need and need > self.state.available_blocks:
                     stel.alloc_failure("prompt_chunk")
                     continue
                 self.state.ensure_blocks(seq, chunk)
@@ -1444,6 +1650,8 @@ class InferenceEngineV2:
                 sched_toks.append(toks)
                 sched_fdev.append(False)
                 n_prefill_toks += chunk
+                stel.prefill_chunk()
+                prefill_budget -= chunk
                 if not seq.in_flight:       # prompt complete -> decode next
                     r.decode_ready = True
                     newly_ready.append(r)
@@ -1454,26 +1662,46 @@ class InferenceEngineV2:
                         sampled_now.append(r)
                 budget -= chunk
 
-            while (waiting and budget > 0 and self.state.free_sequence_slots
+            while (waiting and budget > 0 and prefill_budget > 0
+                   and self.state.free_sequence_slots
                    and len(sched_uids) < seq_budget):
                 r = waiting[0]
-                chunk = min(len(r.prompt), sm.max_q_per_seq, budget)
-                if (-(-chunk // self.state.block_size)
-                        > self.state.allocator.free_blocks):
-                    stel.alloc_failure("admission")
-                    break
+                # radix prefix match FIRST (matching acquires the cached
+                # blocks, pinning them against eviction), THEN size and
+                # check the uncached suffix: after the match both the
+                # block need (kv_blocks_needed off the match boundary) and
+                # the supply (available_blocks no longer counts the pinned
+                # nodes) are exact, so an admitted request can never hit
+                # "KV cache exhausted" inside ensure_blocks.  On a
+                # shortfall the match is rolled back (flush releases the
+                # acquired holds) and the request retries next round.
                 waiting.pop(0)
                 seq = self.state.create(r.uid)
-                seq.pending = r.prompt
+                seq.host_tokens = r.prompt
+                matched = self.state.match_prefix(seq, r.prompt)
+                chunk = min(len(r.prompt) - matched, sm.max_q_per_seq,
+                            budget, prefill_budget)
+                need = seq.kv_blocks_needed(chunk, self.state.block_size)
+                if need > self.state.available_blocks:
+                    stel.alloc_failure("admission")
+                    self.state.flush(r.uid)
+                    waiting.insert(0, r)
+                    break
+                if self.state.radix is not None:
+                    stel.prefix_lookup(matched)
+                seq.pending = r.prompt[matched:]
                 self.state.ensure_blocks(seq, chunk)
                 running.append(r)
                 if r.t_admit is None:
                     r.t_admit = now_fn()
+                    stel.admission(r.sla)
                 toks, seq.pending = seq.pending[:chunk], seq.pending[chunk:]
                 sched_uids.append(r.uid)
                 sched_toks.append(toks)
                 sched_fdev.append(False)
                 n_prefill_toks += chunk
+                stel.prefill_chunk()
+                prefill_budget -= chunk
                 if not seq.in_flight:
                     r.decode_ready = True
                     newly_ready.append(r)
@@ -1487,39 +1715,12 @@ class InferenceEngineV2:
             if not sched_uids:
                 # nothing schedulable: first materialize (EOS retirement may
                 # free blocks), then preempt the most recently admitted
-                # sequence by RECOMPUTE — free its blocks and re-queue it with
-                # its full context (the vLLM/FastGen recompute-preemption
-                # policy); its re-prefill logits are not re-sampled (resume)
+                # sequence (pool starvation — the pre-SLA trigger)
                 if records:
                     materialize()
                     continue
                 if running:
-                    victim = running.pop()
-                    kind = ("mid_prefill" if not victim.decode_ready
-                            else "decode_ready")
-                    self.preempt_stats[kind] += 1
-                    stel.preemption(kind)
-                    victim.preempts += 1
-                    if victim.decode_ready:
-                        # fold generated-but-not-yet-refed tokens into the
-                        # prompt exactly once (folded tracks prior
-                        # preemptions; the last sampled token is NOT folded —
-                        # it replays as a decode via held_token)
-                        keep = victim.sampled - 1
-                        new_ctx = victim.generated[victim.folded:keep]
-                        if new_ctx:
-                            victim.prompt = np.concatenate(
-                                [victim.prompt, np.asarray(new_ctx, np.int32)])
-                        victim.folded = keep
-                        victim.resume = True
-                        victim.held_token = victim.generated[keep]
-                        victim.decode_ready = False
-                    # else: preempted mid-(re-)prefill — folded/resume/
-                    # held_token already describe everything sampled; recycle
-                    # the request unchanged (a second fold here would reset
-                    # the state and duplicate the held continuation token)
-                    self.state.flush(victim.uid)
-                    waiting.insert(0, victim)
+                    preempt(running[-1], "starvation")
                     continue
                 raise RuntimeError(
                     "scheduler deadlock: the KV pool cannot fit even one "
@@ -1536,6 +1737,10 @@ class InferenceEngineV2:
             tnow = now_fn()
             for r in newly_ready:
                 r.t_prefill_end = tnow
+                # index the completed prompt's full blocks into the radix:
+                # the forward that filled them was just dispatched, so any
+                # later program aliasing them is ordered behind the writer
+                self.state.cache_insert(self.state.get(r.uid))
             if pairs:
                 records.append(("step", prev, pairs))
             for r in sampled_now:

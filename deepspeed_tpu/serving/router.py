@@ -32,18 +32,21 @@ schedule is pinned by ``RouterConfig.seed``.
 
 Routing policies are pluggable (``POLICIES``): ``least_outstanding_tokens``
 (default) balances by the live token footprint per replica;
-``round_robin`` is the trivial baseline; ``prefix_affinity`` is the
-reserved hook for the future radix prefix cache — it sticky-routes
-requests sharing a prompt prefix to one replica (hash of the first
-``PREFIX_AFFINITY_TOKENS`` tokens) so a shared-prefix KV cache on that
-replica actually gets hit, falling back to least-outstanding when the
-sticky target is unhealthy.
+``round_robin`` is the trivial baseline; ``prefix_affinity`` routes on
+ACTUAL radix prefix-cache residency — each healthy replica's engine is
+probed for the request's longest cached prefix
+(``engine.prefix_cached_tokens``, a read-only host trie walk that is
+cross-thread safe) and the request goes to the replica holding the most
+of its prompt, least-outstanding-tokens breaking ties.  Replicas without
+a probe (cache off, fake engines) report 0, so a cache-less fleet
+degrades to exactly least-outstanding routing; under replica death the
+migrated request re-probes the survivors and re-prefills only its
+uncached suffix there (token-exact either way).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import zlib
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -51,10 +54,6 @@ import numpy as np
 from deepspeed_tpu.config import DeepSpeedConfigModel
 from deepspeed_tpu.runtime import faults
 from deepspeed_tpu.utils.logging import logger
-
-# prompt tokens hashed by the prefix-affinity policy (the future radix
-# cache's sticky key: requests sharing a system prompt land together)
-PREFIX_AFFINITY_TOKENS = 16
 
 
 class RequestFailed(RuntimeError):
@@ -147,16 +146,31 @@ def round_robin(req: FleetRequest, healthy: list, router: "Router",
 
 def prefix_affinity(req: FleetRequest, healthy: list, router: "Router",
                     rng) -> object:
-    """Reserved hook for the radix prefix cache ([serving_scale]): requests
-    sharing a prompt prefix sticky-route to one replica, so a future
-    shared-prefix KV cache held there multiplies instead of fragmenting
-    across the fleet.  Unhealthy sticky target falls back to
-    least-outstanding (correctness first; affinity is an optimization)."""
-    key = np.asarray(req.prompt[:PREFIX_AFFINITY_TOKENS],
-                     np.int32).tobytes()
-    pick = sorted(healthy, key=lambda rep: rep.name)[
-        zlib.crc32(key) % len(healthy)]
-    return pick
+    """Radix-residency routing ([serving_scale], closing the PR 7 stub):
+    probe every healthy replica's engine for the request's longest cached
+    prefix and send it where the most of its prompt is already resident —
+    those tokens skip prefill there entirely.  Ties (including the
+    cache-cold 0-everywhere case) break by least outstanding tokens, then
+    name, so an unprimed or cache-less fleet load-balances exactly like
+    the default policy.  The probe (``engine.prefix_cached_tokens``) is a
+    read-only host trie walk, safe to call from the dispatcher thread
+    while the replica worker serves; replicas without one report 0.
+    Affinity is an optimization, never a correctness gate: a dead
+    favorite simply isn't in ``healthy`` and the survivors re-prefill the
+    uncached suffix token-exact."""
+    def resident(rep) -> int:
+        probe = getattr(getattr(rep, "engine", None),
+                        "prefix_cached_tokens", None)
+        if probe is None:
+            return 0
+        try:
+            return int(probe(req.prompt))
+        except Exception:  # noqa: BLE001 — a dying replica's probe must
+            return 0       # never take the dispatcher down with it
+    return min(healthy,
+               key=lambda rep: (-resident(rep),
+                                router.outstanding_tokens(rep.name),
+                                rep.name))
 
 
 POLICIES: Dict[str, Callable] = {

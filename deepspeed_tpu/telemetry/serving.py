@@ -141,6 +141,32 @@ class ServingTelemetry:
         self.g_kv_frag = reg.gauge(
             "kv_pool_fragmentation", "internal fragmentation of allocated "
             "KV blocks: 1 - live tokens / (allocated blocks * block size)")
+        # ---- radix shared-prefix cache + SplitFuse scheduler (PR 15):
+        # the control-loop families layered over the PR 5 pool signals
+        self.c_prefix_lookups = reg.counter(
+            "kv_prefix_lookups_total", "radix prefix-cache lookups taken "
+            "at sequence admission (one per new sequence while the cache "
+            "is enabled)")
+        self.c_prefix_hits = reg.counter(
+            "kv_prefix_hit_tokens_total", "prompt tokens whose KV was "
+            "served by aliasing shared radix-cache blocks — prefill "
+            "skipped for every one of them")
+        self.g_shared_blocks = reg.gauge(
+            "kv_shared_blocks", "KV blocks resident in the radix prefix "
+            "cache, per state (cached = indexed total / shared = also "
+            "held by a live sequence / evictable = reclaimable by LRU "
+            "eviction right now)")
+        self.c_prefill_chunks = reg.counter(
+            "prefill_chunks_total", "prompt chunks the SplitFuse "
+            "scheduler co-scheduled with decode tokens (one per chunk "
+            "per round, bounded by prefill_chunk_tokens)")
+        self.c_admissions = reg.counter(
+            "serving_admissions_total", "engine admission decisions, per "
+            "SLA class and decision (admitted / preempted_for)")
+        self.c_sla_preempt = reg.counter(
+            "serving_sla_preemptions_total", "recompute preemptions the "
+            "SLA policy took to protect a higher-priority request's TTFT "
+            "SLO, per victim SLA class")
         self.c_spec_outer = reg.counter(
             "spec_outer_steps_total", "speculative draft-and-verify outer "
             "steps executed, summed over sequences")
@@ -265,6 +291,27 @@ class ServingTelemetry:
         if self.enabled:
             self.c_preempt.inc(1, kind=kind, **self.labels)
 
+    def sla_preemption(self, sla: str) -> None:
+        if self.enabled:
+            self.c_sla_preempt.inc(1, sla=sla, **self.labels)
+
+    def admission(self, sla: str, decision: str = "admitted") -> None:
+        if self.enabled:
+            self.c_admissions.inc(1, sla=sla, decision=decision,
+                                  **self.labels)
+
+    def prefix_lookup(self, hit_tokens: int) -> None:
+        """One radix-cache admission lookup; ``hit_tokens`` is the matched
+        prefix length actually aliased (0 on a miss)."""
+        if self.enabled:
+            self.c_prefix_lookups.inc(1, **self.labels)
+            if hit_tokens:
+                self.c_prefix_hits.inc(hit_tokens, **self.labels)
+
+    def prefill_chunk(self) -> None:
+        if self.enabled:
+            self.c_prefill_chunks.inc(1, **self.labels)
+
     def occupancy(self, running: int, slots: int) -> None:
         if self.enabled and slots:
             self.g_occupancy.set(running / slots, **self.labels)
@@ -298,6 +345,15 @@ class ServingTelemetry:
         self.g_kv_frag.set(
             1.0 - live_tokens / alloc_tokens if alloc_tokens else 0.0,
             **self.labels)
+        radix = getattr(state, "radix", None)
+        if radix is not None:
+            st = radix.stats()
+            self.g_shared_blocks.set(st["nodes"], state="cached",
+                                     **self.labels)
+            self.g_shared_blocks.set(st["shared"], state="shared",
+                                     **self.labels)
+            self.g_shared_blocks.set(st["evictable"], state="evictable",
+                                     **self.labels)
 
     # -------------------------------------------------------- speculative
 

@@ -36,6 +36,15 @@ Scan targets (each file gets the pattern matching its hazard class):
   drain/warmup, but each must be a disclosed ``# sync-ok`` site: an
   undisclosed fence creeping in here silently stretches the preemption
   window (the time between the notice and the final committed export).
+- ``deepspeed_tpu/inference/v2/ragged.py`` radix prefix cache + state
+  manager (match/insert/evict/accounting) — ``device_get`` /
+  ``block_until_ready``: prefix matching runs INSIDE the decode
+  scheduler on every admission, so it must stay a pure host trie walk;
+  a device sync here would serialize serving exactly where the radix
+  cache is supposed to speed it up.  (The engine never needs the cached
+  pages' VALUES on the host: content keys come from the tokens it fed
+  in, and aliased reads are ordered behind their writer by the
+  donated-cache dispatch chain.)
 - ``deepspeed_tpu/serving/router.py`` (every routing/retry/migration
   method) and ``deepspeed_tpu/serving/fleet.py`` dispatcher loop
   (``serve``/``_tick``/event + supervision handlers) — ``device_get`` /
@@ -86,6 +95,8 @@ SERVING_PATH = os.path.join(REPO, "deepspeed_tpu", "inference", "v2",
                             "engine_v2.py")
 RESILIENCE_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
                                "resilience.py")
+RAGGED_PATH = os.path.join(REPO, "deepspeed_tpu", "inference", "v2",
+                           "ragged.py")
 ROUTER_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "router.py")
 FLEET_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "fleet.py")
 GUARDIAN_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
@@ -104,6 +115,38 @@ SERVING_FUNCS = {
     "_step_sampled",
     "_stream_fence",
     "_finish_request",
+    "_put_device",
+    "prefix_cached_tokens",
+}
+
+# the radix prefix cache + state manager: every method the decode
+# scheduler calls per admission/round (matching, insertion, eviction,
+# accounting) plus the cross-thread router probe — all pure host
+# dict/deque walks by design
+RAGGED_FUNCS = {
+    "match",
+    "peek",
+    "insert",
+    "evict",
+    "evictable_blocks",
+    "evictable_set",
+    "_nodes",
+    "_evictable_leaves",
+    "stats",
+    "match_prefix",
+    "peek_prefix_pinned",
+    "peek_prefix_batch",
+    "_capped_path",
+    "touch",
+    "_walk",
+    "cache_insert",
+    "ensure_blocks",
+    "available_blocks",
+    "allocate",
+    "acquire",
+    "release",
+    "create",
+    "flush",
 }
 # (the serving target scans transfers only — TRANSFER_PATTERN below: the
 # loop stages host numpy arrays with np.asarray all over, which is not a
@@ -203,6 +246,7 @@ SCAN_TARGETS = [
     (PREFETCH_PATH, {"__next__", "close"}, BLOCKING_PATTERN, ALLOW_PATTERN),
     (CKPT_PATH, {"save_train_state"}, CKPT_PATTERN, ALLOW_PATTERN),
     (SERVING_PATH, SERVING_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
+    (RAGGED_PATH, RAGGED_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (RESILIENCE_PATH, {"drain", "resume", "warm_resume"},
      RESILIENCE_PATTERN, ALLOW_PATTERN),
     (ROUTER_PATH, ROUTER_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),

@@ -217,21 +217,32 @@ class TestRouterPolicies:
         picks = [r.pick(req, reps).name for _ in range(6)]
         assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
 
-    def test_prefix_affinity_sticky_and_fallback(self):
+    def test_prefix_affinity_residency_and_fallback(self):
+        """prefix_affinity routes on ACTUAL radix residency (PR 15, PR 7
+        stub closed): the replica whose engine reports the longest cached
+        prefix wins; probe-less replicas report 0 and the policy degrades
+        to deterministic least-outstanding routing."""
+        class _Eng:
+            def __init__(self, resident):
+                self._n = resident
+
+            def prefix_cached_tokens(self, prompt):
+                return min(self._n, len(prompt))
         r = _mk_router(policy="prefix_affinity")
         reps = [_FakeReplica(f"r{i}") for i in range(3)]
+        reps[1].engine = _Eng(16)
+        reps[2].engine = _Eng(8)
         p = np.arange(20, dtype=np.int32)
         reqs = [FleetRequest(index=i, prompt=p.copy(), max_new_tokens=4)
                 for i in range(4)]
         picks = {r.pick(q, reps).name for q in reqs}
-        assert len(picks) == 1                # shared prefix -> one replica
-        other = FleetRequest(index=9, prompt=p[::-1].copy(),
-                             max_new_tokens=4)
-        r.pick(other, reps)                   # different prefix: any pick ok
-        # sticky target unhealthy -> still routes (to a survivor)
-        sticky = picks.pop()
-        healthy = [x for x in reps if x.name != sticky]
-        assert r.pick(reqs[0], healthy).name != sticky
+        assert picks == {"r1"}          # most resident prefix wins
+        # the favorite dying -> next-best survivor, never an error
+        healthy = [x for x in reps if x.name != "r1"]
+        assert r.pick(reqs[0], healthy).name == "r2"
+        # cache-cold/probe-less fleet: deterministic fallback pick
+        bare = [_FakeReplica(f"b{i}") for i in range(3)]
+        assert r.pick(reqs[0], bare).name == "b0"
 
     def test_unknown_policy_raises(self):
         with pytest.raises(ValueError, match="unknown routing policy"):
