@@ -111,6 +111,28 @@ def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
     return sum(len(o) for o in outs) / dt
 
 
+def _open_loop_run(serve_fn, prompts, budgets, rate, seed=11,
+                   before_serve=None):
+    """The open-loop core every Poisson leg shares (single-engine open
+    loop / arrival sweep, fleet chaos, disagg-vs-unified): draw the
+    seeded exponential inter-arrival process up front — deterministic,
+    so two legs at the same (rate, seed) replay the IDENTICAL arrival
+    trace — then time one serve through ``serve_fn(prompts, budgets,
+    arrivals)``.  ``before_serve(arrivals)`` runs after the draw and
+    before the clock starts (the chaos leg arms its kill timer there,
+    since the kill offset is derived from the arrival span).  Returns
+    ``(outs, wall_s, arrivals)``."""
+    arr_rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(arr_rng.exponential(1.0 / rate,
+                                             size=len(prompts)))
+    if before_serve is not None:
+        before_serve(arrivals)
+    t0 = time.perf_counter()
+    outs = serve_fn(prompts, budgets, arrivals)
+    dt = time.perf_counter() - t0
+    return outs, dt, arrivals
+
+
 def run_open_loop(cfg, params, prompts, budgets, rate, slo_ttft_ms,
                   slo_tpot_ms, out_dir, block_size=64, seed=11):
     """Open-loop Poisson arrival leg: requests hit the engine at seeded
@@ -127,13 +149,10 @@ def run_open_loop(cfg, params, prompts, budgets, rate, slo_ttft_ms,
     eng = make_v2(cfg, params, block_size=block_size, stream_sync=True)
     eng.generate(prompts, max_new_tokens=budgets)       # warm the compile set
     stel = reset_telemetry(eng)
-    arr_rng = np.random.default_rng(seed)
-    arrivals = np.cumsum(arr_rng.exponential(1.0 / rate,
-                                             size=len(prompts)))
-    t0 = time.perf_counter()
-    outs = eng.generate(prompts, max_new_tokens=budgets,
-                        arrival_times=arrivals)
-    dt = time.perf_counter() - t0
+    outs, dt, _ = _open_loop_run(
+        lambda p, b, arr: eng.generate(p, max_new_tokens=b,
+                                       arrival_times=arr),
+        prompts, budgets, rate, seed=seed)
     total = sum(len(o) for o in outs)
     # joint SLO attainment per request; a one-token completion has no
     # inter-token intervals (tpot_ms is None) and meets the TPOT SLO
@@ -334,24 +353,31 @@ def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
                                  "heartbeat_deadline_s": 60.0,
                                  "router": {"max_retries": int(replicas)
                                             + 1}})
-    arr_rng = np.random.default_rng(seed)
-    arrivals = np.cumsum(arr_rng.exponential(1.0 / rate,
-                                             size=len(prompts)))
-    if kill_at is None:
-        # mid-load by construction: ~35% into the arrival process
-        kill_at = 0.35 * float(arrivals[-1])
-    timer = threading.Timer(
-        kill_at, lambda: faults.inject("replica.mid_decode", "exc"))
+    state = {"timer": None, "t0": None}
+
+    def arm_kill(arrivals):
+        nonlocal kill_at
+        if kill_at is None:
+            # mid-load by construction: ~35% into the arrival process
+            kill_at = 0.35 * float(arrivals[-1])
+        state["timer"] = threading.Timer(
+            kill_at, lambda: faults.inject("replica.mid_decode", "exc"))
+        state["t0"] = fleet.clock()
+        state["timer"].start()
+
     try:
         # one warm pass compiles the SHARED step cache for every replica
         fleet.serve(prompts, max_new_tokens=budgets, max_wall_s=1800)
-        t0 = fleet.clock()
-        timer.start()
-        outs = fleet.serve(prompts, max_new_tokens=budgets,
-                           arrival_times=arrivals, max_wall_s=1800)
+        outs, _, _ = _open_loop_run(
+            lambda p, b, arr: fleet.serve(p, max_new_tokens=b,
+                                          arrival_times=arr,
+                                          max_wall_s=1800),
+            prompts, budgets, rate, seed=seed, before_serve=arm_kill)
+        t0 = state["t0"]
         t_end = fleet.clock()
     finally:
-        timer.cancel()
+        if state["timer"] is not None:
+            state["timer"].cancel()
         faults.reset()      # never leak an unconsumed kill into later legs
         fleet.shutdown()
     assert all(o is not None for o in outs), "fleet lost a request"
@@ -413,6 +439,121 @@ def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
         "fleet_requests_completed": len(log),
         "fleet_trace": fleet_trace,
     }
+
+
+def run_disagg(cfg, params, prompts, budgets, rate, replicas,
+               slo_ttft_ms, slo_tpot_ms, block_size=64, seed=11):
+    """Disaggregated-vs-unified leg at EQUAL replica count: the same
+    open-loop Poisson arrival trace served twice through the fleet —
+    once by a unified pool of N interchangeable replicas, once by a
+    prefill/decode split (1 prefill, N-1 decode) with KV block handoff
+    and the pool autoscaler armed.  Greedy outputs must be
+    byte-identical between the two (the handoff fold is token-exact).
+
+    Goodput definitions are phase-honest: the unified fleet API returns
+    a request only at completion, so its user-visible TTFT is
+    ``t_done - t_arrival``; the disagg fleet stamps ``t_first`` at the
+    prefill->decode handoff (the first token exists and is surfaced to
+    the router there), so disagg TTFT is ``t_first - t_arrival`` and
+    TPOT is ``(t_done - t_first) / (tokens - 1)``.
+
+    The autoscaler's rebalance path is exercised deterministically: a
+    synthetic prefill-starved skew is seeded into the serving histograms
+    before the timed pass (CPU smoke timings are too noisy to trip the
+    thresholds reliably), so ``pool_rebalances_total`` lands >= 1 and
+    the warm role flip runs under bench conditions.  Both fleets run
+    with at least 3 replicas (still an equal-count comparison): a
+    2-replica split is 1 prefill + 1 decode with BOTH pools at their
+    min floor, so the autoscaler has no donor and the rebalance path
+    would never execute."""
+    from deepspeed_tpu.serving import ServingFleet
+
+    replicas = max(3, int(replicas))
+
+    ecfg = {"state_manager": {
+        "max_tracked_sequences": SLOTS,
+        "max_ragged_batch_size": TOKEN_BUDGET,
+        "max_ragged_sequence_count": SLOTS,
+        "max_q_per_seq": 512,
+        "kv_block_size": block_size},
+        "generation": {"do_sample": False}}
+    base_fcfg = {"num_replicas": int(replicas), "respawn": False,
+                 "warmup_deadline_s": 600.0, "heartbeat_deadline_s": 60.0,
+                 "router": {"max_retries": int(replicas) + 1}}
+    out, outputs = {}, {}
+    for label in ("unified", "disagg"):
+        fcfg = dict(base_fcfg)
+        if label == "disagg":
+            fcfg.update({"disaggregated": True, "prefill_replicas": 1,
+                         "autoscale": {"enabled": True, "interval_s": 0.0,
+                                       "cooldown_s": 1e9,
+                                       "min_requests": 1}})
+        fleet = ServingFleet(cfg, engine_config=ecfg, params=params,
+                             config=fcfg)
+        try:
+            # warm pass compiles the shared step cache for BOTH roles
+            fleet.serve(prompts, max_new_tokens=budgets, max_wall_s=1800)
+            if label == "disagg":
+                h_ttft = fleet.registry.histogram("serving_ttft_ms", "t")
+                h_tpot = fleet.registry.histogram("serving_tpot_ms", "t")
+                for _ in range(64):
+                    h_ttft.observe(10_000.0, replica="synthetic")
+                    h_tpot.observe(1.0, replica="synthetic")
+            outs, dt, _ = _open_loop_run(
+                lambda p, b, arr: fleet.serve(p, max_new_tokens=b,
+                                              arrival_times=arr,
+                                              max_wall_s=1800),
+                prompts, budgets, rate, seed=seed)
+            outputs[label] = outs
+            good = total = 0
+            ttfts = []
+            for r in fleet.request_log:
+                total += r["generated_tokens"]
+                if label == "disagg" and r["t_first"] is not None:
+                    ttft_ms = (r["t_first"] - r["t_arrival"]) * 1e3
+                    span = max(r["t_done"] - r["t_first"], 0.0)
+                    tpot_ms = (span / (r["generated_tokens"] - 1) * 1e3
+                               if r["generated_tokens"] > 1 else None)
+                else:
+                    ttft_ms = (r["t_done"] - r["t_arrival"]) * 1e3
+                    tpot_ms = None
+                ttfts.append(ttft_ms)
+                if ttft_ms <= slo_ttft_ms and (tpot_ms is None
+                                               or tpot_ms <= slo_tpot_ms):
+                    good += r["generated_tokens"]
+            out[f"{label}_goodput_tokens_per_sec"] = round(good / dt, 1)
+            out[f"{label}_tokens_per_sec"] = round(total / dt, 1)
+            out[f"{label}_ttft_p99_ms"] = round(
+                float(np.quantile(ttfts, 0.99)) if ttfts else 0.0, 2)
+            if label == "disagg":
+                reg = fleet.registry._metrics
+                out["kv_handoff_bytes_total"] = reg[
+                    "kv_handoff_bytes_total"].value()
+                out["disagg_handoffs_ok"] = reg[
+                    "fleet_handoffs_total"].value(outcome="ok")
+                out["pool_rebalances_total"] = sum(
+                    v for _, v in reg["pool_rebalances_total"].samples())
+        finally:
+            fleet.shutdown()
+    for a, b in zip(outputs["unified"], outputs["disagg"]):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "disaggregation changed greedy output (must be byte-identical)"
+    ug = out["unified_goodput_tokens_per_sec"]
+    dg = out["disagg_goodput_tokens_per_sec"]
+    if ug <= 0.0 and dg <= 0.0:
+        # CPU smoke: compile-dominated latencies blow the SLO for BOTH
+        # fleets, making 0/0 uninformative.  Fall back to the raw
+        # throughput ratio so the regression column still tracks the
+        # disagg path's health; the fallback is disclosed in the extras.
+        out["disagg_goodput_ratio"] = round(
+            out["disagg_tokens_per_sec"]
+            / max(out["unified_tokens_per_sec"], 1e-9), 3)
+        out["disagg_goodput_ratio_source"] = "tokens_per_sec_fallback"
+    else:
+        out["disagg_goodput_ratio"] = round(dg / max(ug, 1e-9), 3)
+        out["disagg_goodput_ratio_source"] = "slo_goodput"
+    out["disagg_replicas"] = int(replicas)
+    return out
 
 
 def run_v1(cfg, params, prompts, budgets):
@@ -525,16 +666,19 @@ def train_memorized(cfg, pool, steps, lr=3e-3, micro=8, stop_loss=None):
 
 
 def run_spec(cfg, params, dcfg, dparams, prompts, budgets, block_size=64,
-             profile=False):
+             profile=False, batch=True):
     """Speculative-decoding leg (round-3 verdict item 5): same ragged engine,
     greedy draft-and-verify with a smaller draft.  Acceptance/timing comes
     from the engine's serving-telemetry counters (spec_*_total — the old
     ``eng.spec_stats`` dict is gone).  ``profile=True`` runs the split
     draft/verify programs with per-side wall timing (token-identical,
-    slower — attribution, not throughput).  Returns (tokens/s,
-    spec_summary dict)."""
+    slower — attribution, not throughput).  ``batch=False`` disables
+    cross-request batching (one draft/verify dispatch per request — the
+    pre-batching behavior, the baseline ``spec_batched_speedup_x``
+    divides by).  Returns (tokens/s, spec_summary dict)."""
     eng = make_v2(cfg, params, block_size=block_size,
-                  spec={"profile": bool(profile)},
+                  spec={"profile": bool(profile),
+                        "batch_across_requests": bool(batch)},
                   draft_model=dcfg, draft_params=dparams)
     eng.generate(prompts, max_new_tokens=budgets)          # warm compile
     stel = reset_telemetry(eng)
@@ -597,9 +741,19 @@ def spec_leg(smoke=False):
     budgets = [64] * nreq
     base_tps = run_v2(scfg, tparams, prompts, budgets)
     spec_tps, st = run_spec(scfg, tparams, sdcfg, dparams, prompts, budgets)
+    # cross-request batching ablation: the SAME spec config with one
+    # draft/verify dispatch per request — tokens are identical (the tests
+    # pin it), only the dispatch count and wall clock move
+    per_req_tps, pst_per = run_spec(scfg, tparams, sdcfg, dparams, prompts,
+                                    budgets, batch=False)
     out["spec_tokens_per_sec"] = round(spec_tps, 1)
     out["spec_target_only_tokens_per_sec"] = round(base_tps, 1)
     out["spec_speedup"] = round(spec_tps / base_tps, 3)
+    out["spec_per_request_tokens_per_sec"] = round(per_req_tps, 1)
+    out["spec_batched_speedup_x"] = round(spec_tps / max(per_req_tps, 1e-9),
+                                          3)
+    out["spec_batched_dispatches"] = st.get("spec_dispatches", 0.0)
+    out["spec_per_request_dispatches"] = pst_per.get("spec_dispatches", 0.0)
     out["spec_accepted_per_verify"] = round(st.get("emitted_per_outer", 0.0),
                                             2)
     out["spec_accept_ratio"] = round(st.get("accept_ratio", 0.0), 3)
@@ -758,11 +912,17 @@ def main(argv=None):
     # router, one replica killed mid-load (no respawn) — goodput must
     # degrade toward (N-1)/N, not cliff, with zero lost/duplicated requests
     fleet_leg = {}
+    disagg_leg = {}
     if args.replicas >= 2:
         fleet_leg = leg("fleet_chaos", lambda: run_fleet_chaos(
             cfg, params, prompts, budgets, rate, args.replicas,
             kill_at=args.kill_replica_at,
             out_dir=args.telemetry_out)) or {}
+        # disagg-vs-unified at equal replica count: same arrival trace,
+        # byte-identical outputs asserted inside, goodput ratio out
+        disagg_leg = leg("disagg", lambda: run_disagg(
+            cfg, params, prompts, budgets, rate, args.replicas,
+            args.slo_ttft_ms, args.slo_tpot_ms)) or {}
 
     extra = {"static_batch_tokens_per_sec": round(v1_tps, 1),
              "telemetry_off_tokens_per_sec": round(v2_notel_tps, 1),
@@ -784,6 +944,7 @@ def main(argv=None):
     extra.update(prefix_leg)
     extra.update(chunk_leg)
     extra.update(fleet_leg)
+    extra.update(disagg_leg)
     try:
         extra.update(spec_leg(smoke=smoke))
     except Exception as e:  # noqa: BLE001 — the leg must not kill the bench

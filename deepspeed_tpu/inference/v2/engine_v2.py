@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -127,6 +127,15 @@ class SpeculativeConfig(DeepSpeedConfigModel):
     # (same acceptance functions) but slower (2 dispatches + sync per outer
     # step IS the measurement), so it's a profiling knob, not a serving mode
     profile: bool = False
+    # serving default: ONE draft+verify dispatch covers every running
+    # request (the spec program is slot-wide with an active mask, so the
+    # per-dispatch floor — launch + host sync for the acceptance counts —
+    # amortizes over the whole decode batch).  False dispatches each
+    # request alone through the SAME compiled program (inactive lanes pass
+    # their prev-token state through untouched, so the sequential runs are
+    # token-identical to the batched one) — the per-request baseline the
+    # bench's spec_batched_speedup_x compares against, not a serving mode
+    batch_across_requests: bool = True
 
 
 class V2QuantConfig(DeepSpeedConfigModel):
@@ -1094,6 +1103,35 @@ class InferenceEngineV2:
             return 0
         return radix.peek(np.asarray(prompt, np.int32).reshape(-1))
 
+    def prefix_block_handles(self, prompt) -> Tuple[List[int], int]:
+        """(pool block ids, matched token count) of ``prompt``'s longest
+        radix-cached block-aligned prefix — the disaggregated fleet's
+        KV-handoff probe.  Read-only like :meth:`prefix_cached_tokens`;
+        the caller (the fleet dispatcher) pins the blocks with
+        ``state.allocator.acquire`` — atomic validate-then-bump, so a
+        block a concurrent evict freed between walk and pin raises there
+        and the handoff degrades to accounting-free, never to a
+        corrupted refcount.  ([], 0) with the cache off."""
+        radix = self.state.radix
+        if radix is None:
+            return [], 0
+        return radix.peek_blocks(np.asarray(prompt, np.int32).reshape(-1))
+
+    def kv_block_bytes(self) -> int:
+        """Device bytes one KV pool block holds (K + V across layers at
+        the serving dtype) — the unit the fleet's stubbed multi-host
+        handoff copy path accounts ``kv_handoff_bytes_total`` in.  An
+        approximation by design: kv-quant stores int8 codes + scales, but
+        the accounting models the FUTURE wire transfer, not today's
+        resident bytes."""
+        mc = self.model_config
+        try:
+            itemsize = int(np.dtype(self.config.jnp_dtype).itemsize)
+        except TypeError:       # bfloat16 without a numpy extension
+            itemsize = 2
+        return int(2 * mc.num_layers * mc.kv_heads * self._block_size
+                   * mc.head_dim * itemsize)
+
     # ------------------------------- continuous batching (Dynamic SplitFuse)
     def _stream_fence(self, value) -> None:
         """Streaming-latency mode (``telemetry.stream_sync`` / the
@@ -1463,35 +1501,52 @@ class InferenceEngineV2:
                             for r in running)):
                 sp = self.config.speculative
                 worst = sp.gamma + 1            # tokens per outer step, max
-                need_max = max(r.max_new_tokens - r.sampled for r in running)
-                cap = min(self.model_config.max_seq_len
-                          - self.state.get(r.uid).seen_tokens
-                          for r in running)
-                # size for ~half acceptance (2x the full-acceptance need),
-                # then round DOWN to a power of two so the compile cache
-                # holds at most log2(outer_steps) spec programs
-                outer = min(sp.outer_steps, 2 * -(-need_max // worst),
-                            cap // worst)
-                if outer >= 1:
-                    outer = 1 << (outer.bit_length() - 1)
-                while outer >= 1:
-                    need = sum(self.state.get(r.uid).kv_blocks_needed(
-                        outer * worst, self.state.block_size) for r in running)
-                    if need <= self.state.available_blocks:
-                        break
-                    outer //= 2
-                if outer >= 1:
-                    n_before = len(running)
-                    materialize()               # keep .generated chronological
-                    if len(running) != n_before:
-                        continue    # EOS retirements changed the set (maybe
-                        # to empty) — recompute eligibility and sizing
+                n_before = len(running)
+                materialize()                   # keep .generated chronological
+                if len(running) != n_before:
+                    continue        # EOS retirements changed the set (maybe
+                    # to empty) — recompute eligibility and sizing
+                # batched mode: the whole running set in one dispatch.
+                # Per-request baseline (batch_across_requests=False): one
+                # dispatch per request through the SAME slot-wide program —
+                # a request finishing mid-round simply drops out of later
+                # groups; inactive lanes pass prev through, so the token
+                # stream is identical either way
+                groups = ([list(running)] if sp.batch_across_requests
+                          else [[r] for r in list(running)])
+                ran_any = False
+                for grp in groups:
+                    grp = [r for r in grp if r in running]
+                    if not grp:
+                        continue
+                    need_max = max(r.max_new_tokens - r.sampled for r in grp)
+                    cap = min(self.model_config.max_seq_len
+                              - self.state.get(r.uid).seen_tokens
+                              for r in grp)
+                    # size for ~half acceptance (2x the full-acceptance
+                    # need), then round DOWN to a power of two so the
+                    # compile cache holds at most log2(outer_steps) spec
+                    # programs
+                    outer = min(sp.outer_steps, 2 * -(-need_max // worst),
+                                cap // worst)
+                    if outer >= 1:
+                        outer = 1 << (outer.bit_length() - 1)
+                    while outer >= 1:
+                        need = sum(self.state.get(r.uid).kv_blocks_needed(
+                            outer * worst, self.state.block_size)
+                            for r in grp)
+                        if need <= self.state.available_blocks:
+                            break
+                        outer //= 2
+                    if outer < 1:
+                        continue
+                    ran_any = True
                     pairs = [(r.uid, self.state.get(r.uid).slot)
-                             for r in running]
+                             for r in grp]
                     toks_h, counts_h, prev, rng = self._run_spec(
-                        running, outer, sp.gamma, gen, prev, rng)
+                        grp, outer, sp.gamma, gen, prev, rng)
                     tnow = now_fn()     # _run_spec synced: completion time
-                    for r, (uid, sl) in zip(list(running), pairs):
+                    for r, (uid, sl) in zip(list(grp), pairs):
                         total = int(counts_h[:, sl].sum())
                         self.state.get(uid).seen_tokens += total
                         vals = []
@@ -1509,6 +1564,7 @@ class InferenceEngineV2:
                             self.flush([r.uid])
                             running.remove(r)
                             self._finish_request(r)
+                if ran_any:
                     continue
 
             # ---- decode-burst fast path: every running sequence is in pure

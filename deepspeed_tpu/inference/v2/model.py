@@ -903,6 +903,16 @@ def speculative_burst(params, draft_params, cache: PagedKVCache,
     baseline, so near-tied logits can argmax differently on low-precision
     hardware.  The tests pin exactness on fp32 configs.  See
     _speculative_burst_core.
+
+    Inactive-lane contract: slots outside ``batch["active"]`` pass their
+    ``prev_tokens`` state through untouched (``counts`` 0, KV unwritten) —
+    each lane's trajectory depends only on its own slot state, never on
+    which OTHER lanes share the dispatch.  The engine's cross-request
+    batching (SpeculativeConfig.batch_across_requests) leans on exactly
+    this: one all-requests dispatch and a sequence of one-request
+    dispatches through this same program are token-identical, which is
+    what makes the batched/per-request comparison a fair dispatch-count
+    experiment rather than two different decoders.
     Returns (toks, counts, prev', cache', draft_cache')."""
     toks, counts, prev, _, cache, draft_cache = _speculative_burst_core(
         params, draft_params, cache, draft_cache, batch, prev_tokens,

@@ -36,6 +36,7 @@ book ``kv_alloc_failures_total``.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -52,12 +53,22 @@ class BlockedAllocator:
     sharing); ``release`` drops one holder and returns a block to the
     free deque only when its LAST holder lets go.  ``free`` stays as an
     alias of ``release`` for the pre-radix exclusive-ownership callers.
+
+    Refcount transitions take a lock: the engine mutates the pool from
+    its replica worker thread while the fleet dispatcher pins/unpins
+    KV-handoff blocks (serving/fleet.py) on the same allocator, and an
+    interleaved ``_ref[b] -= 1`` is not atomic in CPython — a torn
+    decrement would corrupt the refcount and either leak the block or
+    free it under a live holder.  Single-threaded engines pay one
+    uncontended lock per TRANSITION (not per token), which is noise
+    next to the dict walks around it.
     """
 
     def __init__(self, num_blocks: int):
         self.num_blocks = int(num_blocks)
         self._free: Deque[int] = deque(range(num_blocks))
         self._ref: List[int] = [0] * num_blocks
+        self._lock = threading.Lock()
         # bumped on every refcount transition: the radix caches its
         # evictable-count DFS against it (the scheduler reads
         # available_blocks many times per round, usually with no
@@ -72,39 +83,44 @@ class BlockedAllocator:
         return self._ref[block]
 
     def allocate(self, n: int) -> List[int]:
-        if n > len(self._free):
-            raise RuntimeError(
-                f"KV cache exhausted: requested {n} blocks, "
-                f"{len(self._free)} free of {self.num_blocks}")
-        out = [self._free.popleft() for _ in range(n)]
-        self.version += 1
-        for b in out:
-            assert self._ref[b] == 0, (b, self._ref[b])
-            self._ref[b] = 1
-        return out
+        with self._lock:
+            if n > len(self._free):
+                raise RuntimeError(
+                    f"KV cache exhausted: requested {n} blocks, "
+                    f"{len(self._free)} free of {self.num_blocks}")
+            out = [self._free.popleft() for _ in range(n)]
+            self.version += 1
+            for b in out:
+                assert self._ref[b] == 0, (b, self._ref[b])
+                self._ref[b] = 1
+            return out
 
     def acquire(self, blocks: List[int]) -> None:
         """Add one holder to each (already-live) block."""
-        self.version += 1
-        for b in blocks:
-            if self._ref[b] <= 0:
-                raise RuntimeError(
-                    f"acquire of dead block {b} (refcount {self._ref[b]})")
-            self._ref[b] += 1
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise RuntimeError(
+                        f"acquire of dead block {b} "
+                        f"(refcount {self._ref[b]})")
+            self.version += 1
+            for b in blocks:
+                self._ref[b] += 1
 
     def release(self, blocks: List[int]) -> List[int]:
         """Drop one holder per block; blocks reaching refcount 0 return to
         the free list.  Returns the freed subset (accounting tests)."""
         freed: List[int] = []
-        self.version += 1
-        for b in blocks:
-            self._ref[b] -= 1
-            if self._ref[b] < 0:
-                raise RuntimeError(
-                    f"refcount underflow on block {b} (double release)")
-            if self._ref[b] == 0:
-                self._free.append(b)
-                freed.append(b)
+        with self._lock:
+            self.version += 1
+            for b in blocks:
+                self._ref[b] -= 1
+                if self._ref[b] < 0:
+                    raise RuntimeError(
+                        f"refcount underflow on block {b} (double release)")
+                if self._ref[b] == 0:
+                    self._free.append(b)
+                    freed.append(b)
         return freed
 
     # exclusive-ownership callers (pre-radix API) release through this name
@@ -187,6 +203,17 @@ class RadixKVCache:
         a plain dict walk under the GIL; a concurrent insert/evict can
         only make the answer stale, never corrupt it)."""
         return len(self._walk(tokens)) * self.block_size
+
+    def peek_blocks(self, tokens: np.ndarray) -> Tuple[List[int], int]:
+        """:meth:`match` without the side effects: (block ids, matched
+        token count), no LRU freshening, no references taken.  The fleet's
+        KV-handoff path probes this cross-thread (same safety argument as
+        :meth:`peek`) and then pins the blocks with ``allocator.acquire``
+        — which validates liveness atomically, so a block a concurrent
+        evict freed between the walk and the pin raises there instead of
+        being silently resurrected."""
+        path = self._walk(np.asarray(tokens, np.int32).reshape(-1))
+        return [n.block for n in path], len(path) * self.block_size
 
     # ------------------------------------------------------------ insert
     def insert(self, tokens: np.ndarray, blocks: List[int]) -> int:

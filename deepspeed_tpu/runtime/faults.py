@@ -49,7 +49,11 @@ stalled replica the supervisor must deadline out), ``replica.mid_decode``
 (inside the v2 engine's scheduler loop — a replica dying mid-serve),
 ``admission.decide`` (the admission controller's per-request decision),
 ``fleet.respawn_factory`` (the engine factory during a respawn — an ``exc``
-here must book the replica dead, never unwind the dispatcher) — and the
+here must book the replica dead, never unwind the dispatcher),
+``handoff.mid_transfer`` (between the KV block pin and the handoff commit
+of a disaggregated prefill->decode handoff — an ``exc`` models the source
+replica dying mid-transfer: the fleet must release the pinned blocks and
+re-enter the request through the migration fold) — and the
 training step path: ``step.grads`` (``nan`` poisons the step's gradient
 computation) and ``step.dispatch`` (``sleep`` models a hung collective the
 guardian's watchdog must deadline out).

@@ -1,13 +1,16 @@
 """deepspeed_tpu.serving — multi-replica serving fleet with failure
 tolerance end to end: supervised ``InferenceEngineV2`` replicas
 (fleet.py), a failure-tolerant router with bounded retry and request
-migration (router.py), and hysteresis admission control (admission.py).
-Chaos sites (``runtime/faults.py``): ``router.dispatch``,
-``replica.heartbeat``, ``replica.mid_decode``, ``admission.decide``.
+migration (router.py), hysteresis admission control (admission.py), and
+signal-driven prefill/decode pool autoscaling for the disaggregated mode
+(autoscale.py).  Chaos sites (``runtime/faults.py``):
+``router.dispatch``, ``replica.heartbeat``, ``replica.mid_decode``,
+``admission.decide``, ``handoff.mid_transfer``.
 """
 
 from deepspeed_tpu.serving.admission import (AdmissionConfig,
                                              AdmissionController)
+from deepspeed_tpu.serving.autoscale import AutoscaleConfig, PoolAutoscaler
 from deepspeed_tpu.serving.fleet import (FleetConfig, FleetDrained, Replica,
                                          REPLICA_STATES, ServingFleet)
 from deepspeed_tpu.serving.router import (POLICIES, FleetRequest,
@@ -17,4 +20,5 @@ from deepspeed_tpu.serving.router import (POLICIES, FleetRequest,
 __all__ = ["ServingFleet", "FleetConfig", "FleetDrained", "Replica",
            "REPLICA_STATES", "Router", "RouterConfig", "FleetRequest",
            "RequestFailed", "NoHealthyReplicas", "POLICIES",
-           "AdmissionController", "AdmissionConfig"]
+           "AdmissionController", "AdmissionConfig",
+           "PoolAutoscaler", "AutoscaleConfig"]

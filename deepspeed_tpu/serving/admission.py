@@ -116,6 +116,11 @@ class AdmissionController:
         self._rate = 0.0
         self._win_start_t: Optional[float] = None
         self._win_start_total: Optional[float] = None
+        # shed rate (rejections/s) over the same windows: the pool
+        # autoscaler's overload signal (serving/autoscale.py) — a
+        # mis-sized disaggregated pool split shows up here first
+        self._shed_rate = 0.0
+        self._win_start_rejections: Optional[float] = None
         self.c_rejections = registry.counter(
             "admission_rejections_total", "requests shed (429-style, with "
             "retry-after) by the fleet admission controller before "
@@ -133,6 +138,13 @@ class AdmissionController:
         if m is None:
             return 0.0
         return sum(v for _, v in m.samples())
+
+    def shed_rate(self) -> float:
+        """Requests shed per second over the last full rate window (same
+        windowing as the kv-failure rate).  Read by the pool autoscaler:
+        a nonzero shed rate means overload, where a mis-sized
+        prefill/decode split costs goodput immediately."""
+        return self._shed_rate
 
     # -------------------------------------------------------- control loop
     def update(self, queue_depth: int,
@@ -153,15 +165,21 @@ class AdmissionController:
             return False
         total = (self.kv_failures_total() if kv_failures_total is None
                  else float(kv_failures_total))
+        rejections = self.c_rejections.value()
         now = self.clock()
         if self._win_start_t is None:
             self._win_start_t = now
             self._win_start_total = total
+            self._win_start_rejections = rejections
         elapsed = now - self._win_start_t
         if elapsed >= float(cfg.rate_window_s):
             self._rate = max(0.0, total - self._win_start_total) / elapsed
+            self._shed_rate = max(
+                0.0, rejections - (self._win_start_rejections or 0.0)) \
+                / elapsed
             self._win_start_t = now
             self._win_start_total = total
+            self._win_start_rejections = rejections
         rate = self._rate
         if not self.shedding:
             if (queue_depth > cfg.high_queue_depth

@@ -43,7 +43,20 @@ Chaos wiring: arm ``runtime/faults.py`` sites ``replica.mid_decode``
 (death inside the scheduler loop), ``replica.heartbeat`` (``sleep`` =
 stalled replica, ``exc`` = death at the beat), ``router.dispatch``
 (dispatch-path failure -> retry/backoff), ``admission.decide`` (controller
-failure -> fail open).
+failure -> fail open), ``handoff.mid_transfer`` (source replica death
+between KV pin and handoff commit -> pins released, request re-enters
+via the migration fold).
+
+Disaggregated mode (``disaggregated: true``): replicas split into a
+prefill pool (serves prompt + FIRST token only — the TTFT-critical
+phase) and a decode pool (the token tail).  The phase boundary reuses
+the migration fold: the prefill result folds into the prompt and the
+request requeues as a decode-phase dispatch, so the decode replica's
+prefill over the folded prompt hits either the radix alias of the
+handed-off blocks (single-host shared pool) or recomputes token-exactly
+— greedy outputs are byte-identical to a unified fleet either way.  A
+signal-driven autoscaler (serving/autoscale.py) rebalances the split at
+runtime via warm role flips against the shared compile cache.
 """
 
 from __future__ import annotations
@@ -62,6 +75,7 @@ from deepspeed_tpu.config import DeepSpeedConfigModel
 from deepspeed_tpu.runtime import faults
 from deepspeed_tpu.serving.admission import (AdmissionConfig,
                                              AdmissionController)
+from deepspeed_tpu.serving.autoscale import AutoscaleConfig, PoolAutoscaler
 from deepspeed_tpu.serving.router import (FleetRequest, NoHealthyReplicas,
                                           RequestFailed, Router,
                                           RouterConfig)
@@ -110,8 +124,19 @@ class FleetConfig(DeepSpeedConfigModel):
     respawn_after_drain: bool = True
     share_compile_cache: bool = True
     poll_interval_s: float = 0.005
+    # disaggregated prefill/decode pools: the first ``prefill_replicas``
+    # replicas serve ONLY the prompt+first-token phase, the rest only the
+    # decode tail; finished prefill KV hands off to the decode replica
+    # through the paged pool (refcounted block pin + radix prefix alias —
+    # on single-host pools the alias IS the transfer; the multi-host copy
+    # is a stub accounted in kv_handoff_bytes_total).  Both phases are
+    # greedy over identical weights, so a disaggregated serve is
+    # byte-identical to a unified one.
+    disaggregated: bool = False
+    prefill_replicas: int = 1
     router: RouterConfig = Field(default_factory=RouterConfig)
     admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
+    autoscale: AutoscaleConfig = Field(default_factory=AutoscaleConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,6 +164,11 @@ class Replica:
         self.fleet = fleet
         self.state = "spawning"
         self.engine = None
+        # pool membership in disaggregated mode ("prefill"/"decode"; None
+        # in unified fleets).  Mutated only by the dispatcher thread — a
+        # role flip stale-ifies the worker first (same incarnation fence
+        # as a retire), so no worker ever serves across a flip.
+        self.role: Optional[str] = None
         self.incarnation = 0
         self.respawns = 0              # death-respawns taken
         self.queue: List[_Dispatch] = []
@@ -160,9 +190,13 @@ class Replica:
         self.last_beat = self.fleet.clock()
 
     def enqueue(self, req: FleetRequest) -> None:
+        # a prefill-phase request serves the prompt plus EXACTLY one token
+        # (full prefill + first sample = the TTFT boundary); the decode
+        # phase gets the rest of the budget after the handoff fold
+        remaining = 1 if req.phase == "prefill" else req.remaining
         d = _Dispatch(index=req.index, epoch=req.epoch,
                       prompt=np.asarray(req.prompt, np.int32),
-                      remaining=req.remaining,
+                      remaining=remaining,
                       prefix=tuple(req.generated),
                       gen=self.fleet._serve_gen)
         with self.cond:
@@ -195,6 +229,14 @@ class ServingFleet:
                  registry: Optional[MetricRegistry] = None,
                  preemption_handler=None):
         self.config = FleetConfig.parse(config)
+        if self.config.disaggregated:
+            n, npre = self.config.num_replicas, self.config.prefill_replicas
+            if not 1 <= npre < n:
+                raise ValueError(
+                    f"disaggregated fleet needs 1 <= prefill_replicas < "
+                    f"num_replicas, got prefill_replicas={npre} of {n}")
+            # the router must see the same mode (phase-aware pick)
+            self.config.router.disaggregated = True
         self.clock = clock or time.monotonic
         self.registry = registry if registry is not None else MetricRegistry()
         self._model = model
@@ -231,9 +273,32 @@ class ServingFleet:
             "fleet_recovery_ms", "replica death/drain detection to the "
             "replacement healthy (in-flight work is already requeued "
             "before the respawn starts)")
+        self.c_handoffs = self.registry.counter(
+            "fleet_handoffs_total", "prefill->decode phase handoffs, per "
+            "outcome: ok (blocks pinned or accounting-free), aborted "
+            "(source died mid-transfer; pins released, request re-entered "
+            "through the migration fold)")
+        self.c_handoff_bytes = self.registry.counter(
+            "kv_handoff_bytes_total", "KV bytes the multi-host handoff "
+            "copy path WOULD move (pinned blocks x per-block KV bytes); "
+            "single-host pools alias the blocks instead of copying, so "
+            "the counter sizes the future wire transfer, not work done")
+        # index -> (source replica, incarnation at pin time, pinned block
+        # ids): handoff pins released at final completion (or dropped when
+        # the source incarnation — and with it the allocator — is gone)
+        self._handoffs: Dict[int, Tuple[str, int, List[int]]] = {}
+        self._autoscaler: Optional[PoolAutoscaler] = None
+        if self.config.disaggregated:
+            self._autoscaler = PoolAutoscaler(
+                self.config.autoscale, registry=self.registry,
+                clock=self.clock)
         self.replicas: Dict[str, Replica] = {}
         for i in range(int(self.config.num_replicas)):
             rep = Replica(f"r{i}", self)
+            if self.config.disaggregated:
+                rep.role = ("prefill"
+                            if i < int(self.config.prefill_replicas)
+                            else "decode")
             self.replicas[rep.name] = rep
             self._spawn(rep, is_respawn=False)
         self._handler = preemption_handler
@@ -250,6 +315,13 @@ class ServingFleet:
         from deepspeed_tpu.inference.v2 import InferenceEngineV2
         ecfg = copy.deepcopy(self._engine_config)
         ecfg.setdefault("telemetry", {})["replica"] = name
+        if self.config.disaggregated:
+            # the handoff pins radix-matched blocks on the source pool, and
+            # decode-side prefix_affinity routes on radix residency: both
+            # need the prefix cache on every replica
+            sm = ecfg.setdefault("state_manager", {})
+            if isinstance(sm, dict):
+                sm.setdefault("prefix_cache", True)
         return InferenceEngineV2(self._model, ecfg, params=self._params,
                                  steps_cache=self._steps_cache,
                                  telemetry_registry=self.registry)
@@ -444,13 +516,18 @@ class ServingFleet:
         for rep in self.replicas.values():
             with rep.cond:
                 rep.queue.clear()
+        # release any handoff pins a previous serve left behind (e.g. an
+        # exception path between handoff and final completion)
+        for index in list(self._handoffs):
+            self._release_handoff(index)
         self.router = Router(self.config.router, clock=self.clock,
                              registry=self.registry)
         t0 = self.clock()
+        phase = "prefill" if self.config.disaggregated else "full"
         for i, (p, m) in enumerate(zip(prompts, max_list)):
             self.router.submit(FleetRequest(
                 index=i, prompt=np.asarray(p, np.int32).reshape(-1),
-                max_new_tokens=m,
+                max_new_tokens=m, phase=phase,
                 t_arrival=t0 + (float(arrival_times[i])
                                 if arrival_times is not None else 0.0)))
         while not self.router.settled():
@@ -501,8 +578,16 @@ class ServingFleet:
         # 4) admission control tick + dispatch
         depth = self.router.queue_depth(now)
         self.admission.update(depth)
+        # handoff pins of requests that FAILED (retry budget, admission
+        # cap, ...) never reach _complete's release — sweep them here
+        if self._handoffs:
+            for index in [i for i in self._handoffs
+                          if i in self.router.failed]:
+                self._release_handoff(index)
         if self._fleet_draining:
             return
+        if self._autoscaler is not None:
+            self._rebalance_pools(now)
         for req in self.router.take_dispatchable(now):
             try:
                 admitted, retry_after = self.admission.decide(req)
@@ -580,13 +665,177 @@ class ServingFleet:
             self._retire_replica(rep, reason)
 
     def _complete(self, index: int, epoch: int, tokens, now: float) -> None:
+        req = self.router.inflight.get(index)
+        if (req is not None and req.phase == "prefill"
+                and req.epoch == epoch
+                and len(tokens) < req.max_new_tokens):
+            # prefill phase done (prompt + first token) with budget left:
+            # hand the KV off and requeue the decode tail instead of
+            # completing.  A one-token budget skips this and completes
+            # directly — prefill already produced everything.
+            self._advance_phase(req, epoch, tokens, now)
+            return
         if not self.router.complete(index, epoch, tokens):
             return
+        self._release_handoff(index)
         req = self.router.requests[index]
         self.request_log.append({
             "index": index, "t_arrival": req.t_arrival, "t_done": now,
             "generated_tokens": int(len(tokens)), "attempts": req.attempts,
-            "migrations": req.migrations, "rejections": req.rejections})
+            "migrations": req.migrations, "rejections": req.rejections,
+            "t_first": req.t_first})
+
+    # ----------------------------------------------------------- KV handoff
+    def _advance_phase(self, req: FleetRequest, epoch: int, tokens,
+                       now: float) -> None:
+        """Prefill -> decode handoff.  The transfer primitive is the PR 15
+        radix block-alias path: the source replica's finished prompt
+        blocks are PINNED (refcounted ``acquire``) so eviction cannot
+        reclaim them while the decode attempt is in flight, and the decode
+        replica's prefix probe then aliases them for free on a shared
+        single-host pool.  The multi-host path is a stub: the bytes a
+        wire copy would move are accounted in ``kv_handoff_bytes_total``.
+        ``handoff.mid_transfer`` fires between pin and commit — an
+        injected fault there models the source dying mid-transfer: pins
+        are released (no refcount leak) and the request re-enters through
+        the existing token-exact migration fold."""
+        index = req.index
+        src = self.replicas.get(req.assigned) if req.assigned else None
+        new = [int(t) for t in np.asarray(tokens).reshape(-1)
+               [len(req.generated):]]
+        folded = np.concatenate(
+            [np.asarray(req.prompt, np.int32),
+             np.asarray(new, np.int32)]) if new else req.prompt
+        blocks: List[int] = []
+        pinned = False
+        eng = getattr(src, "engine", None) if src is not None else None
+        src_inc = src.incarnation if src is not None else -1
+        probe = getattr(eng, "prefix_block_handles", None)
+        if probe is not None:
+            try:
+                blocks, _matched = probe(folded)
+                if blocks:
+                    # pin vs eviction; acquire validates every block
+                    # before bumping any, so a lost race with the radix
+                    # evictor (dead block) leaves nothing to unwind and
+                    # the handoff degrades to accounting-free
+                    eng.state.allocator.acquire(blocks)
+                    pinned = True
+            except Exception:  # noqa: BLE001 — degraded, never corrupt
+                blocks, pinned = [], False
+        try:
+            faults.fire("handoff.mid_transfer", index=index,
+                        replica=src.name if src is not None else None)
+        except faults.InjectedFault as e:
+            if pinned:
+                self._release_blocks(eng, blocks)
+            self.c_handoffs.inc(1, outcome="aborted")
+            logger.warning(
+                f"fleet: handoff of request {index} aborted mid-transfer "
+                f"({e!r}); re-entering via migration fold")
+            # the prefill result is host-known, so the fold keeps it —
+            # the request re-enters token-exact as a decode-phase retry
+            # (drain-style: an injected infrastructure fault must not
+            # burn the client's retry budget)
+            req.phase = "decode"
+            if req.t_first is None:
+                req.t_first = now
+            self.router.migrate(
+                req, now, reason="handoff_abort",
+                record={"prompt": folded, "generated": new},
+                burn_budget=False)
+            return
+        if pinned:
+            self._handoffs[index] = (src.name, src_inc, blocks)
+            bytes_fn = getattr(eng, "kv_block_bytes", None)
+            if bytes_fn is not None:
+                self.c_handoff_bytes.inc(len(blocks) * int(bytes_fn()))
+        self.c_handoffs.inc(1, outcome="ok")
+        if req.t_first is None:
+            req.t_first = now
+        self.router.handoff(index, epoch, tokens, now)
+
+    @staticmethod
+    def _release_blocks(eng, blocks: List[int]) -> None:
+        try:
+            eng.state.allocator.release(blocks)
+        except Exception as e:  # noqa: BLE001 — bookkeeping must never
+            #                     take the dispatcher down
+            logger.warning(f"fleet: handoff pin release failed: {e!r}")
+
+    def _release_handoff(self, index: int) -> None:
+        """Release a request's pinned handoff blocks on its SOURCE pool.
+        Skipped when the source incarnation is gone — its allocator (and
+        the pins with it) died with the engine."""
+        rec = self._handoffs.pop(index, None)
+        if rec is None:
+            return
+        name, inc, blocks = rec
+        rep = self.replicas.get(name)
+        if rep is None or rep.incarnation != inc or rep.engine is None:
+            return
+        self._release_blocks(rep.engine, blocks)
+
+    def _drop_handoffs_for(self, rep: Replica) -> None:
+        """Forget pins sourced on a replica whose engine is being torn
+        down (retire / role flip): the allocator dies with it, so there
+        is nothing to release — keeping the record would release against
+        the REPLACEMENT engine's allocator."""
+        for index in [i for i, (name, _inc, _b) in self._handoffs.items()
+                      if name == rep.name]:
+            del self._handoffs[index]
+
+    # ----------------------------------------------------- pool autoscaling
+    def _rebalance_pools(self, now: float) -> None:
+        """One autoscaler evaluation: ask for a direction, then flip ONE
+        idle replica (healthy, nothing queued, nothing assigned) — moving
+        a busy replica would migrate its work for a latency optimization,
+        which is backwards.  No idle donor means no move this tick; the
+        signal persists and a later tick retries."""
+        pools = {"prefill": 0, "decode": 0}
+        for r in self.replicas.values():
+            if r.state == "healthy" and r.role in pools:
+                pools[r.role] += 1
+        direction = self._autoscaler.evaluate(
+            now, pools, shedding=self.admission.shedding,
+            shed_rate=self.admission.shed_rate())
+        if direction is None:
+            return
+        donor_role = "decode" if direction == "to_prefill" else "prefill"
+        new_role = "prefill" if direction == "to_prefill" else "decode"
+        for rep in sorted(self.replicas.values(), key=lambda r: r.name):
+            if rep.state != "healthy" or rep.role != donor_role:
+                continue
+            with rep.cond:
+                idle = not rep.busy and not rep.queue
+            if not idle or self.router.assigned_to(rep.name):
+                continue
+            self._flip_role(rep, new_role)
+            self._autoscaler.record_move(direction, now)
+            return
+
+    def _flip_role(self, rep: Replica, role: str) -> None:
+        """Warm role flip: stale-ify the worker (incarnation fence — same
+        mechanism as a retire, but no death is booked and no respawn
+        budget burns), swap the role, and respawn against the shared
+        jitted-step cache.  Both roles run the same compiled program set,
+        so the flip is a warm respawn: the recompile watchdog in the
+        tests pins that no new program is compiled by one."""
+        with rep.cond:
+            rep.incarnation += 1
+            leftovers, rep.queue = rep.queue, []
+            rep.busy = False
+            rep.cond.notify_all()
+        now = self.clock()
+        for d in leftovers:   # donor was idle-checked; belt and braces
+            self._apply_migration(d.index, d.epoch, None, "drain", now)
+        self._drop_handoffs_for(rep)
+        self.router.invalidate_residency(rep.name)
+        old = rep.role
+        rep.role = role
+        logger.info(f"fleet: role flip {rep.name}: {old} -> {role} "
+                    f"(warm respawn)")
+        self._spawn(rep, is_respawn=True)
 
     def _apply_migration(self, index: int, epoch: int,
                          record: Optional[dict], reason: str,
@@ -654,6 +903,8 @@ class ServingFleet:
             rep.cond.notify_all()
         self._set_state(rep, "dead")
         self.c_deaths.inc(1, reason=reason)
+        self._drop_handoffs_for(rep)
+        self.router.invalidate_residency(rep.name)
         now = self.clock()
         for d in leftovers:
             self._apply_migration(d.index, d.epoch, None, reason, now)
@@ -709,7 +960,8 @@ class ServingFleet:
             free = kv.value(replica=rep.name, state="free") if kv else 0.0
             used = kv.value(replica=rep.name, state="used") if kv else 0.0
             out[rep.name] = {
-                "state": rep.state, "beat_age_s": now - rep.last_beat,
+                "state": rep.state, "role": rep.role,
+                "beat_age_s": now - rep.last_beat,
                 "busy": rep.busy, "respawns": rep.respawns,
                 "kv_free_blocks": free, "kv_used_blocks": used,
                 "outstanding_tokens":

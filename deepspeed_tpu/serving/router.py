@@ -97,6 +97,13 @@ class RouterConfig(DeepSpeedConfigModel):
     backoff_max_s: float = 2.0
     backoff_jitter: float = 0.5
     seed: int = 0
+    # disaggregated serving (serving/fleet.py FleetConfig.disaggregated
+    # mirrors this down): route each PHASE independently — prefill-phase
+    # requests to the prefill-role pool by shortest queue, decode-phase
+    # requests to the decode-role pool by prefix_affinity against the
+    # handoff residency.  An empty role pool falls back to any healthy
+    # replica: specialization is an optimization, never a liveness gate
+    disaggregated: bool = False
 
 
 @dataclasses.dataclass
@@ -120,6 +127,13 @@ class FleetRequest:
     next_eligible: float = 0.0              # arrival / backoff / retry-after
     deadline: float = float("inf")          # per-attempt timeout
     assigned: Optional[str] = None          # replica name while inflight
+    # disaggregated lifecycle: "full" (unified fleet — prefill and decode
+    # on one replica), "prefill" (serve the prompt + FIRST token only),
+    # "decode" (prefill done and folded; serve the remaining budget).
+    # ``handoff`` advances prefill -> decode
+    phase: str = "full"
+    t_first: Optional[float] = None         # fleet-observed first-token time
+    #                                         (set at handoff; None unified)
 
     @property
     def remaining(self) -> int:
@@ -157,18 +171,17 @@ def prefix_affinity(req: FleetRequest, healthy: list, router: "Router",
     while the replica worker serves; replicas without one report 0.
     Affinity is an optimization, never a correctness gate: a dead
     favorite simply isn't in ``healthy`` and the survivors re-prefill the
-    uncached suffix token-exact."""
-    def resident(rep) -> int:
-        probe = getattr(getattr(rep, "engine", None),
-                        "prefix_cached_tokens", None)
-        if probe is None:
-            return 0
-        try:
-            return int(probe(req.prompt))
-        except Exception:  # noqa: BLE001 — a dying replica's probe must
-            return 0       # never take the dispatcher down with it
+    uncached suffix token-exact.
+
+    Probes go through :meth:`Router.residency` — a per-(replica, prompt)
+    cache so scheduling stays O(replicas) dict hits per request instead
+    of O(replicas) trie walks: at fleet scale the probe itself was the
+    routing cost.  The cache invalidates per replica on dispatch
+    (residency there is about to grow) and on death/migration
+    (``Router.invalidate_residency``), so a stale entry can only
+    UNDER-state residency for one pick, never mis-route."""
     return min(healthy,
-               key=lambda rep: (-resident(rep),
+               key=lambda rep: (-router.residency(rep, req),
                                 router.outstanding_tokens(rep.name),
                                 rep.name))
 
@@ -202,6 +215,10 @@ class Router:
         self.done: Dict[int, np.ndarray] = {}
         self.failed: Dict[int, RequestFailed] = {}
         self.requests: Dict[int, FleetRequest] = {}
+        # per-replica radix-residency probe cache: {replica name ->
+        # {prompt bytes -> resident token count}} — see residency()
+        self._residency: Dict[str, Dict[bytes, int]] = {}
+        self._residency_cap = 4096      # entries per replica before reset
         self.c_retries = registry.counter(
             "router_retries_total", "request re-dispatches taken by the "
             "fleet router, per reason (dispatch_error / timeout / "
@@ -253,10 +270,28 @@ class Router:
         return base * (1.0 + c.backoff_jitter * float(self._rng.random()))
 
     def pick(self, req: FleetRequest, healthy: list):
-        """Choose a replica for ``req`` under the configured policy."""
+        """Choose a replica for ``req`` under the configured policy.  In
+        disaggregated mode each phase routes against its OWN pool:
+        prefill-phase requests go to the prefill-role replica with the
+        shortest queue (fewest assigned requests — prefill work is one
+        prompt-sized burst, so queue length IS the wait), decode-phase
+        (and unified "full") requests to the decode pool by
+        ``prefix_affinity`` — a handed-off request lands where its folded
+        prompt is already radix-resident.  An empty role pool falls back
+        to the whole healthy set under the configured policy."""
         if not healthy:
             raise NoHealthyReplicas(
                 f"no healthy replica for request {req.index}")
+        if self.config.disaggregated:
+            role = "prefill" if req.phase == "prefill" else "decode"
+            pool = [r for r in healthy
+                    if getattr(r, "role", None) == role]
+            if pool:
+                if role == "prefill":
+                    return min(pool, key=lambda rep: (
+                        self.assigned_count(rep.name),
+                        self.outstanding_tokens(rep.name), rep.name))
+                return prefix_affinity(req, pool, self, self._rng)
         return self._policy(req, healthy, self, self._rng)
 
     def dispatch(self, req: FleetRequest, replica, now: float) -> None:
@@ -272,6 +307,10 @@ class Router:
                         if self.config.request_timeout_s > 0
                         else float("inf"))
         self.inflight[req.index] = req
+        # this replica's radix residency is about to change (the dispatch
+        # will insert the request's blocks): drop its probe cache so the
+        # next pick re-probes it — everyone else's entries stay warm
+        self._residency.pop(replica.name, None)
         replica.enqueue(req)
 
     def fail_attempt(self, req: FleetRequest, now: float, reason: str,
@@ -302,6 +341,8 @@ class Router:
         retries from its last known context, recomputing the lost tail.
         The ORIGINAL arrival timestamp is preserved: with greedy decoding
         the re-served request completes token-exact vs. a no-failure run."""
+        if req.assigned is not None:
+            self._residency.pop(req.assigned, None)
         self.inflight.pop(req.index, None)
         req.assigned = None
         req.epoch += 1
@@ -322,6 +363,39 @@ class Router:
         # since its original arrival already
         req.next_eligible = now
         self.pending.append(req)
+
+    # ------------------------------------------------------------- handoff
+    def handoff(self, index: int, epoch: int, tokens: np.ndarray,
+                now: float) -> Optional[FleetRequest]:
+        """Advance a prefill-phase request to its decode phase: fold the
+        prefill attempt's output (its first generated token) into the
+        prompt — the SAME host-known fold migration uses, so greedy decode
+        on any replica continues token-exact — and requeue it immediately
+        as phase "decode" for the decode pool to pick up.  Burns no retry
+        budget (a handoff is the request's normal lifecycle, not a
+        failure).  Strictly epoch-gated, unlike ``complete``: a stale
+        prefill attempt must not fold into a request some LIVE attempt
+        owns — the live attempt produces its own (token-identical)
+        result.  Returns the advanced request, or None when stale/done."""
+        if index in self.done or index in self.failed:
+            return None
+        req = self.inflight.get(index)
+        if req is None or req.epoch != epoch:
+            return None
+        del self.inflight[index]
+        req.assigned = None
+        req.epoch += 1
+        new = [int(t) for t in np.asarray(tokens).reshape(-1)
+               [len(req.generated):]]
+        if new:
+            req.prompt = np.concatenate(
+                [np.asarray(req.prompt, np.int32),
+                 np.asarray(new, np.int32)])
+            req.generated = req.generated + new
+        req.phase = "decode"
+        req.next_eligible = now     # no backoff: this is progress
+        self.pending.append(req)
+        return req
 
     # ---------------------------------------------------------- completion
     def complete(self, index: int, epoch: int, tokens: np.ndarray) -> bool:
@@ -354,10 +428,55 @@ class Router:
                               detail=f"replica {req.assigned}")
         return late
 
+    # ----------------------------------------------------------- residency
+    def residency(self, rep, req: FleetRequest) -> int:
+        """Cached radix-residency probe for ``prefix_affinity``: how many
+        of ``req.prompt``'s tokens are radix-resident on ``rep``.  The
+        underlying ``engine.prefix_cached_tokens`` walk is O(prompt) per
+        replica per request; at fleet scale that walk WAS the routing
+        cost, so results cache per (replica, prompt bytes) until the
+        replica's residency can have changed — a dispatch to it, a
+        migration off it, or its death drops that replica's entries
+        (``invalidate_residency``).  A stale entry therefore only ever
+        UNDER-states residency, which costs one suboptimal pick, never
+        correctness.  Replicas without a probe (fakes, cache off) report
+        0 uncached, and a failing probe (dying replica) reports 0 without
+        poisoning the cache."""
+        probe = getattr(getattr(rep, "engine", None),
+                        "prefix_cached_tokens", None)
+        if probe is None:
+            return 0
+        cache = self._residency.setdefault(rep.name, {})
+        key = np.asarray(req.prompt, np.int32).tobytes()
+        hit = cache.get(key)
+        if hit is None:
+            try:
+                hit = int(probe(req.prompt))
+            except Exception:  # noqa: BLE001 — a dying replica's probe
+                return 0       # must never take the dispatcher down
+            if len(cache) >= self._residency_cap:
+                cache.clear()
+            cache[key] = hit
+        return hit
+
+    def invalidate_residency(self, name: Optional[str] = None) -> None:
+        """Drop the residency probe cache for one replica (death, drain,
+        role flip) or for the whole fleet (``name=None``)."""
+        if name is None:
+            self._residency.clear()
+        else:
+            self._residency.pop(name, None)
+
     # -------------------------------------------------------------- status
     def outstanding_tokens(self, replica_name: str) -> int:
         return sum(len(r.prompt) + r.remaining
                    for r in self.inflight.values()
+                   if r.assigned == replica_name)
+
+    def assigned_count(self, replica_name: str) -> int:
+        """In-flight requests currently assigned to ``replica_name`` (the
+        prefill pool's shortest-queue routing signal)."""
+        return sum(1 for r in self.inflight.values()
                    if r.assigned == replica_name)
 
     def assigned_to(self, replica_name: str) -> List[FleetRequest]:

@@ -405,6 +405,10 @@ class ServingTelemetry:
             "draft_dispatches": self.c_dispatch.value(kind="spec_draft", **L),
             "verify_dispatches": self.c_dispatch.value(kind="spec_verify",
                                                        **L),
+            # fused draft+verify dispatches: the cross-request batching
+            # claim is "dispatches per emitted token strictly lower than
+            # per-request spec" — this is the numerator the tests pin
+            "spec_dispatches": self.c_dispatch.value(kind="spec", **L),
         }
 
     # -------------------------------------------------------------- reads

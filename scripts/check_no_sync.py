@@ -153,6 +153,8 @@ RAGGED_FUNCS = {
 # device sync, so the scalar patterns would drown the real hazard class)
 
 # the fleet router: every method is on the dispatch/retry/migration path
+# (incl. the disaggregated handoff + the residency probe cache — both run
+# per scheduler round; the probe itself is a pure host radix walk)
 ROUTER_FUNCS = {
     "submit",
     "queue_depth",
@@ -164,23 +166,48 @@ ROUTER_FUNCS = {
     "fail_attempt",
     "migrate",
     "complete",
+    "handoff",
+    "residency",
+    "invalidate_residency",
+    "assigned_count",
     "check_timeouts",
     "outstanding_tokens",
     "assigned_to",
 }
 # the fleet dispatcher loop (control plane only — replica worker bodies
-# are the sanctioned per-replica blocking sites)
+# are the sanctioned per-replica blocking sites).  The KV-handoff path
+# (_advance_phase/_release_handoff) pins/releases refcounts on the paged
+# pool — host dict bookkeeping; the actual block content never moves on
+# a single host, and the multi-host copy stub only counts bytes
 FLEET_FUNCS = {
     "serve",
     "_tick",
     "_handle_event",
     "_complete",
+    "_advance_phase",
+    "_release_handoff",
+    "_drop_handoffs_for",
+    "_rebalance_pools",
+    "_flip_role",
     "_apply_migration",
     "_invalid_reason",
     "_check_health",
     "_retire_replica",
     "drain_replica",
     "drain_all",
+}
+
+# the pool autoscaler: evaluate/decide run inside the dispatcher tick and
+# read only host-side registry series — a device sync here would stall
+# every replica's dispatch on a latency OPTIMIZATION
+AUTOSCALE_PATH = os.path.join(REPO, "deepspeed_tpu", "serving",
+                              "autoscale.py")
+AUTOSCALE_FUNCS = {
+    "signals",
+    "decide",
+    "evaluate",
+    "record_move",
+    "_fleet_p99",
 }
 
 # the guardian control loop: the per-step half (run/_assess/
@@ -251,6 +278,7 @@ SCAN_TARGETS = [
      RESILIENCE_PATTERN, ALLOW_PATTERN),
     (ROUTER_PATH, ROUTER_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (FLEET_PATH, FLEET_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
+    (AUTOSCALE_PATH, AUTOSCALE_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (GUARDIAN_PATH, GUARDIAN_FUNCS, GUARDIAN_PATTERN, ALLOW_PATTERN),
 ]
 
