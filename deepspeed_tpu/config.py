@@ -146,6 +146,49 @@ class ZeroPPConfig(DeepSpeedConfigModel):
         return self
 
 
+class MoEConfig(DeepSpeedConfigModel):
+    """Expert-parallel fast-path knobs (moe/layer.py, moe/comm.py).
+
+    The MoE dispatch/combine all-to-alls are the dominant wire cost of an
+    expert-parallel step; this block says how they go over the wire and how
+    they schedule, mirroring ``zeropp`` for the ZeRO collectives:
+
+    - ``wire_bits``: int wire width of both a2a directions (0 = bf16/fp32
+      full width; 8 = blockwise int8 values + fp32 scales; 4 =
+      nibble-packed).  Gradients of the combine a2a ride the same width
+      (quantized-transpose custom_vjp).
+    - ``block_size``: values per quantization block (one fp32 scale each).
+    - ``hierarchical``: all-ICI ep axes stay full width, only host-crossing
+      ep axes quantize (same per-axis policy as ``zeropp.hierarchical``).
+    - ``num_chunks``: decompose dispatch-a2a -> expert FFN -> combine-a2a
+      into this many expert sub-group chunks so expert GEMMs interleave
+      with in-flight a2a chunks (T3-style overlap); 1 = single-shot.
+    - ``expert_telemetry``: per-expert assigned-token gauges, drop
+      counters, aux-loss/gate-entropy gauges computed inside the jitted
+      step (one extra output, no steady-state recompile).
+    """
+
+    wire_bits: int = 0
+    block_size: int = 256
+    hierarchical: bool = False
+    num_chunks: int = 1
+    expert_telemetry: bool = True
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.wire_bits not in (0, 4, 8):
+            raise ValueError(
+                f"moe.wire_bits must be 0 (full width), 4, or 8 "
+                f"(got {self.wire_bits})")
+        if self.block_size < 8:
+            raise ValueError(
+                f"moe.block_size must be >= 8, got {self.block_size}")
+        if self.num_chunks < 1:
+            raise ValueError(
+                f"moe.num_chunks must be >= 1, got {self.num_chunks}")
+        return self
+
+
 class ZeroConfig(DeepSpeedConfigModel):
     """reference: runtime/zero/config.py (DeepSpeedZeroConfig).
 
@@ -662,6 +705,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     bf16: BF16Config = Field(default_factory=BF16Config)
     zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
     overlap: OverlapConfig = Field(default_factory=OverlapConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig)

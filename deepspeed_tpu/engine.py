@@ -80,6 +80,23 @@ def _cast_params(params, dtype):
         params)
 
 
+def _moe_stats_to_python(moe_host):
+    """Host-side MoE stats → plain python: the [E] expert-tokens vector
+    becomes a list, scalars become floats (flight-recorder/JSON-safe)."""
+    return {k: (v.tolist() if getattr(v, "ndim", 0) else float(v))
+            for k, v in moe_host.items()}
+
+
+def _reduce_moe_micros(moes):
+    """Reduce [gas]-stacked per-micro MoE stats (moe/layer.py sows,
+    aggregated per micro by ``aggregate_moe_stats``) to one step-level
+    dict: token counts sum over microbatches, aux/entropy average."""
+    if not moes:
+        return {}
+    return {k: (moes[k].mean(axis=0) if k in ("aux_loss", "gate_entropy")
+                else moes[k].sum(axis=0)) for k in moes}
+
+
 def _poison_first_float_leaf(params):
     """Engine-site payload of the ``nan`` fault kind at ``step.grads``:
     multiply the first floating-point parameter leaf by NaN (shape, dtype
@@ -352,6 +369,24 @@ class DeepSpeedTPUEngine:
                     "overlap.collective_matmul set but the model config has "
                     "no tp_collective_matmul knob (models/gpt.py GPT) — the "
                     "ring collective-matmul fusions are inert for this model")
+        # moe: push the ep a2a wire/overlap knobs into the model config so
+        # ds_config is the single source of truth (moe/comm.py fast path),
+        # like the random-LTD / activation-quant knobs above
+        moe_cfg = config.moe
+        if moe_cfg.wire_bits or moe_cfg.num_chunks > 1 or moe_cfg.hierarchical:
+            if (hasattr(model, "clone") and hasattr(model, "cfg")
+                    and hasattr(model.cfg, "moe_wire_bits")):
+                import dataclasses as _dc
+                model = model.clone(cfg=_dc.replace(
+                    model.cfg, moe_wire_bits=moe_cfg.wire_bits,
+                    moe_wire_block=moe_cfg.block_size,
+                    moe_hierarchical=moe_cfg.hierarchical,
+                    moe_num_chunks=moe_cfg.num_chunks))
+            else:
+                logger.warning(
+                    "moe.* wire/overlap knobs set but the model config has "
+                    "no moe_wire_bits knob (models/gpt.py GPT) — the MoE a2a "
+                    "fast path is inert for this model")
         # progressive layer drop (reference engine.progressive_layer_drop
         # built at initialize() when the config block is enabled)
         pld_cfg = config.progressive_layer_drop
@@ -371,6 +406,7 @@ class DeepSpeedTPUEngine:
         # pipeline models consume all gas microbatches in one pipelined scan
         # (reference: PipelineEngine.train_batch owns the microbatch loop)
         self.gas_in_model = bool(getattr(model, "is_pipeline", False))
+        self._apply_fn_stats = None     # flax models only (moe_stats sow)
         if isinstance(model, tuple):
             self._init_fn, self._apply_fn = model
             # rng=None signals "deterministic" by convention (PipeGPT does
@@ -384,6 +420,12 @@ class DeepSpeedTPUEngine:
             if isinstance(model, fnn.Module):
                 self._apply_fn = lambda params, batch, rng: model.apply(
                     params, batch, rngs={"dropout": rng})
+                # expert-telemetry leg: same forward with the moe_stats sow
+                # collection mutable — returns (out, {"moe_stats": ...})
+                self._apply_fn_stats = \
+                    lambda params, batch, rng: model.apply(
+                        params, batch, rngs={"dropout": rng},
+                        mutable=["moe_stats"])
                 # deterministic leg for eval_batch (reference module.eval()):
                 # only if the module's __call__ actually takes the optional
                 # `deterministic` flag — the base contract (__call__(batch))
@@ -626,6 +668,18 @@ class DeepSpeedTPUEngine:
         # _build_step_functions
         self._health_enabled = bool(config.telemetry.health.enabled)
         self._health_depth = int(config.telemetry.health.group_depth)
+
+        # expert-load telemetry (moe/layer.py _sow_stats): traced INTO the
+        # step as one extra output (the health pattern — no steady-state
+        # recompile); flax MoE models only, and not under the qgZ
+        # partial-manual wrapper, whose shard_map can't carry the extra
+        # mutable-collection output
+        self._moe_stats_on = bool(
+            config.moe.expert_telemetry
+            and self._apply_fn_stats is not None
+            and getattr(getattr(model, "cfg", None), "num_experts", 0) > 0
+            and self._qgz_axis is None)
+        self._last_moe_host = None
 
         # ---- build + jit the step functions ----
         self._jit_init = jax.jit(
@@ -979,16 +1033,39 @@ class DeepSpeedTPUEngine:
         loss = apply(params, batch, rng)
         return (loss * scale).astype(jnp.float32), loss
 
+    def _loss_stats(self, params, batch, rng, scale, step=None):
+        """``_loss`` with the ``moe_stats`` sow collection mutable — aux is
+        ``(loss, stats)`` where stats aggregates the per-layer expert-load
+        sows (moe/layer.py ``_sow_stats``) into one small dict that rides
+        the step program as an extra output (the health pattern)."""
+        from deepspeed_tpu.moe.layer import aggregate_moe_stats
+        params = self._prepare_params(params, step)
+        if self.pld is not None and step is not None:
+            batch = dict(batch, pld_theta=self.pld.theta_at(step))
+        loss, var = self._apply_fn_stats(params, batch, rng)
+        stats = aggregate_moe_stats(var.get("moe_stats", {}))
+        return (loss * scale).astype(jnp.float32), (loss, stats)
+
     def _grads_one_micro(self, state: TrainState, batch, idx):
+        """One microbatch's (grads, loss, moe_stats) — moe_stats is {} off
+        the expert-telemetry path (empty pytree, free under scan/jit)."""
         rng = jax.random.fold_in(state.rng, state.step * self.gas + idx)
         if self._qgz_axis is not None:
-            return self._qgz_grads(state, batch, rng)
-        (_, loss), grads = jax.value_and_grad(self._loss, has_aux=True)(
-            state.params, batch, rng, state.loss_scale.scale, state.step)
+            grads, loss = self._qgz_grads(state, batch, rng)
+            return grads, loss, {}
+        if self._moe_stats_on:
+            (_, (loss, moe)), grads = jax.value_and_grad(
+                self._loss_stats, has_aux=True)(
+                    state.params, batch, rng, state.loss_scale.scale,
+                    state.step)
+        else:
+            (_, loss), grads = jax.value_and_grad(self._loss, has_aux=True)(
+                state.params, batch, rng, state.loss_scale.scale, state.step)
+            moe = {}
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         grads = jax.lax.with_sharding_constraint(
             grads, self.grad_shardings)
-        return grads, loss
+        return grads, loss, moe
 
     def _qgz_grads(self, state: TrainState, batch, rng):
         """qgZ grad computation, restructured as three composable stages
@@ -1165,7 +1242,8 @@ class DeepSpeedTPUEngine:
     def _accumulate_grads(self, state: TrainState, batch):
         """Scan over gas microbatches accumulating fp32 grads — the ONE
         accumulation loop, shared by the fused train step and the offload
-        grads program.  Returns (acc_grads, per-micro losses).
+        grads program.  Returns (acc_grads, per-micro losses, per-micro
+        moe stats — {} when expert telemetry is off).
 
         gas=1 bypasses the scan entirely: lax.scan lowers to a while loop
         whose carry is a SEPARATE fp32 accumulation buffer (4 bytes/param of
@@ -1173,27 +1251,30 @@ class DeepSpeedTPUEngine:
         buffer is the difference between fitting and OOM."""
         if self.gas == 1:
             mb = jax.tree_util.tree_map(lambda x: x[0], batch)
-            grads, loss = self._grads_one_micro(state, mb, jnp.int32(0))
-            return grads, loss[None]
+            grads, loss, moe = self._grads_one_micro(state, mb, jnp.int32(0))
+            return grads, loss[None], jax.tree_util.tree_map(
+                lambda a: a[None], moe)
 
         def micro(carry, xs):
             idx, mb = xs
-            grads, loss = self._grads_one_micro(state, mb, idx)
+            grads, loss, moe = self._grads_one_micro(state, mb, idx)
             acc = jax.tree_util.tree_map(jnp.add, carry, grads)
             acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
-            return acc, loss
+            return acc, (loss, moe)
 
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
         zeros = jax.lax.with_sharding_constraint(zeros, self.grad_shardings)
-        return jax.lax.scan(micro, zeros, (jnp.arange(self.gas), batch))
+        acc, (losses, moes) = jax.lax.scan(
+            micro, zeros, (jnp.arange(self.gas), batch))
+        return acc, losses, moes
 
     def _make_train_batch(self):
         if self.gas_in_model:
             # pipeline path: the model's pipelined scan IS the microbatch loop;
             # one grad computation over the whole [gas, micro, ...] batch
             def train_batch_pipe(state: TrainState, batch):
-                grads, loss = self._grads_one_micro(state, batch, 0)
+                grads, loss, _ = self._grads_one_micro(state, batch, 0)
                 grads = self._unscale(grads, state.loss_scale.scale, 1)
                 new_state, metrics, health = self._apply_update(state, grads)
                 return new_state, metrics._replace(
@@ -1202,10 +1283,14 @@ class DeepSpeedTPUEngine:
 
         def train_batch(state: TrainState, batch):
             # batch leaves: [gas, micro_global, ...]
-            acc, losses = self._accumulate_grads(state, batch)
+            acc, losses, moes = self._accumulate_grads(state, batch)
             grads = self._unscale(acc, state.loss_scale.scale, self.gas)
             new_state, metrics, health = self._apply_update(state, grads)
             metrics = metrics._replace(loss=jnp.mean(losses).astype(jnp.float32))
+            if moes:
+                # expert-load stats ride the health dict under a reserved
+                # key; popped host-side before health post-processing
+                health = dict(health, __moe__=_reduce_moe_micros(moes))
             return new_state, metrics, health
         return train_batch
 
@@ -1225,15 +1310,18 @@ class DeepSpeedTPUEngine:
 
         if self.gas_in_model:
             def grads_pipe(state: TrainState, batch):
-                grads, loss = self._grads_one_micro(state, batch, 0)
+                grads, loss, _ = self._grads_one_micro(state, batch, 0)
                 return (grads, loss.astype(jnp.float32),
                         optax.global_norm(grads), health_of(state, grads))
             return grads_pipe
 
         def grads_batch(state: TrainState, batch):
-            acc, losses = self._accumulate_grads(state, batch)
+            acc, losses, moes = self._accumulate_grads(state, batch)
+            health = health_of(state, acc)
+            if moes:
+                health = dict(health, __moe__=_reduce_moe_micros(moes))
             return (acc, jnp.mean(losses).astype(jnp.float32),
-                    optax.global_norm(acc), health_of(state, acc))
+                    optax.global_norm(acc), health)
         return grads_batch
 
     def _train_batch_offload(self, batch):
@@ -1355,6 +1443,9 @@ class DeepSpeedTPUEngine:
             # the norms to match the reported raw_norm (counts and param
             # norms are scale-free)
             from deepspeed_tpu.telemetry.health import to_python
+            if "__moe__" in health_host:   # [E] vector: not per-group stats
+                self._last_moe_host = _moe_stats_to_python(
+                    health_host.pop("__moe__"))
             health_host = to_python(health_host)
             for stats in health_host.values():
                 gn = stats.get("grad_norm")
@@ -1369,7 +1460,7 @@ class DeepSpeedTPUEngine:
 
     def _make_grad_fn(self):
         def grad_fn(state: TrainState, batch, idx):
-            grads, loss = self._grads_one_micro(state, batch, idx)
+            grads, loss, _ = self._grads_one_micro(state, batch, idx)
             return grads, loss
         return grad_fn
 
@@ -1754,6 +1845,12 @@ class DeepSpeedTPUEngine:
                            loss_scale=float(vals[2]),
                            skipped_steps=int(vals[3]))
         self._last_metrics_host = host
+        # expert-load stats ride the health pytree under a reserved key but
+        # are NOT per-group numerics (expert_tokens is an [E] vector, which
+        # to_python's float() would reject) — split them off first
+        if isinstance(health_host, dict) and "__moe__" in health_host:
+            self._last_moe_host = _moe_stats_to_python(
+                health_host.pop("__moe__"))
         self._last_health_host = to_python(health_host)
         self._host_metrics_step = self.global_steps
         return host
@@ -1821,7 +1918,7 @@ class DeepSpeedTPUEngine:
         monitor_cadence = at_cadence or (not spp and self.monitor.enabled)
         need_host = bool(at_cadence or (self.monitor.enabled
                                         and monitor_cadence)
-                         or self._health_enabled)
+                         or self._health_enabled or self._moe_stats_on)
         host = (self._fetch_metrics(metrics, self._last_health)
                 if need_host else None)
         if host is not None and at_cadence:
@@ -1849,6 +1946,11 @@ class DeepSpeedTPUEngine:
             self.telemetry.health_step(
                 self.global_steps, host, self._last_health_host,
                 lr=self.get_lr()[0], samples=samples)
+        if self._moe_stats_on and host is not None \
+                and self._last_moe_host is not None:
+            # per-expert load gauges + drop counters (telemetry registry) —
+            # reads only the host copy fetched above, no device sync
+            self.telemetry.moe_step(self._last_moe_host)
         if self.wall_clock_breakdown and at_cadence:
             self.timers.log([DATA_TIMER, TRAIN_BATCH_TIMER], normalizer=spp)
         fp = self.config.flops_profiler

@@ -57,6 +57,14 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_dropless: bool = False          # ragged grouped-GEMM routing
     #                                     (ep>1: padded-bucket a2a, no drops)
+    # ep a2a fast path (moe/comm.py; pushed from the ds_config `moe` block):
+    # wire width of dispatch/combine a2as (0=full, 8/4=blockwise int codes),
+    # quantization block, all-ICI full-width policy, and the chunk count
+    # interleaving expert GEMMs with in-flight a2a chunks
+    moe_wire_bits: int = 0
+    moe_wire_block: int = 256
+    moe_hierarchical: bool = False
+    moe_num_chunks: int = 1
     # parallelism (mesh passed separately to the GPT module attribute)
     sequence_parallel: bool = False     # attention over the sp axis
     sp_impl: str = "ulysses"            # "ulysses" (a2a head swap) | "ring"
@@ -698,6 +706,10 @@ class Block(nn.Module):
                                param_dtype=c.param_dtype,
                                dropless=c.moe_dropless,
                                gated=c.gated_mlp,
+                               wire_bits=c.moe_wire_bits,
+                               wire_block=c.moe_wire_block,
+                               hierarchical=c.moe_hierarchical,
+                               num_chunks=c.moe_num_chunks,
                                name="moe")(Norm(c)(x), rng, deterministic)
             m = pld_mask()
             if m is not None:     # one keep gates BOTH the output and the

@@ -1,0 +1,71 @@
+"""Quantized, differentiable all-to-all for the expert-parallel route.
+
+The MoE dispatch/combine all-to-alls are the dominant wire cost of an
+expert-parallel step; this module puts them on the same composable comm
+stack as the ZeRO collectives (runtime/zero.py, ZeRO++ arXiv:2306.10209):
+
+- ``qwire_a2a`` builds a ``custom_vjp`` exchange for use INSIDE the MoE
+  route's ``shard_map``: the forward moves int8/int4 codes + fp32 block
+  scales through ``ops/quantization.q_all_to_all`` (the shared wire core,
+  so the format and its ``all_to_all_q{bits}`` byte accounting live once);
+  the backward is the transposed a2a (split/concat swapped) at the SAME
+  wire width — the quantized-transpose pattern of
+  ``runtime/zero._qwire_exchange``, which keeps the wire differentiable
+  without differentiating through the quantizer's round/clip.
+- ``resolve_a2a_bits`` is the per-axis hierarchy policy
+  (``runtime/zero.resolve_wire_bits`` applied to the ep axis): all-ICI ep
+  rings keep full-width values — intra-host bandwidth is cheap and the
+  quantizer costs accuracy for nothing — while host-crossing rings
+  quantize.  Resolved OUTSIDE the shard_map, at trace time, from the mesh
+  device placement.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from deepspeed_tpu.comm import collectives
+from deepspeed_tpu.ops.quantization import q_all_to_all
+
+
+def resolve_a2a_bits(bits: int, *, hierarchical: bool, mesh=None,
+                     axis="ep") -> int:
+    """Effective wire width for the ep all-to-all pair: 0 (full width)
+    when quantization is off, or when the ``hierarchical`` policy finds
+    the axis's ring entirely inside one host (``axis_dcn_fraction == 0``).
+    Call OUTSIDE the shard_map — the decision is static per mesh."""
+    if not bits:
+        return 0
+    if hierarchical and collectives.axis_dcn_fraction(axis, mesh=mesh) == 0.0:
+        return 0
+    return bits
+
+
+def qwire_a2a(axis, size: int, split_axis: int, concat_axis: int, *,
+              bits: int = 0, block_size: int = 256):
+    """Build an all-to-all exchange function for use INSIDE ``shard_map``
+    over ``axis``: semantically ``lax.all_to_all(x, axis, split_axis,
+    concat_axis, tiled=True)`` in both directions, with ``bits``-wide
+    codes + scales on the wire when ``bits`` is 4 or 8 (0 = full width,
+    the plain logged wrapper).  The VJP is the transposed exchange —
+    ``(concat_axis, split_axis)`` — at the same wire width, so combine
+    gradients ride the quantized wire too."""
+
+    def _go(x, s, c):
+        if bits:
+            return q_all_to_all(x, axis, size, s, c,
+                                bits=bits, block_size=block_size)
+        return collectives.all_to_all(x, axis, split_dim=s, concat_dim=c)
+
+    @jax.custom_vjp
+    def exchange(x):
+        return _go(x, split_axis, concat_axis)
+
+    def _fwd(x):
+        return _go(x, split_axis, concat_axis), None
+
+    def _bwd(_, g):
+        return (_go(g, concat_axis, split_axis),)
+
+    exchange.defvjp(_fwd, _bwd)
+    return exchange

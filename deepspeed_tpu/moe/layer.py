@@ -26,11 +26,43 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from deepspeed_tpu.utils.compat import shard_map
 
+from deepspeed_tpu.moe.comm import qwire_a2a, resolve_a2a_bits
 from deepspeed_tpu.moe.sharded_moe import topk_gating
 
 
 def _part(init, names):
     return nn.with_partitioning(init, names)
+
+
+def aggregate_moe_stats(collection):
+    """Fold the per-layer ``moe_stats`` sows (engine's
+    ``mutable=["moe_stats"]`` apply) into ONE small dict: token counts sum
+    across MoE layers, aux-loss/gate-entropy average.  {} when the model
+    sowed nothing (dense model, or telemetry off)."""
+    dicts = jax.tree_util.tree_leaves(
+        collection,
+        is_leaf=lambda x: isinstance(x, dict) and "expert_tokens" in x)
+    dicts = [d for d in dicts if isinstance(d, dict)]
+    if not dicts:
+        return {}
+    n = len(dicts)      # static python int — divides arrays exactly
+    return {
+        "expert_tokens": sum(d["expert_tokens"] for d in dicts),
+        "dropped_tokens": sum(d["dropped_tokens"] for d in dicts),
+        "assigned_tokens": sum(d["assigned_tokens"] for d in dicts),
+        "aux_loss": sum(d["aux_loss"] for d in dicts) / n,
+        "gate_entropy": sum(d["gate_entropy"] for d in dicts) / n,
+    }
+
+
+def _resolve_chunks(n_units: int, num_chunks: int) -> int:
+    """Largest divisor of ``n_units`` that is <= ``num_chunks`` — the chunk
+    count must tile the expert (or assignment) dim exactly, and asking for
+    more chunks than units degrades gracefully to one unit per chunk."""
+    nc = max(1, min(num_chunks, n_units))
+    while n_units % nc:
+        nc -= 1
+    return nc
 
 
 def _expert_ffn(d, wi, wo, wg=None):
@@ -96,6 +128,15 @@ class MoE(nn.Module):
     dropless: bool = False
     # SwiGLU experts (per-expert gate matrix — Mixtral style)
     gated: bool = False
+    # wire format of the ep dispatch/combine all-to-alls (moe/comm.py):
+    # 0 = full width; 8/4 = blockwise int codes + fp32 scales
+    wire_bits: int = 0
+    wire_block: int = 256
+    # hierarchical wire policy: all-ICI ep axes stay full width
+    hierarchical: bool = False
+    # chunk the dispatch-a2a -> expert FFN -> combine-a2a chain over this
+    # many expert sub-groups so GEMMs interleave with in-flight a2a chunks
+    num_chunks: int = 1
 
     @nn.compact
     def __call__(self, x, rng: Optional[jax.Array] = None,
@@ -122,6 +163,10 @@ class MoE(nn.Module):
                                 and rng is not None) else 0.0
 
         ep = self.mesh.shape["ep"] if self.mesh is not None else 1
+        # per-axis hierarchy policy resolves OUTSIDE the shard_map (static
+        # per mesh); ep == 1 has no wire at all
+        bits = resolve_a2a_bits(self.wire_bits, hierarchical=self.hierarchical,
+                                mesh=self.mesh) if ep > 1 else 0
         if self.dropless:
             from deepspeed_tpu.moe.sharded_moe import dropless_topk
             aux, expert_idx, weights = dropless_topk(logits, self.k, rng,
@@ -131,24 +176,52 @@ class MoE(nn.Module):
                     raise ValueError(f"num_experts {E} not divisible by "
                                      f"ep {ep}")
                 out = _ep_route_dropless(self.mesh, tokens, expert_idx,
-                                         weights, wi, wo, weg)
+                                         weights, wi, wo, weg,
+                                         wire_bits=bits,
+                                         wire_block=self.wire_block,
+                                         num_chunks=self.num_chunks)
             else:
                 out = _expert_ffn_ragged(tokens, expert_idx, weights, wi, wo,
                                          weg)
+            exp_tokens = jnp.bincount(expert_idx.reshape(-1), length=E)
+            self._sow_stats(logits, aux, exp_tokens, jnp.float32(0.0))
             return self._finish(x, out.reshape(B, T, H), aux, k_init)
 
         aux, combine, dispatch = topk_gating(
             logits, self.k, cf, self.min_capacity, rng, noise_std)
 
         if ep > 1:
-            out = _ep_route(self.mesh, tokens, combine, dispatch, wi, wo, weg)
+            out = _ep_route(self.mesh, tokens, combine, dispatch, wi, wo, weg,
+                            wire_bits=bits, wire_block=self.wire_block,
+                            num_chunks=self.num_chunks)
         else:
             dispatched = jnp.einsum("sec,sh->ech",
                                     dispatch.astype(x.dtype), tokens)
             expert_out = _expert_ffn(dispatched, wi, wo, weg)
             out = jnp.einsum("sec,ech->sh", combine.astype(x.dtype), expert_out)
 
+        kept = dispatch.astype(jnp.float32)
+        self._sow_stats(logits, aux, kept.sum(axis=(0, 2)),
+                        logits.shape[0] * self.k - kept.sum())
         return self._finish(x, out.reshape(B, T, H), aux, k_init)
+
+    def _sow_stats(self, logits, aux, expert_tokens, dropped):
+        """Expert-load observability: sow per-layer routing stats into the
+        ``moe_stats`` collection (lax.stop_gradient — pure telemetry).  A
+        no-op unless the caller passes ``mutable=["moe_stats"]`` (the
+        engine's stats apply fn); guarded against ``init``, where every
+        collection is mutable and the sow would pollute the params tree."""
+        if self.is_initializing():
+            return
+        p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        ent = jnp.mean(-jnp.sum(p * jnp.log(p + 1e-9), axis=-1))
+        self.sow("moe_stats", "stats", jax.lax.stop_gradient({
+            "expert_tokens": expert_tokens.astype(jnp.float32),
+            "dropped_tokens": jnp.asarray(dropped, jnp.float32),
+            "assigned_tokens": jnp.float32(logits.shape[0] * self.k),
+            "aux_loss": jnp.asarray(aux, jnp.float32),
+            "gate_entropy": ent,
+        }))
 
     def _finish(self, x, out, aux, k_init):
         if self.use_residual:
@@ -168,7 +241,8 @@ class MoE(nn.Module):
         return out, aux
 
 
-def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo, weg=None):
+def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo, weg=None, *,
+              wire_bits: int = 0, wire_block: int = 256, num_chunks: int = 1):
     """all-to-all route (reference sharded_moe.py MOELayer.forward): dispatch
     einsum → A2A (tokens meet their expert owners) → local experts → A2A back →
     combine einsum, inside shard_map over the ep axis.
@@ -176,6 +250,12 @@ def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo, weg=None):
     Token batch is replicated over ep within each dp shard here (ep composes
     with dp/fsdp at the mesh level; each ep rank routes its 1/ep slice of the
     local tokens — reference: EP group is orthogonal to DP group).
+
+    The a2a pair goes through ``moe/comm.qwire_a2a`` — int codes + scales on
+    the wire when ``wire_bits`` is 4/8 — and the dispatch-a2a → FFN →
+    combine-a2a chain tiles over ``num_chunks`` local-expert sub-groups so
+    XLA's latency-hiding scheduler can interleave chunk c's expert GEMM with
+    chunk c+1's in-flight a2a (the T3 pattern; PR 4 chunk semantics).
     """
 
     # tokens/combine/dispatch split over the joint (dp, fsdp, ep) group so dp
@@ -188,20 +268,36 @@ def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo, weg=None):
     in_specs = (tok_spec, sec_spec, sec_spec, w_spec, w_spec) + \
         ((w_spec,) if gated else ())
 
+    ep = mesh.shape["ep"]
+    E_local = wi.shape[0] // ep
+    nc = _resolve_chunks(E_local, num_chunks)
+    g = E_local // nc                       # local experts per chunk
+    ex_d = qwire_a2a("ep", ep, 0, 1, bits=wire_bits, block_size=wire_block)
+    ex_c = qwire_a2a("ep", ep, 1, 0, bits=wire_bits, block_size=wire_block)
+
     @partial(shard_map, mesh=mesh, in_specs=in_specs,
              out_specs=tok_spec, check_vma=False)
     def route(tokens, combine, dispatch, wi, wo, *maybe_weg):
         # local shapes: tokens [S/(dp·fsdp·ep), H]; combine/dispatch [S', E, C];
-        # wi [E/ep, H, M]; wo [E/ep, M, H]
+        # wi [E/ep, H, M]
+        weg_l = maybe_weg[0] if maybe_weg else None
         dispatched = jnp.einsum("sec,sh->ech",
                                 dispatch.astype(tokens.dtype), tokens)
-        # [E, C, H] → [E/ep, C*ep, H]
-        dispatched = lax.all_to_all(dispatched, "ep", split_axis=0,
-                                    concat_axis=1, tiled=True)
-        expert_out = _expert_ffn(dispatched, wi, wo,
-                                 maybe_weg[0] if maybe_weg else None)
-        expert_out = lax.all_to_all(expert_out, "ep", split_axis=1,
-                                    concat_axis=0, tiled=True)
+        E, C, H = dispatched.shape
+        # global expert e = p*E_local + l (dest rank p, local expert l):
+        # chunk c covers local experts [c*g, (c+1)*g) on EVERY rank
+        disp4 = dispatched.reshape(ep, E_local, C, H)
+        outs = []
+        for c in range(nc):
+            lo, hi = c * g, (c + 1) * g
+            part = disp4[:, lo:hi].reshape(ep * g, C, H)
+            ex = ex_d(part)                 # [g, C*ep, H]: this rank's chunk
+            eo = _expert_ffn(ex, wi[lo:hi], wo[lo:hi],
+                             weg_l[lo:hi] if weg_l is not None else None)
+            back = ex_c(eo)                 # [g*ep, C, H], peer-major
+            outs.append(back.reshape(ep, g, C, H))
+        # [ep, nc, g, C, H] → [E, C, H]: global id p*E_local + c*g + j
+        expert_out = jnp.stack(outs, axis=1).reshape(E, C, H)
         return jnp.einsum("sec,ech->sh", combine.astype(tokens.dtype),
                           expert_out)
 
@@ -210,7 +306,8 @@ def _ep_route(mesh: Mesh, tokens, combine, dispatch, wi, wo, weg=None):
 
 
 def _ep_route_dropless(mesh: Mesh, tokens, expert_idx, weights, wi, wo,
-                       weg=None):
+                       weg=None, *, wire_bits: int = 0, wire_block: int = 256,
+                       num_chunks: int = 1):
     """Capacity-FREE expert-parallel route (round-3 VERDICT item 7 —
     reference analog: inference/v2 cutlass grouped GEMM consumed under EP;
     MegaBlocks): no token is ever dropped.
@@ -224,7 +321,14 @@ def _ep_route_dropless(mesh: Mesh, tokens, expert_idx, weights, wi, wo,
     zero-weight dummy expert), and all-to-alls results back to be combined
     at the source.  Bandwidth is worst-case padded — the price of static
     shapes; the capacity path stays available when a bounded a2a matters
-    more than zero drops."""
+    more than zero drops.
+
+    The three value a2as ride ``moe/comm.qwire_a2a`` (int wire when
+    ``wire_bits``); the int32 id buffer always moves FULL width — routing
+    indices must survive the wire exactly.  ``num_chunks`` tiles the
+    assignment dim so per-chunk expert GEMMs interleave with in-flight a2a
+    chunks; the grouping only changes GEMM batching, outputs are identical
+    row-wise."""
     ep = mesh.shape["ep"]
     E, H, M = wi.shape
     E_local = E // ep
@@ -237,11 +341,16 @@ def _ep_route_dropless(mesh: Mesh, tokens, expert_idx, weights, wi, wo,
     in_specs = (tok_spec, idx_spec, idx_spec, w_spec, w_spec) + \
         ((w_spec,) if gated else ())
 
+    # (0,0) a2a is its own transpose — one exchange serves both directions
+    ex_v = qwire_a2a("ep", ep, 0, 0, bits=wire_bits, block_size=wire_block)
+
     @partial(shard_map, mesh=mesh, in_specs=in_specs,
              out_specs=tok_spec, check_vma=False)
     def route(tokens, expert_idx, weights, wi, wo, *maybe_weg):
         S = tokens.shape[0]                      # local rows
         A = S * k
+        nc = _resolve_chunks(A, num_chunks)
+        ac = A // nc                             # assignments per chunk
         flat_e = expert_idx.reshape(A)           # global expert ids
         order = jnp.argsort(flat_e)              # by (dest rank, local expert)
         e_sorted = flat_e[order]
@@ -253,31 +362,39 @@ def _ep_route_dropless(mesh: Mesh, tokens, expert_idx, weights, wi, wo,
         pos = jnp.arange(A) - start[d_sorted]    # slot within dest bucket
 
         send = jnp.zeros((ep * A, H), tokens.dtype).at[
-            d_sorted * A + pos].set(tokens[tok_rows])
+            d_sorted * A + pos].set(tokens[tok_rows]).reshape(ep, A, H)
         ids = jnp.full((ep * A,), E_local, jnp.int32).at[
-            d_sorted * A + pos].set((e_sorted % E_local).astype(jnp.int32))
-        recv = lax.all_to_all(send.reshape(ep, A, H), "ep", 0, 0, tiled=True)
-        rids = lax.all_to_all(ids.reshape(ep, A), "ep", 0, 0, tiled=True)
+            d_sorted * A + pos].set((e_sorted % E_local).astype(
+                jnp.int32)).reshape(ep, A)
 
-        flat = recv.reshape(ep * A, H)
-        fids = rids.reshape(ep * A)
-        ord2 = jnp.argsort(fids)                 # group by local expert;
-        rows = flat[ord2]                        # sentinel rows sort last
-        gs = jnp.bincount(fids, length=E_local + 1).astype(jnp.int32)
-        pad = jnp.zeros((1, H, M), wi.dtype)
-        h = jax.lax.ragged_dot(rows, jnp.concatenate(
-            [wi, pad]).astype(rows.dtype), gs)
-        if maybe_weg:
-            h = nn.silu(jax.lax.ragged_dot(
-                rows, jnp.concatenate([maybe_weg[0], pad]).astype(rows.dtype),
-                gs)) * h
-        else:
-            h = nn.gelu(h)
-        o = jax.lax.ragged_dot(h, jnp.concatenate(
-            [wo, jnp.zeros((1, M, H), wo.dtype)]).astype(rows.dtype), gs)
-        o = o[jnp.argsort(ord2)].reshape(ep, A, H)
+        pad_i = jnp.concatenate([wi, jnp.zeros((1, H, M), wi.dtype)])
+        pad_o = jnp.concatenate([wo, jnp.zeros((1, M, H), wo.dtype)])
+        pad_g = (jnp.concatenate([maybe_weg[0],
+                                  jnp.zeros((1, H, M), wo.dtype)])
+                 if maybe_weg else None)
 
-        back = lax.all_to_all(o, "ep", 0, 0, tiled=True)
+        back_chunks = []
+        for c in range(nc):
+            lo, hi = c * ac, (c + 1) * ac
+            recv = ex_v(send[:, lo:hi])          # [ep, ac, H] values
+            rids = lax.all_to_all(ids[:, lo:hi], "ep", 0, 0, tiled=True)
+
+            flat = recv.reshape(ep * ac, H)
+            fids = rids.reshape(ep * ac)
+            ord2 = jnp.argsort(fids)             # group by local expert;
+            rows = flat[ord2]                    # sentinel rows sort last
+            gs = jnp.bincount(fids, length=E_local + 1).astype(jnp.int32)
+            h = jax.lax.ragged_dot(rows, pad_i.astype(rows.dtype), gs)
+            if pad_g is not None:
+                h = nn.silu(jax.lax.ragged_dot(
+                    rows, pad_g.astype(rows.dtype), gs)) * h
+            else:
+                h = nn.gelu(h)
+            o = jax.lax.ragged_dot(h, pad_o.astype(rows.dtype), gs)
+            o = o[jnp.argsort(ord2)].reshape(ep, ac, H)
+            back_chunks.append(ex_v(o))          # [ep, ac, H] results
+        back = jnp.concatenate(back_chunks, axis=1)   # == unchunked [ep, A, H]
+
         res_sorted = back[d_sorted, pos]         # [A, H] expert outputs
         w_sorted = weights.reshape(A)[order].astype(res_sorted.dtype)
         return jnp.zeros_like(tokens).at[tok_rows].add(

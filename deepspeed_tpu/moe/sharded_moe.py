@@ -41,7 +41,62 @@ def topk_gating(logits: jax.Array, k: int, capacity_factor: float = 1.0,
 
     Load-balancing aux loss = E * Σ_e mean(gate_e) * mean(assigned_e)
     (reference sharded_moe.py:249) computed on the top-1 assignment.
+
+    k == 1 routes through ``_top1_gating_indexed`` — same outputs bitwise
+    (test-pinned) without materializing the intermediate fp32 one-hot
+    ``[S, E]``/``[S, E, C]`` algebra, the layer's biggest HBM term at
+    large S·E·C.
     """
+    if k == 1:
+        return _top1_gating_indexed(logits, capacity_factor, min_capacity,
+                                    rng, noise_std)
+    return _topk_gating_dense(logits, k, capacity_factor, min_capacity,
+                              rng, noise_std)
+
+
+def _top1_gating_indexed(logits, capacity_factor=1.0, min_capacity=4,
+                         rng=None, noise_std=0.0):
+    """Index-based top-1 gating: argmax index + scatter instead of the dense
+    one-hot cumsum algebra.  Bitwise-equal to ``_topk_gating_dense`` at
+    k == 1: picking ``gates[s, idx]`` equals summing ``gates * one_hot``
+    (adding exact zeros), integer ranks equal the fp32 cumsum-of-one-hot
+    positions (counts < 2^24), and the dropped-token scatter adds +0.0 —
+    bitwise-neutral on the zero-initialized combine tensor."""
+    S, E = logits.shape
+    C = _capacity(S, E, capacity_factor, min_capacity, 1)
+    if rng is not None and noise_std > 0.0:
+        logits = logits + jax.random.normal(rng, logits.shape,
+                                            logits.dtype) * noise_std
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [S, E]
+    idx = jnp.argmax(gates, axis=-1)                             # [S]
+    gval = jnp.take_along_axis(gates, idx[:, None], axis=-1)[:, 0]
+
+    counts = jnp.bincount(idx, length=E)                         # [E]
+    me = jnp.mean(gates, axis=0)
+    ce = counts.astype(jnp.float32) / S
+    aux_loss = jnp.sum(me * ce) * E
+
+    gval = gval / jnp.clip(gval, 1e-9, None)
+
+    # rank within the expert queue: stable sort by expert, offset by the
+    # expert's segment start (== the dense path's cumsum-of-one-hot)
+    order = jnp.argsort(idx)
+    start = (jnp.cumsum(counts) - counts).astype(jnp.int32)      # [E]
+    pos = jnp.zeros((S,), jnp.int32).at[order].set(
+        jnp.arange(S, dtype=jnp.int32) - start[idx[order]])
+    keep = pos < C
+    combine = jnp.zeros((S, E, C), jnp.float32).at[
+        jnp.arange(S), idx, jnp.minimum(pos, C - 1)].add(gval * keep)
+    dispatch = combine > 0.0
+    return aux_loss, combine, dispatch
+
+
+def _topk_gating_dense(logits: jax.Array, k: int, capacity_factor: float = 1.0,
+                       min_capacity: int = 4, rng: Optional[jax.Array] = None,
+                       noise_std: float = 0.0,
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The dense GShard one-hot algebra, any k — the k == 1 reference for
+    the indexed fast path's bitwise pin."""
     S, E = logits.shape
     C = _capacity(S, E, capacity_factor, min_capacity, k)
     if rng is not None and noise_std > 0.0:

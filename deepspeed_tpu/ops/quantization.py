@@ -220,6 +220,32 @@ def q_reduce_rows(rows, axis: str, size: int, *, bits: int = 8,
     return total.astype(rows.dtype)
 
 
+def q_all_to_all(x, axis: str, size: int, split_axis: int, concat_axis: int,
+                 *, bits: int = 8, block_size: int = 256):
+    """Quantized all-to-all, inside ``shard_map`` over ``axis``: the exact
+    data movement of ``lax.all_to_all(x, axis, split_axis, concat_axis,
+    tiled=True)`` with int codes + fp32 block scales on the wire instead of
+    full-width values.  Each destination's slice quantizes INDEPENDENTLY
+    (blocks never straddle destinations, same invariant as
+    ``q_reduce_rows``); one stacked a2a pair moves codes + scales; each
+    received slice dequants back to ``x.dtype`` and concats along
+    ``concat_axis``.  THE quantized-a2a wire core — the MoE expert
+    dispatch/combine exchanges (moe/comm.py) run through here, so the wire
+    format and its ``all_to_all_q{bits}`` byte accounting live once."""
+    parts = jnp.split(x, size, axis=split_axis)
+    bs = _wire_block(parts[0].size, block_size)
+    qbs = [quantize_blockwise(p, bits=bits, block_size=bs) for p in parts]
+    _log_qwire("all_to_all", bits, sum(_qb_bytes(q) for q in qbs), axis,
+               size, lambda b, n: b * (n - 1) // n)
+    v = jax.lax.all_to_all(jnp.stack([q.values for q in qbs]),
+                           axis, 0, 0, tiled=False)
+    s = jax.lax.all_to_all(jnp.stack([q.scales for q in qbs]),
+                           axis, 0, 0, tiled=False)
+    return jnp.concatenate([
+        dequantize_blockwise(qbs[0]._replace(values=v[i], scales=s[i]))
+        for i in range(size)], axis=concat_axis).astype(x.dtype)
+
+
 def qag_local(xs, axis: str, size: int, gather_dim: int = 0, *,
               bits: int = 8, block_size: int = 256):
     """Per-device body of a quantized all-gather (inside ``shard_map`` over

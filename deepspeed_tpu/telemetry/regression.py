@@ -60,7 +60,7 @@ _IGNORE_SUBSTR = ("arrival_rate", "kill_at", "replicas", "num_chunks",
 _LOWER_SUFFIX = ("_ms", "_s", "_bytes", "_bytes_per_step")
 _LOWER_SUBSTR = ("step_time", "exposed", "fragmentation", "misses",
                  "starvation", "anomalies", "dumps", "regressions",
-                 "padding_waste")
+                 "padding_waste", "drop_rate")
 # zero-baseline metrics where ANY nonzero current value is a trip
 _ZERO_SENTINELS = ("starvation", "anomalies", "dumps", "misses_after_warm")
 

@@ -294,6 +294,37 @@ class StepTelemetry:
             pass
         return info["collectives"]
 
+    # ------------------------------------------------------------ MoE
+
+    def moe_step(self, stats_host: dict) -> None:
+        """Publish one step's HOST-side expert-load stats (engine
+        ``_fetch_metrics`` already paid the device fetch; ``stats_host`` is
+        plain python — moe/layer.py ``_sow_stats`` aggregated across layers
+        and microbatches).  Gauges overwrite per step; the drop counter
+        accumulates so rate() works over scrape intervals."""
+        toks = stats_host.get("expert_tokens") or []
+        g = self.registry.gauge(
+            "moe_expert_tokens",
+            "tokens assigned to each expert this step, summed over MoE "
+            "layers and microbatches (expert label = global expert index)")
+        for e, v in enumerate(toks):
+            g.set(float(v), expert=str(e))
+        self.registry.counter(
+            "moe_dropped_tokens_total",
+            "token->expert assignments dropped by the capacity limit "
+            "(always 0 on the dropless route)").inc(
+                float(stats_host.get("dropped_tokens", 0.0)))
+        self.registry.gauge(
+            "moe_aux_loss",
+            "load-balancing auxiliary loss, averaged over MoE layers "
+            "(1.0 = perfectly uniform routing under the GShard loss)"
+        ).set(float(stats_host.get("aux_loss", 0.0)))
+        self.registry.gauge(
+            "moe_gate_entropy",
+            "mean per-token entropy of the router softmax, averaged over "
+            "MoE layers (nats; ln(num_experts) = uniform)"
+        ).set(float(stats_host.get("gate_entropy", 0.0)))
+
     # ------------------------------------------------------------ health
 
     def health_step(self, step: int, metrics_host, health=None,

@@ -101,6 +101,21 @@ ROUTER_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "router.py")
 FLEET_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "fleet.py")
 GUARDIAN_PATH = os.path.join(REPO, "deepspeed_tpu", "runtime",
                              "guardian.py")
+MOE_PATH = os.path.join(REPO, "deepspeed_tpu", "moe", "layer.py")
+STEP_TELEMETRY_PATH = os.path.join(REPO, "deepspeed_tpu", "telemetry",
+                                   "step_telemetry.py")
+
+# the MoE route + expert-telemetry surface: everything here is traced into
+# the jitted step, so any host transfer would sync EVERY step; moe_step
+# publishes the gauges and must read only the host copy _fetch_metrics
+# already paid for
+MOE_FUNCS = {
+    "__call__",
+    "_sow_stats",
+    "_ep_route",
+    "_ep_route_dropless",
+    "aggregate_moe_stats",
+}
 
 # the v2 serving hot loop: scheduler + every dispatch helper.  Nested defs
 # (materialize/_append inside generate) are the sanctioned bulk-fetch
@@ -280,6 +295,11 @@ SCAN_TARGETS = [
     (FLEET_PATH, FLEET_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (AUTOSCALE_PATH, AUTOSCALE_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (GUARDIAN_PATH, GUARDIAN_FUNCS, GUARDIAN_PATTERN, ALLOW_PATTERN),
+    # MoE route bodies are jit-traced — any blocking host op would sync the
+    # step; the gauge publish (moe_step) may do host float() math but must
+    # never touch the device
+    (MOE_PATH, MOE_FUNCS, BLOCKING_PATTERN, ALLOW_PATTERN),
+    (STEP_TELEMETRY_PATH, {"moe_step"}, TRANSFER_PATTERN, ALLOW_PATTERN),
 ]
 
 
