@@ -105,9 +105,24 @@ def run_v2(cfg, params, prompts, budgets, block_size=64, kv_quant=None,
     # running the SAME workload once — greedy generate is deterministic, and
     # completed sequences are flushed so the engine returns to a clean state
     eng.generate(prompts, max_new_tokens=budgets)
-    t0 = time.perf_counter()
-    outs = eng.generate(prompts, max_new_tokens=budgets)
-    dt = time.perf_counter() - t0
+    # the telemetry leg carries the WHOLE observability layer so the
+    # paired telemetry=False replay prices it under the 2% overhead gate:
+    # request tracing (trace contexts + spans, on via the engine config)
+    # plus the SLO time-series sampler at its default fleet cadence
+    store = None
+    if telemetry:
+        from deepspeed_tpu.telemetry.timeseries import TimeSeriesStore
+        store = TimeSeriesStore(interval_s=0.25)
+        store.track_attainment(eng.telemetry.h_ttft, 500.0, key="slo.ttft")
+        store.track_attainment(eng.telemetry.h_tpot, 50.0, key="slo.tpot")
+        store.start()
+    try:
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=budgets)
+        dt = time.perf_counter() - t0
+    finally:
+        if store is not None:
+            store.stop()
     return sum(len(o) for o in outs) / dt
 
 
@@ -441,8 +456,60 @@ def run_fleet_chaos(cfg, params, prompts, budgets, rate, replicas,
     }
 
 
+def _export_disagg_trace(fleet, out_dir):
+    """Stitched-trace columns for the disagg leg: write the router trace
+    + every replica trace, merge them flow-intact
+    (scripts/merge_traces.py), decompose every completed request
+    (telemetry/critical_path.py — terms sum to measured e2e exactly),
+    and return the p99 TTFT budget as ``ttft_budget_*_ms`` columns.
+    Runs after shutdown (tracer objects outlive the workers); any
+    failure degrades to no columns, never a dead leg."""
+    out = {}
+    try:
+        import os as _os
+        import sys as _sys
+        scripts_dir = _os.path.join(_os.path.dirname(
+            _os.path.abspath(__file__)), "scripts")
+        if scripts_dir not in _sys.path:
+            _sys.path.insert(0, scripts_dir)
+        import merge_traces as _mt
+
+        from deepspeed_tpu.telemetry.critical_path import (decompose,
+                                                           ttft_budget)
+        paths = []
+        p = fleet.export_trace(_os.path.join(out_dir,
+                                             "trace_disagg_router.json"))
+        if p:
+            paths.append(p)
+        for rep in fleet.replicas.values():
+            tel = getattr(getattr(rep, "engine", None), "telemetry", None)
+            if tel is None or not getattr(tel.tracer, "events", None):
+                continue
+            path = _os.path.join(out_dir, f"trace_disagg_{rep.name}.json")
+            tel.emitter.write(path, tel.tracer)
+            paths.append(path)
+        if not paths:
+            return out
+        merged_path = _os.path.join(out_dir, "disagg_trace.json")
+        merged = _mt.merge_files(merged_path, paths)
+        rows = decompose(merged)
+        if not rows:
+            return out
+        budget = ttft_budget(rows, q=0.99)
+        for term, rec in budget["terms"].items():
+            out[f"ttft_budget_{term}"] = round(rec["p"], 2)
+        out["ttft_budget_dominant"] = budget["dominant"]
+        out["disagg_trace_requests"] = len(rows)
+        out["disagg_trace"] = merged_path
+    except Exception as e:  # noqa: BLE001 — trace export must not kill
+        print(f"bench_serving: disagg trace export failed: {e!r}",
+              file=sys.stderr)
+    return out
+
+
 def run_disagg(cfg, params, prompts, budgets, rate, replicas,
-               slo_ttft_ms, slo_tpot_ms, block_size=64, seed=11):
+               slo_ttft_ms, slo_tpot_ms, block_size=64, seed=11,
+               out_dir="./telemetry/serving_bench"):
     """Disaggregated-vs-unified leg at EQUAL replica count: the same
     open-loop Poisson arrival trace served twice through the fleet —
     once by a unified pool of N interchangeable replicas, once by a
@@ -465,7 +532,17 @@ def run_disagg(cfg, params, prompts, budgets, rate, replicas,
     with at least 3 replicas (still an equal-count comparison): a
     2-replica split is 1 prefill + 1 decode with BOTH pools at their
     min floor, so the autoscaler has no donor and the rebalance path
-    would never execute."""
+    would never execute.
+
+    The disagg pass additionally runs the full observability tentpole:
+    the SLO burn-rate monitor is armed over ``serving_ttft_ms`` and a
+    chaos latency spike (``sleep@replica.mid_decode``) is injected
+    mid-load — the resulting ``slo_alerts_total`` firing plus the burn
+    the autoscaler hook SAW come out as record columns.  The stitched
+    fleet trace (router + every replica, flow events intact) is merged
+    and decomposed (telemetry/critical_path.py) into the
+    ``ttft_budget_*_ms`` p99 columns."""
+    from deepspeed_tpu.runtime import faults
     from deepspeed_tpu.serving import ServingFleet
 
     replicas = max(3, int(replicas))
@@ -487,9 +564,28 @@ def run_disagg(cfg, params, prompts, budgets, rate, replicas,
             fcfg.update({"disaggregated": True, "prefill_replicas": 1,
                          "autoscale": {"enabled": True, "interval_s": 0.0,
                                        "cooldown_s": 1e9,
-                                       "min_requests": 1}})
+                                       "min_requests": 1,
+                                       # observe the burn signal (the
+                                       # alert must REACH a control loop)
+                                       "slo_burn_input": True},
+                         "slo": {"enabled": True,
+                                 "sample_interval_s": 0.1,
+                                 "windows_s": [1.0, 5.0],
+                                 "alert_burn_threshold": 1.0,
+                                 "slos": [{"name": "ttft",
+                                           "metric": "serving_ttft_ms",
+                                           "threshold_ms":
+                                               float(slo_ttft_ms),
+                                           "objective": 0.99}]}})
         fleet = ServingFleet(cfg, engine_config=ecfg, params=params,
                              config=fcfg)
+
+        def spike(_arrivals):
+            # chaos latency spike: 4 decode rounds each stall one replica
+            # for 2x the TTFT budget — the burn-rate monitor must page
+            faults.inject("replica.mid_decode", "sleep",
+                          arg=2.0 * float(slo_ttft_ms) / 1e3, count=4)
+
         try:
             # warm pass compiles the shared step cache for BOTH roles
             fleet.serve(prompts, max_new_tokens=budgets, max_wall_s=1800)
@@ -503,7 +599,8 @@ def run_disagg(cfg, params, prompts, budgets, rate, replicas,
                 lambda p, b, arr: fleet.serve(p, max_new_tokens=b,
                                               arrival_times=arr,
                                               max_wall_s=1800),
-                prompts, budgets, rate, seed=seed)
+                prompts, budgets, rate, seed=seed,
+                before_serve=spike if label == "disagg" else None)
             outputs[label] = outs
             good = total = 0
             ttfts = []
@@ -533,8 +630,22 @@ def run_disagg(cfg, params, prompts, budgets, rate, replicas,
                     "fleet_handoffs_total"].value(outcome="ok")
                 out["pool_rebalances_total"] = sum(
                     v for _, v in reg["pool_rebalances_total"].samples())
+                # SLO burn-rate acceptance: the chaos spike must have
+                # tripped an alert AND the autoscaler hook must have
+                # seen a nonzero burn (observability reached control)
+                out["slo_alerts_total"] = sum(
+                    v for _, v in reg["slo_alerts_total"].samples())
+                out["slo_max_burn"] = round(
+                    fleet.slo_monitor.max_burn(), 3)
+                seen = (fleet._autoscaler.last_signals or {}).get(
+                    "slo_burn")
+                out["slo_burn_seen_by_autoscaler"] = (
+                    round(float(seen), 3) if seen is not None else None)
         finally:
+            faults.reset()   # never leak an unconsumed spike
             fleet.shutdown()
+        if label == "disagg":
+            out.update(_export_disagg_trace(fleet, out_dir))
     for a, b in zip(outputs["unified"], outputs["disagg"]):
         assert np.array_equal(np.asarray(a), np.asarray(b)), \
             "disaggregation changed greedy output (must be byte-identical)"
@@ -922,7 +1033,8 @@ def main(argv=None):
         # byte-identical outputs asserted inside, goodput ratio out
         disagg_leg = leg("disagg", lambda: run_disagg(
             cfg, params, prompts, budgets, rate, args.replicas,
-            args.slo_ttft_ms, args.slo_tpot_ms)) or {}
+            args.slo_ttft_ms, args.slo_tpot_ms,
+            out_dir=args.telemetry_out)) or {}
 
     extra = {"static_batch_tokens_per_sec": round(v1_tps, 1),
              "telemetry_off_tokens_per_sec": round(v2_notel_tps, 1),

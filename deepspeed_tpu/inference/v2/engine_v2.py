@@ -233,6 +233,11 @@ class _Request:
     t_last: Optional[float] = None         # last generated token
     preempts: int = 0
     finished: bool = False                 # finish_request recorded
+    # distributed TraceContext (telemetry/tracecontext.py): fleet-minted
+    # when the request came through the router (generate(trace_ctx=...)),
+    # engine-allocated (flowless) otherwise — its ids ride the request's
+    # lifecycle spans so merged traces stitch per request
+    trace: Optional[Any] = None
 
 
 class InferenceEngineV2:
@@ -1156,7 +1161,7 @@ class InferenceEngineV2:
             t_first=r.t_first, t_last=r.t_last,
             n_prompt=len(r.prompt) - r.folded,
             n_generated=len(r.generated), preempts=r.preempts,
-            outcome=outcome)
+            outcome=outcome, trace=r.trace)
 
     # --------------------------------------- fleet drain/migration hooks
     def request_drain(self) -> None:
@@ -1222,6 +1227,7 @@ class InferenceEngineV2:
                  arrival_times: Optional[Sequence[float]] = None,
                  now_fn=None, stream: Optional[bool] = None,
                  sla: Optional[Sequence[str]] = None,
+                 trace_ctx: Optional[Sequence[Any]] = None,
                  **gen_overrides) -> List[np.ndarray]:
         """Serve a set of prompts to completion with continuous batching.
 
@@ -1253,6 +1259,13 @@ class InferenceEngineV2:
         must advance or an idle open loop spins).  ``stream`` fences each
         dispatch before timestamping (defaults to ``telemetry.stream_sync``)
         so TTFT/TPOT histograms reflect device completion.
+
+        trace_ctx: one distributed TraceContext per prompt (or None
+        entries) — the serving fleet threads each dispatch attempt's
+        context through so this engine's request spans carry the
+        fleet-wide trace/span ids and stitch into the merged cross-
+        replica view.  Absent (single-engine use), flowless contexts are
+        allocated locally so trace args stay uniformly present.
 
         sla: one ``scheduler.sla_classes`` name per prompt (default: the
         implicit ``default`` class, priority 0, no SLO).  Priority orders
@@ -1286,6 +1299,8 @@ class InferenceEngineV2:
             if name not in classes:
                 raise ValueError(f"unknown SLA class {name!r}; expected one "
                                  f"of {sorted(classes)}")
+        if trace_ctx is not None and len(trace_ctx) != len(prompts):
+            raise ValueError("trace_ctx list must match prompts")
         t_start = now_fn()
         waiting = [
             _Request(uid=-(i + 1), prompt=np.asarray(p, np.int32).reshape(-1),
@@ -1302,6 +1317,13 @@ class InferenceEngineV2:
         pool_blocks = self.state.allocator.num_blocks
         for i, r in enumerate(waiting):
             r.track = stel.new_track(f"req {i}")
+            if trace_ctx is not None and trace_ctx[i] is not None:
+                r.trace = trace_ctx[i]
+            elif stel.enabled:
+                # local root context (flow_id=None: a single-engine trace
+                # has no cross-file hop to stitch, so no flow events)
+                from deepspeed_tpu.telemetry import tracecontext
+                r.trace = tracecontext.new_trace(with_flow=False)
             r.t_arrival = t_start + (float(arrival_times[i])
                                      if arrival_times is not None else 0.0)
             if (len(r.prompt) + r.max_new_tokens

@@ -16,9 +16,12 @@ from deepspeed_tpu.serving.fleet import (FleetConfig, FleetDrained, Replica,
 from deepspeed_tpu.serving.router import (POLICIES, FleetRequest,
                                           NoHealthyReplicas, RequestFailed,
                                           Router, RouterConfig)
+from deepspeed_tpu.serving.slo import (SLOConfig, SLOMonitor, SLOSpec,
+                                       burn_rate)
 
 __all__ = ["ServingFleet", "FleetConfig", "FleetDrained", "Replica",
            "REPLICA_STATES", "Router", "RouterConfig", "FleetRequest",
            "RequestFailed", "NoHealthyReplicas", "POLICIES",
            "AdmissionController", "AdmissionConfig",
-           "PoolAutoscaler", "AutoscaleConfig"]
+           "PoolAutoscaler", "AutoscaleConfig",
+           "SLOMonitor", "SLOConfig", "SLOSpec", "burn_rate"]

@@ -70,6 +70,15 @@ class AdmissionConfig(DeepSpeedConfigModel):
     rate_window_s: float = 0.25
     retry_after_s: float = 0.25
     max_rejections: int = 0          # 0 = unbounded client retries
+    # opt-in third signal (serving/slo.py): SLO burn rate as a shed
+    # trigger.  Queue depth and kv starvation are CAUSE signals; burn
+    # rate is the EFFECT signal — latency already out of budget — so it
+    # catches overloads the queue cannot see (e.g. slow replicas at low
+    # depth).  Same hysteresis contract: trips at high, releases only
+    # when back under low (and the other signals agree).
+    slo_burn_shed: bool = False
+    high_slo_burn: float = 2.0
+    low_slo_burn: float = 1.0
 
     @model_validator(mode="after")
     def _reject_legacy_per_tick(self):
@@ -148,7 +157,8 @@ class AdmissionController:
 
     # -------------------------------------------------------- control loop
     def update(self, queue_depth: int,
-               kv_failures_total: Optional[float] = None) -> bool:
+               kv_failures_total: Optional[float] = None,
+               slo_burn: Optional[float] = None) -> bool:
         """One control tick: fold the current signals through the
         hysteresis band and return the (possibly new) shedding state.
         ``kv_failures_total`` is injectable for tests; by default it is
@@ -181,13 +191,19 @@ class AdmissionController:
             self._win_start_total = total
             self._win_start_rejections = rejections
         rate = self._rate
+        # opt-in SLO burn-rate signal (None when the fleet runs no SLO
+        # monitor, 0.0 participation when the feature flag is off)
+        burn = (float(slo_burn)
+                if cfg.slo_burn_shed and slo_burn is not None else None)
         if not self.shedding:
             if (queue_depth > cfg.high_queue_depth
-                    or rate >= cfg.high_kv_failures_per_s):
+                    or rate >= cfg.high_kv_failures_per_s
+                    or (burn is not None and burn >= cfg.high_slo_burn)):
                 self.shedding = True
         else:
             if (queue_depth <= cfg.low_queue_depth
-                    and rate <= cfg.low_kv_failures_per_s):
+                    and rate <= cfg.low_kv_failures_per_s
+                    and (burn is None or burn <= cfg.low_slo_burn)):
                 self.shedding = False
         self.g_shedding.set(1.0 if self.shedding else 0.0)
         return self.shedding

@@ -68,6 +68,13 @@ class AutoscaleConfig(DeepSpeedConfigModel):
     skew_to_decode: float = 2.0     # ratio below: prefill replica -> decode
     shed_tighten: float = 2.0       # threshold tightening while shedding
     min_requests: int = 4           # completed-request mass before acting
+    # opt-in SLO burn-rate input (serving/slo.py): while the fleet's
+    # paging-condition burn is at or past ``high_slo_burn``, the skew
+    # thresholds tighten by ``shed_tighten`` exactly as during admission
+    # shedding — the error budget is being spent too fast, so a
+    # mis-sized split must be corrected earlier
+    slo_burn_input: bool = False
+    high_slo_burn: float = 1.0
 
 
 class PoolAutoscaler:
@@ -87,6 +94,7 @@ class PoolAutoscaler:
         self.clock = clock
         self._last_eval = -math.inf
         self._last_move = -math.inf
+        self.last_signals: Dict[str, float] = {}
         self.c_rebalances = registry.counter(
             "pool_rebalances_total", "replicas moved between the prefill "
             "and decode pools by the autoscaler, per direction "
@@ -116,17 +124,20 @@ class PoolAutoscaler:
         return worst, count
 
     def signals(self, *, shedding: bool = False,
-                shed_rate: float = 0.0) -> Dict[str, float]:
+                shed_rate: float = 0.0,
+                slo_burn: Optional[float] = None) -> Dict[str, float]:
         """Read the landed signals off the shared registry.  ``shedding``/
-        ``shed_rate`` come from the fleet's admission controller (they are
-        controller state, not registry series with a stable cross-version
-        shape)."""
+        ``shed_rate`` come from the fleet's admission controller and
+        ``slo_burn`` from its SLO monitor (they are controller state, not
+        registry series with a stable cross-version shape)."""
         ttft, n_ttft = self._fleet_p99("serving_ttft_ms")
         tpot, n_tpot = self._fleet_p99("serving_tpot_ms")
         return {"ttft_p99_ms": ttft, "tpot_p99_ms": tpot,
                 "requests": min(n_ttft, n_tpot),
                 "shedding": bool(shedding),
-                "shed_rate": float(shed_rate)}
+                "shed_rate": float(shed_rate),
+                "slo_burn": (float(slo_burn)
+                             if slo_burn is not None else 0.0)}
 
     # ------------------------------------------------------------- decision
     def decide(self, signals: Dict[str, float]) -> Optional[str]:
@@ -139,8 +150,10 @@ class PoolAutoscaler:
         tpot = signals.get("tpot_p99_ms", float("nan"))
         if math.isnan(ttft) or math.isnan(tpot) or tpot <= 0.0:
             return None
+        burning = (cfg.slo_burn_input
+                   and signals.get("slo_burn", 0.0) >= cfg.high_slo_burn)
         tighten = (cfg.shed_tighten
-                   if signals.get("shedding") else 1.0)
+                   if (signals.get("shedding") or burning) else 1.0)
         ratio = ttft / tpot
         if ratio > cfg.skew_to_prefill / tighten:
             return "to_prefill"
@@ -150,7 +163,8 @@ class PoolAutoscaler:
 
     def evaluate(self, now: float, pool_sizes: Dict[str, int], *,
                  shedding: bool = False,
-                 shed_rate: float = 0.0) -> Optional[str]:
+                 shed_rate: float = 0.0,
+                 slo_burn: Optional[float] = None) -> Optional[str]:
         """Rate-limited decision against the live pool sizes: returns a
         direction the fleet should move ONE replica in, or None.  Keeps
         the ``pool_replicas`` gauge fresh as a side effect (it reads the
@@ -164,8 +178,12 @@ class PoolAutoscaler:
         if now - self._last_eval < cfg.interval_s:
             return None
         self._last_eval = now
-        direction = self.decide(
-            self.signals(shedding=shedding, shed_rate=shed_rate))
+        # kept for the bench/tests: proof of what the control loop SAW
+        # (e.g. "the burn-rate alert reached the autoscaler hook")
+        self.last_signals = self.signals(shedding=shedding,
+                                         shed_rate=shed_rate,
+                                         slo_burn=slo_burn)
+        direction = self.decide(self.last_signals)
         if direction is None:
             return None
         if now - self._last_move < cfg.cooldown_s:

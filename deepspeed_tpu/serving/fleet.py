@@ -63,6 +63,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import inspect
 import queue
 import threading
 import time
@@ -79,7 +80,9 @@ from deepspeed_tpu.serving.autoscale import AutoscaleConfig, PoolAutoscaler
 from deepspeed_tpu.serving.router import (FleetRequest, NoHealthyReplicas,
                                           RequestFailed, Router,
                                           RouterConfig)
+from deepspeed_tpu.serving.slo import SLOConfig, SLOMonitor
 from deepspeed_tpu.telemetry.registry import MetricRegistry
+from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
 from deepspeed_tpu.utils.logging import logger
 
 REPLICA_STATES = ("spawning", "healthy", "draining", "dead")
@@ -134,9 +137,17 @@ class FleetConfig(DeepSpeedConfigModel):
     # byte-identical to a unified one.
     disaggregated: bool = False
     prefill_replicas: int = 1
+    # router-side distributed tracing: the fleet records dispatch /
+    # handoff / request-envelope spans plus the Perfetto flow events
+    # (``ph`` s/t/f) that stitch one request across the per-replica
+    # trace files (telemetry/tracecontext.py).  Bounded like the replica
+    # tracers; off = zero per-request trace work on the dispatcher.
+    trace_enabled: bool = True
+    max_trace_events: int = 100_000
     router: RouterConfig = Field(default_factory=RouterConfig)
     admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     autoscale: AutoscaleConfig = Field(default_factory=AutoscaleConfig)
+    slo: SLOConfig = Field(default_factory=SLOConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +163,10 @@ class _Dispatch:
     remaining: int
     prefix: Tuple[int, ...]
     gen: int
+    # TraceContext of the dispatch attempt (already the per-attempt
+    # child span — Router.dispatch minted it); threaded into the
+    # engine's generate so replica trace files carry the fleet ids
+    trace: Any = None
 
 
 class Replica:
@@ -198,7 +213,8 @@ class Replica:
                       prompt=np.asarray(req.prompt, np.int32),
                       remaining=remaining,
                       prefix=tuple(req.generated),
-                      gen=self.fleet._serve_gen)
+                      gen=self.fleet._serve_gen,
+                      trace=req.trace)
         with self.cond:
             self.queue.append(d)
             self.cond.notify_all()
@@ -287,6 +303,28 @@ class ServingFleet:
         # ids): handoff pins released at final completion (or dropped when
         # the source incarnation — and with it the allocator — is gone)
         self._handoffs: Dict[int, Tuple[str, int, List[int]]] = {}
+        # router-side tracer: dispatch/handoff/request spans + flow
+        # events on pid 0 (replica tracers use their own pids), one tid
+        # per request.  _trace_clock_t0 anchors the fleet's injected
+        # clock onto the tracer's microsecond epoch.
+        self.tracer = SpanTracer(enabled=bool(self.config.trace_enabled),
+                                 pid=0,
+                                 max_events=int(self.config.max_trace_events))
+        self.trace_emitter = TraceEmitter(process_name="deepspeed_tpu_router")
+        self._trace_clock_t0 = self.clock()
+        # per-request start of the current router-hold interval (arrival,
+        # or the end of the previous dispatch/handoff) — the "dispatch"
+        # slice each attempt records spans it
+        self._trace_hold: Dict[int, float] = {}
+        # continuous SLO signals: ring-buffer sampling of the shared
+        # registry + multi-window burn rate over the TTFT/TPOT histograms
+        # (serving/slo.py).  Sampled from the dispatcher tick — the
+        # sampler never blocks the scheduler round.
+        self.slo_monitor: Optional[SLOMonitor] = None
+        if self.config.slo.enabled:
+            self.slo_monitor = SLOMonitor(self.config.slo,
+                                          registry=self.registry,
+                                          clock=self.clock)
         self._autoscaler: Optional[PoolAutoscaler] = None
         if self.config.disaggregated:
             self._autoscaler = PoolAutoscaler(
@@ -395,9 +433,69 @@ class ServingFleet:
             self.c_respawns.inc(1)
         return True
 
+    # ------------------------------------------------------------- tracing
+    def _trace_us(self, t: float) -> float:
+        """Map a fleet-clock timestamp onto the router tracer's epoch."""
+        return (t - self._trace_clock_t0) * 1e6
+
+    def _trace_dispatch(self, req: FleetRequest, replica_name: str,
+                        now: float) -> None:
+        """Record one dispatch attempt on the request's router track: a
+        slice covering the hold since arrival / the previous hop, plus
+        the flow event (``s`` on the first attempt, ``t`` after) that
+        chains it to the replica-side spans."""
+        if not self.tracer.enabled or req.trace is None:
+            return
+        tid = req.index + 1
+        start = self._trace_hold.get(req.index, req.t_arrival)
+        self._trace_hold[req.index] = now
+        ts = self._trace_us(start)
+        dur = max((now - start) * 1e6, 1.0)
+        self.tracer.record(f"dispatch {req.phase}", ts, dur, tid=tid,
+                           cat="router", replica=replica_name,
+                           **req.trace.args())
+        if req.trace.flow_id is not None:
+            self.tracer.flow("s" if req.attempts == 1 else "t",
+                             req.trace.flow_id, ts + dur / 2, tid=tid)
+
+    def _trace_request(self, req: FleetRequest, now: float,
+                       n_tokens: int) -> None:
+        """Record the request envelope [arrival, done] — the outer span
+        critical_path.py decomposes — and terminate the flow (``f``)."""
+        if not self.tracer.enabled or req.trace is None:
+            return
+        tid = req.index + 1
+        self.tracer.set_thread_name(tid, f"req {req.index}")
+        ts = self._trace_us(req.t_arrival)
+        dur = max((now - req.t_arrival) * 1e6, 1.0)
+        self.tracer.record(
+            "request", ts, dur, tid=tid, cat="router",
+            mode="disagg" if self.config.disaggregated else "unified",
+            index=req.index, attempts=req.attempts,
+            migrations=req.migrations, generated_tokens=int(n_tokens),
+            **req.trace.args())
+        if req.trace.flow_id is not None:
+            self.tracer.flow("f", req.trace.flow_id, ts + dur / 2, tid=tid)
+        self._trace_hold.pop(req.index, None)
+
+    def export_trace(self, path: str) -> Optional[str]:
+        """Write the router-side trace (dispatch/handoff/request spans +
+        flow events) — merge with the per-replica traces via
+        scripts/merge_traces.py for the stitched fleet view."""
+        if not self.tracer.enabled or not self.tracer.events:
+            return None
+        return self.trace_emitter.write(path, self.tracer)
+
     # ------------------------------------------------------ replica worker
     def _worker(self, rep: Replica, engine, incarnation: int) -> None:
         from deepspeed_tpu.inference.v2.engine_v2 import EngineDrained
+        # probed once per incarnation: fake/minimal engines in tests need
+        # not accept the trace_ctx keyword
+        try:
+            accepts_trace = "trace_ctx" in inspect.signature(
+                engine.generate).parameters
+        except (TypeError, ValueError):
+            accepts_trace = False
         while True:
             with rep.cond:
                 while not rep.queue:
@@ -415,9 +513,13 @@ class ServingFleet:
                 # beat (the queue wait must not count against serving)
                 rep.last_beat = self.clock()
             try:
+                gen_kwargs = {}
+                if accepts_trace:
+                    gen_kwargs["trace_ctx"] = [d.trace for d in batch]
                 outs = engine.generate(
                     [d.prompt for d in batch],
-                    max_new_tokens=[d.remaining for d in batch])
+                    max_new_tokens=[d.remaining for d in batch],
+                    **gen_kwargs)
                 items = [(d.index, d.epoch, self._stitch(d.prefix, out))
                          for d, out in zip(batch, outs)]
                 self._events.put(("complete", rep.name, incarnation,
@@ -522,6 +624,7 @@ class ServingFleet:
             self._release_handoff(index)
         self.router = Router(self.config.router, clock=self.clock,
                              registry=self.registry)
+        self._trace_hold.clear()
         t0 = self.clock()
         phase = "prefill" if self.config.disaggregated else "full"
         for i, (p, m) in enumerate(zip(prompts, max_list)):
@@ -575,9 +678,14 @@ class ServingFleet:
                     rep.engine.request_drain()
                 else:
                     self._retire_replica(rep, "drain")
-        # 4) admission control tick + dispatch
+        # 4) continuous SLO signals + admission control tick + dispatch
+        slo_burn = None
+        if self.slo_monitor is not None:
+            # cadence-gated ring-buffer sample + burn re-evaluation:
+            # bounded host reads, never blocks the round
+            slo_burn = self.slo_monitor.tick(now)
         depth = self.router.queue_depth(now)
-        self.admission.update(depth)
+        self.admission.update(depth, slo_burn=slo_burn)
         # handoff pins of requests that FAILED (retry budget, admission
         # cap, ...) never reach _complete's release — sweep them here
         if self._handoffs:
@@ -629,6 +737,7 @@ class ServingFleet:
                 continue
             try:
                 self.router.dispatch(req, rep, now)
+                self._trace_dispatch(req, rep.name, now)
             except Exception as e:  # noqa: BLE001 — injected or real
                 self.router.fail_attempt(req, now, "dispatch_error",
                                          repr(e))
@@ -684,6 +793,7 @@ class ServingFleet:
             "generated_tokens": int(len(tokens)), "attempts": req.attempts,
             "migrations": req.migrations, "rejections": req.rejections,
             "t_first": req.t_first})
+        self._trace_request(req, now, len(tokens))
 
     # ----------------------------------------------------------- KV handoff
     def _advance_phase(self, req: FleetRequest, epoch: int, tokens,
@@ -753,6 +863,22 @@ class ServingFleet:
         self.c_handoffs.inc(1, outcome="ok")
         if req.t_first is None:
             req.t_first = now
+        t_end = self.clock()
+        if self.tracer.enabled and req.trace is not None:
+            # the handoff slice is critical_path.py's b2->b3 boundary
+            # pair: [prefill result observed, decode requeue committed]
+            tid = index + 1
+            ts = self._trace_us(now)
+            dur = max((t_end - now) * 1e6, 1.0)
+            self.tracer.record("fleet.handoff", ts, dur, tid=tid,
+                               cat="router",
+                               src=src.name if src is not None else None,
+                               pinned_blocks=len(blocks),
+                               **req.trace.args())
+            if req.trace.flow_id is not None:
+                self.tracer.flow("t", req.trace.flow_id, ts + dur / 2,
+                                 tid=tid)
+            self._trace_hold[index] = t_end
         self.router.handoff(index, epoch, tokens, now)
 
     @staticmethod
@@ -798,7 +924,9 @@ class ServingFleet:
                 pools[r.role] += 1
         direction = self._autoscaler.evaluate(
             now, pools, shedding=self.admission.shedding,
-            shed_rate=self.admission.shed_rate())
+            shed_rate=self.admission.shed_rate(),
+            slo_burn=(self.slo_monitor.max_burn()
+                      if self.slo_monitor is not None else None))
         if direction is None:
             return
         donor_role = "decode" if direction == "to_prefill" else "prefill"

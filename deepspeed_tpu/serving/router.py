@@ -53,6 +53,7 @@ import numpy as np
 
 from deepspeed_tpu.config import DeepSpeedConfigModel
 from deepspeed_tpu.runtime import faults
+from deepspeed_tpu.telemetry import tracecontext
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -134,6 +135,11 @@ class FleetRequest:
     phase: str = "full"
     t_first: Optional[float] = None         # fleet-observed first-token time
     #                                         (set at handoff; None unified)
+    # distributed-trace context (telemetry/tracecontext.py): trace_id is
+    # STABLE for the request's whole lifetime — retries, migrations, and
+    # the prefill->decode handoff keep it — while each dispatch attempt
+    # mints a child span under it (Router.dispatch)
+    trace: Optional[tracecontext.TraceContext] = None
 
     @property
     def remaining(self) -> int:
@@ -233,6 +239,8 @@ class Router:
     # ----------------------------------------------------------- admission
     def submit(self, req: FleetRequest) -> None:
         self.requests[req.index] = req
+        if req.trace is None:
+            req.trace = tracecontext.new_trace(phase=req.phase)
         req.next_eligible = max(req.next_eligible, req.t_arrival)
         self.pending.append(req)
 
@@ -300,6 +308,11 @@ class Router:
         dispatch-path failure (connection refused, serialization error)
         and is the retry/backoff path's test vector."""
         req.attempts += 1              # counted even if the dispatch faults
+        if req.trace is not None:
+            # new attempt span, SAME trace/flow id: a retried or migrated
+            # request stays one causal tree with per-attempt children
+            req.trace = req.trace.child(phase=req.phase,
+                                        attempt=req.attempts)
         faults.fire("router.dispatch", index=req.index,
                     replica=replica.name)
         req.assigned = replica.name

@@ -5,7 +5,14 @@ EngineTimers, flops profiler, see_memory_usage); this package correlates
 them per step and adds the TPU-specific hazards nothing else watches:
 
 - ``tracer``         — host-phase span recording + Chrome-trace/Perfetto
-                       JSON export
+                       JSON export (incl. cross-file flow events)
+- ``tracecontext``   — per-request distributed trace/span ids threaded
+                       through the serving fleet (router -> replicas)
+- ``timeseries``     — bounded ring-buffer sampling of registry metrics
+                       with rate()/window-delta reads (SLO burn input)
+- ``critical_path``  — merged-trace e2e latency decomposition
+                       (queue_wait / prefill / handoff / decode terms
+                       that sum exactly; ``scripts/trace_report.py``)
 - ``watchdog``       — jit recompile detection with leaf-level shape diffs
 - ``registry``       — labeled counter/gauge registries (collective bytes,
                        memory gauges, cache misses)
@@ -53,6 +60,9 @@ from deepspeed_tpu.telemetry.roofline import (PEAK_SPECS, detect_peak_spec,
 from deepspeed_tpu.telemetry.serving import (ServingTelemetry,
                                              ServingTelemetryConfig)
 from deepspeed_tpu.telemetry.step_telemetry import StepTelemetry
+from deepspeed_tpu.telemetry.timeseries import (TimeSeriesStore,
+                                                histogram_attainment)
+from deepspeed_tpu.telemetry.tracecontext import TraceContext, new_trace
 from deepspeed_tpu.telemetry.tracer import SpanTracer, TraceEmitter
 from deepspeed_tpu.telemetry.watchdog import RecompileWatchdog, signature_of
 
@@ -74,7 +84,11 @@ __all__ = [
     "SnapshotExporter",
     "SpanTracer",
     "StepTelemetry",
+    "TimeSeriesStore",
+    "TraceContext",
     "TraceEmitter",
+    "histogram_attainment",
+    "new_trace",
     "log_buckets",
     "compute_group_health",
     "default_registry",

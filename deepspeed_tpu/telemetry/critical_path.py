@@ -1,0 +1,191 @@
+"""Critical-path decomposition of merged serving traces.
+
+The distributed trace (telemetry/tracecontext.py) makes a disaggregated
+request *visible* as one tree — router dispatch, prefill replica spans,
+the KV handoff, decode replica spans — but a tree is still N slices an
+operator has to eyeball.  This module turns it back into the question
+they actually ask: *where did this request's latency go, and which term
+do I buy hardware for?*  Every completed request's end-to-end time is
+decomposed into five terms that **sum to the measured e2e by
+construction**:
+
+    queue_wait   arrival -> the (final-attempt) prefill engine admits it
+    prefill      admission -> KV handoff starts (disagg) / prefill done
+    handoff      the router's KV handoff slice (zero in unified mode)
+    decode_wait  handoff done -> the decode-pool engine resumes it
+    decode       resume -> request completion
+
+The exact-sum property is structural, not numerical luck: the terms are
+consecutive differences of a monotonic boundary chain ``b0 <= b1 <= ...
+<= b5`` clamped inside the fleet's ``request`` envelope span, so they
+telescope to ``b5 - b0`` — the envelope's own duration — no matter how
+noisy the inner spans are.  A missing boundary (request failed before a
+stage, unified mode has no handoff) collapses its term to zero instead
+of guessing.
+
+Matching: the fleet stamps every router span with the request's
+distributed-trace coordinates (``args.trace`` / ``span`` / ``attempt`` /
+``phase``) and the replica engines stamp their ``queue_wait`` /
+``prefill`` / ``decode`` lifecycle spans with the same ``trace`` id.
+Retries re-enter with the ORIGINAL trace id but a new attempt number, so
+the decomposition picks the **final** attempt per phase (max attempt,
+then max ts) — the one that actually produced tokens.  Trace ids are
+process-unique (one allocator per process); merging traces from
+*different* processes keeps flows disjoint via ``flow_id_scope`` but
+this decomposition assumes one fleet's id space per merged file (the
+bench's layout).
+
+``scripts/trace_report.py`` is the CLI; the bench's disagg leg exports
+the merged trace and folds :func:`ttft_budget` into its records as
+``ttft_budget_*_ms`` columns.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+__all__ = ["TERMS", "TTFT_TERMS", "decompose", "ttft_budget"]
+
+# decomposition terms, in causal order; values are milliseconds
+TERMS = ("queue_wait_ms", "prefill_ms", "handoff_ms",
+         "decode_wait_ms", "decode_ms")
+# the terms a first token waits on — the TTFT budget (decode_ms is paid
+# after the first token is already out)
+TTFT_TERMS = ("queue_wait_ms", "prefill_ms", "handoff_ms",
+              "decode_wait_ms")
+
+
+def _span_args(ev: dict) -> dict:
+    return ev.get("args") or {}
+
+
+def _final(spans: List[dict]) -> Optional[dict]:
+    """The final-attempt span: max (attempt, ts).  Retries/migrations
+    keep the trace id and bump the attempt; the last one is the one
+    whose timing the request actually paid for."""
+    if not spans:
+        return None
+    return max(spans, key=lambda e: (int(_span_args(e).get("attempt", 0)),
+                                     float(e.get("ts", 0.0))))
+
+
+def decompose(trace: dict) -> List[dict]:
+    """Per-request critical-path rows from a (merged or single-file)
+    Chrome trace dict.  One row per fleet ``request`` envelope span;
+    requests with no envelope (still in flight / failed before
+    completion) are skipped — there is no measured e2e to decompose."""
+    by_trace: Dict[int, List[dict]] = {}
+    envelopes: List[dict] = []
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = _span_args(ev)
+        tid_ = args.get("trace")
+        if tid_ is None:
+            continue
+        if ev.get("name") == "request" and ev.get("cat") == "router":
+            envelopes.append(ev)
+        by_trace.setdefault(int(tid_), []).append(ev)
+
+    rows: List[dict] = []
+    for env in envelopes:
+        eargs = _span_args(env)
+        trace_id = int(eargs["trace"])
+        b0 = float(env["ts"])
+        b5 = b0 + float(env.get("dur", 0.0))
+        mode = str(eargs.get("mode", "unified"))
+        spans = by_trace.get(trace_id, [])
+
+        def pick(name: str, phases) -> Optional[dict]:
+            return _final([e for e in spans
+                           if e.get("name") == name
+                           and e.get("cat") == "request"
+                           and _span_args(e).get("phase") in phases])
+
+        # b1: the final prefill-side engine run admits the request
+        pre = pick("prefill", ("prefill", "full"))
+        b1 = float(pre["ts"]) if pre is not None else None
+
+        if mode == "disagg":
+            # b2/b3: the router's KV handoff slice bounds the prefill
+            # term on the left side of the pool boundary
+            hand = _final([e for e in spans
+                           if e.get("name") == "fleet.handoff"])
+            b2 = float(hand["ts"]) if hand is not None else None
+            b3 = (float(hand["ts"]) + float(hand.get("dur", 0.0))
+                  if hand is not None else None)
+            # b4: the decode-pool engine resumes (its admission point —
+            # its own "prefill" slice is the KV restore, billed to
+            # decode); fall back to its decode slice if the restore
+            # stage was skipped
+            resume = (pick("prefill", ("decode",))
+                      or pick("decode", ("decode",)))
+            b4 = float(resume["ts"]) if resume is not None else None
+        else:
+            # unified: no pool boundary — handoff and decode_wait are
+            # structurally zero; prefill ends where the engine says
+            b2 = (float(pre["ts"]) + float(pre.get("dur", 0.0))
+                  if pre is not None else None)
+            b3 = None
+            b4 = None
+
+        # clamp the chain monotonic inside the envelope: a None boundary
+        # inherits its predecessor (term -> 0), a noisy one cannot push
+        # a term negative, and the telescoped sum stays exactly b5 - b0
+        bounds = [b0]
+        for cand in (b1, b2, b3, b4):
+            prev = bounds[-1]
+            bounds.append(min(max(cand, prev), b5)
+                          if cand is not None else prev)
+        bounds.append(b5)
+
+        terms = {name: (bounds[i + 1] - bounds[i]) / 1e3
+                 for i, name in enumerate(TERMS)}
+        row = {
+            "trace": trace_id,
+            "index": eargs.get("index"),
+            "mode": mode,
+            "attempts": int(eargs.get("attempts", 1)),
+            "migrations": int(eargs.get("migrations", 0)),
+            "generated_tokens": int(eargs.get("generated_tokens", 0)),
+            "e2e_ms": (b5 - b0) / 1e3,
+            "ttft_path_ms": sum(terms[t] for t in TTFT_TERMS),
+        }
+        row.update(terms)
+        rows.append(row)
+    rows.sort(key=lambda r: r["trace"])
+    return rows
+
+
+def _quantile(values: List[float], q: float) -> float:
+    """Nearest-rank quantile (matches telemetry/histogram.py exact-mode
+    semantics) — no interpolation, so the reported p99 is a latency some
+    request actually paid."""
+    if not values:
+        return float("nan")
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+    return s[idx]
+
+
+def ttft_budget(rows: List[dict], q: float = 0.99) -> dict:
+    """Fleet-aggregate latency budget over decomposed rows: per-term
+    quantile + mean, the dominant TTFT term (the one to fix first), and
+    the e2e quantile.  Keys are stable — the bench emits them as
+    ``ttft_budget_*_ms`` record columns."""
+    out: dict = {"n_requests": len(rows), "quantile": q,
+                 "terms": {}, "dominant": None,
+                 "e2e_ms": _quantile([r["e2e_ms"] for r in rows], q),
+                 "ttft_path_ms": _quantile(
+                     [r["ttft_path_ms"] for r in rows], q)}
+    for name in TERMS:
+        vals = [r[name] for r in rows]
+        out["terms"][name] = {
+            "p": _quantile(vals, q),
+            "mean": (sum(vals) / len(vals)) if vals else float("nan"),
+        }
+    ttft_ps = {name: out["terms"][name]["p"] for name in TTFT_TERMS}
+    if rows:
+        out["dominant"] = max(ttft_ps, key=lambda k: ttft_ps[k])
+    return out

@@ -222,11 +222,11 @@ class ServingTelemetry:
         size: a long-lived engine serves unboundedly many requests, and an
         unbounded thread_names dict would leak ~100B per request forever
         (the span deque itself is bounded) — requests past the bound still
-        get a tid, just no name metadata."""
+        get a tid, just no name metadata (the bound now lives inside
+        ``SpanTracer.set_thread_name``)."""
         self._track_count += 1
         tid = self._track_count
-        if (self.tracer.enabled
-                and len(self.tracer.thread_names) < self.tracer.max_events):
+        if self.tracer.enabled:
             self.tracer.set_thread_name(tid, label)
         return tid
 
@@ -235,7 +235,8 @@ class ServingTelemetry:
                        t_prefill_end: Optional[float],
                        t_first: Optional[float], t_last: Optional[float],
                        n_prompt: int, n_generated: int,
-                       preempts: int = 0, outcome: str = "completed") -> None:
+                       preempts: int = 0, outcome: str = "completed",
+                       trace=None) -> None:
         """Record one retired request: latency histograms + the three
         lifecycle spans on the request's own track.  Timestamps are
         ``now()`` seconds; missing stages (a zero-token completion) are
@@ -268,14 +269,30 @@ class ServingTelemetry:
             args = {"uid": uid, "prompt_tokens": int(n_prompt),
                     "generated_tokens": int(n_generated),
                     "preempts": int(preempts), "outcome": outcome}
+            if trace is not None:
+                # distributed-trace coordinates: critical_path.py matches
+                # these engine spans back to fleet requests by (trace,
+                # phase) and picks the final attempt by timestamp
+                args.update(trace.args())
             spans = [("queue_wait", t_arrival, t_admit),
                      ("prefill", t_admit, t_prefill_end),
                      ("decode", t_prefill_end, t_last)]
+            first_ts = None
             for name, a, b in spans:
                 if a is None or b is None or b < a:
                     continue
-                self.tracer.record(name, self._trace_us(a), (b - a) * 1e6,
+                ts = self._trace_us(a)
+                if first_ts is None:
+                    first_ts = (ts, (b - a) * 1e6)
+                self.tracer.record(name, ts, (b - a) * 1e6,
                                    tid=track, cat="request", **args)
+            if (trace is not None and trace.flow_id is not None
+                    and first_ts is not None):
+                # flow step binding to this replica's first lifecycle
+                # slice: the router's `s` event + this `t` + the fleet's
+                # `f` stitch the request into one cross-replica tree
+                self.tracer.flow("t", trace.flow_id,
+                                 first_ts[0] + first_ts[1] / 2, tid=track)
 
     # ----------------------------------------------------------- counters
 

@@ -13,6 +13,11 @@ device profile.
 Events use the ``ph: "X"`` (complete) form with microsecond timestamps
 relative to tracer construction; ``pid`` is the JAX process index so
 multi-host traces merge cleanly.
+
+Flow events (``ph: "s"/"t"/"f"``) stitch one request's spans across
+replica trace files into a single causal tree (see ``flow()`` and
+``telemetry/tracecontext.py``); ``scripts/merge_traces.py`` remaps their
+ids per ``otherData.flow_id_scope`` so merged trees survive.
 """
 
 from __future__ import annotations
@@ -23,6 +28,11 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Dict, Optional
+
+
+def _flow_scope() -> str:
+    from .tracecontext import FLOW_SCOPE
+    return FLOW_SCOPE
 
 
 class SpanTracer:
@@ -108,10 +118,41 @@ class SpanTracer:
             agg["max_ms"] = dur_ms
         self.last_dur_ms[name] = round(dur_ms, 3)
 
+    def flow(self, ph: str, flow_id: int, ts_us: float, tid: int = 0,
+             name: str = "request_flow", cat: str = "flow") -> None:
+        """Emit a Perfetto flow event (``ph`` one of ``s``/``t``/``f``).
+
+        Flow events bind to the slice enclosing ``ts_us`` on this
+        pid/tid; a chain of same-``id`` events renders as arrows linking
+        the slices — one request's causal tree across replicas.  They
+        ride the same bounded event buffer as spans (and count against
+        ``dropped_events``), so a long-lived fleet cannot leak per-
+        request flow records."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name, "cat": cat, "ph": ph, "ts": round(ts_us, 3),
+            "pid": self.pid, "tid": int(tid), "id": int(flow_id),
+        }
+        if ph == "f":
+            ev["bp"] = "e"   # bind to the enclosing slice, not the next
+        if len(self.events) == self.max_events:
+            self.dropped_events += 1
+        self.events.append(ev)
+        self.total_recorded += 1
+
     def set_thread_name(self, tid: int, name: str) -> None:
         """Name a tid's track in the emitted trace (Perfetto thread_name
-        metadata) — the serving layer names each request's track."""
-        self.thread_names[int(tid)] = str(name)
+        metadata) — the serving layer names each request's track.  The
+        map is bounded by ``max_events`` (same policy as the event
+        buffer): past the cap, new tids go unnamed rather than growing
+        per-request metadata without limit."""
+        tid = int(tid)
+        if tid not in self.thread_names and \
+                len(self.thread_names) >= self.max_events:
+            self.dropped_events += 1
+            return
+        self.thread_names[tid] = str(name)
 
     def summary(self) -> Dict[str, dict]:
         """Per-phase count / total / max / mean milliseconds — the compact
@@ -169,6 +210,10 @@ class TraceEmitter:
                 # stamp existed — the merger then falls back to as-is)
                 "epoch_unix_time": getattr(tracer, "epoch_unix_time",
                                            None),
+                # flow-id allocator scope: files sharing this token used
+                # one id space (merge keeps their flows stitched); files
+                # from different scopes get disjoint remapped ids
+                "flow_id_scope": _flow_scope(),
             },
         }
 

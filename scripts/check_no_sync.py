@@ -63,6 +63,13 @@ Scan targets (each file gets the pattern matching its hazard class):
   remediation machinery that exists for the rare bad one.  (Ring exports
   go through ``CheckpointRing.export`` → the crash-safe universal export,
   which is synchronous by design at its checkpoint cadence.)
+- ``deepspeed_tpu/telemetry/tracecontext.py`` id minting,
+  ``deepspeed_tpu/telemetry/timeseries.py`` sampler/read surface, and
+  ``deepspeed_tpu/serving/slo.py`` burn evaluation — transfers, sleeps,
+  and undisclosed lock acquisitions: trace contexts are minted on the
+  router dispatch path and the sampler runs inside the dispatcher tick,
+  so both must stay bounded host work (the id-counter locks and the
+  histogram-copy lock are the disclosed ``# sync-ok`` sites).
 
 Allowed on any line: ``device_get`` in engine.py (an explicit, visible
 host fetch — the sanctioned way to cross the boundary there) and a
@@ -210,6 +217,10 @@ FLEET_FUNCS = {
     "_retire_replica",
     "drain_replica",
     "drain_all",
+    # request-tracing hooks ride the same tick: deque appends only
+    "_trace_us",
+    "_trace_dispatch",
+    "_trace_request",
 }
 
 # the pool autoscaler: evaluate/decide run inside the dispatcher tick and
@@ -223,6 +234,48 @@ AUTOSCALE_FUNCS = {
     "evaluate",
     "record_move",
     "_fleet_p99",
+}
+
+# distributed trace-context minting runs on the router submit/dispatch
+# path and inside every replica engine's admission loop: id allocation
+# takes a process-wide lock (the two disclosed sites), and nothing there
+# may sleep or touch a device.  reset_ids (test isolation) is excluded.
+TRACECTX_PATH = os.path.join(REPO, "deepspeed_tpu", "telemetry",
+                             "tracecontext.py")
+TRACECTX_FUNCS = {
+    "_next_trace_id",
+    "_next_span_id",
+    "new_trace",
+    "child",
+    "args",
+}
+
+# the time-series sampler + SLO burn evaluation run inside the fleet
+# dispatcher tick (maybe_sample / tick / the read helpers): bounded
+# host-memory walks only — the histogram-lock copy in
+# histogram_attainment is the one disclosed blocking site.  start/stop
+# (the background-thread harness mode) block by design and are excluded.
+TIMESERIES_PATH = os.path.join(REPO, "deepspeed_tpu", "telemetry",
+                               "timeseries.py")
+TIMESERIES_FUNCS = {
+    "histogram_attainment",
+    "maybe_sample",
+    "track",
+    "track_counter",
+    "track_attainment",
+    "series",
+    "latest",
+    "value_at",
+    "window_delta",
+    "rate",
+}
+SLO_PATH = os.path.join(REPO, "deepspeed_tpu", "serving", "slo.py")
+SLO_FUNCS = {
+    "burn_rate",
+    "tick",
+    "_evaluate_alerts",
+    "max_burn",
+    "_track",
 }
 
 # the guardian control loop: the per-step half (run/_assess/
@@ -281,6 +334,13 @@ GUARDIAN_PATTERN = re.compile(
 # '# sync-ok' comment discloses a reviewed, intentional sync
 ENGINE_ALLOW = re.compile(r"device_get|#\s*sync-ok")
 ALLOW_PATTERN = re.compile(r"#\s*sync-ok")
+# trace-context minting + the timeseries/SLO sampler: the generic
+# transfer class plus the two blocking shapes that could sneak into a
+# sampler (a sleep, an undisclosed lock acquisition — the disclosed
+# ones carry `# sync-ok` on the line)
+SAMPLER_PATTERN = re.compile(
+    r"device_get|block_until_ready|time\.sleep"
+    r"|with\s+\S*_lock|\.acquire\(")
 
 # (path, functions to scan, hazard pattern, allow pattern)
 SCAN_TARGETS = [
@@ -300,6 +360,11 @@ SCAN_TARGETS = [
     # never touch the device
     (MOE_PATH, MOE_FUNCS, BLOCKING_PATTERN, ALLOW_PATTERN),
     (STEP_TELEMETRY_PATH, {"moe_step"}, TRANSFER_PATTERN, ALLOW_PATTERN),
+    # distributed tracing + SLO sampling on the dispatcher tick: lock
+    # acquisitions must be disclosed, sleeps/transfers never allowed
+    (TRACECTX_PATH, TRACECTX_FUNCS, SAMPLER_PATTERN, ALLOW_PATTERN),
+    (TIMESERIES_PATH, TIMESERIES_FUNCS, SAMPLER_PATTERN, ALLOW_PATTERN),
+    (SLO_PATH, SLO_FUNCS, SAMPLER_PATTERN, ALLOW_PATTERN),
 ]
 
 

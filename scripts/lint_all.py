@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Run every repo lint in ONE process with a unified summary.
 
-Four lints guard four interfaces, and until now each was wired into the
-test suite as its own subprocess run (three interpreter startups + three
-jax imports just to say "clean"):
+Each lint guards one interface, and until now each was wired into the
+test suite as its own subprocess run (an interpreter startup + a jax
+import per lint just to say "clean"):
 
 - ``check_no_sync``  — no undisclosed host↔device syncs on dispatch paths
 - ``check_overlap``  — chunked collectives keep compute between them
@@ -11,6 +11,9 @@ jax imports just to say "clean"):
 - ``check_metrics``  — metric naming convention + docs coverage
 - ``check_bench --self-test`` — the bench regression sentinel trips on
   the canned 10% slowdown fixture and stays quiet in the noise band
+- ``trace_report --self-test`` — the critical-path decomposition holds
+  its exact-sum + zero-handoff-in-unified invariants on the canned
+  disagg+unified trace fixture
 
 This driver imports each lint's ``main()`` and runs them back to back,
 printing one PASS/FAIL table.  The test suite shells THIS script once
@@ -53,12 +56,14 @@ def _lints() -> List[Tuple[str, Callable[[], int]]]:
     import check_metrics
     import check_no_sync
     import check_overlap
+    import trace_report
     return [
         ("check_no_sync", lambda: check_no_sync.main([])),
         ("check_overlap", lambda: check_overlap.main(
             ["--demo", "--assert-overlap", "--min-chunks", "2"])),
         ("check_metrics", lambda: check_metrics.main([])),
         ("check_bench", lambda: check_bench.main(["--self-test"])),
+        ("trace_report", lambda: trace_report.main(["--self-test"])),
     ]
 
 
@@ -97,8 +102,9 @@ def run_all(only: Optional[List[str]] = None,
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
-        description="run check_no_sync, check_overlap, check_metrics and "
-                    "the check_bench fixture lint in one process")
+        description="run check_no_sync, check_overlap, check_metrics, "
+                    "the check_bench fixture lint and the trace_report "
+                    "fixture lint in one process")
     ap.add_argument("--only", nargs="+", metavar="LINT",
                     help="subset of lints to run (by name)")
     ap.add_argument("--verbose", action="store_true",

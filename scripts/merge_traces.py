@@ -18,6 +18,13 @@ the survivor's pickup sit on one timeline.  This tool merges them:
   metadata + the file label), so N replicas' track-0 dispatch rows don't
   collapse onto each other.  Thread (tid) metadata — the per-request
   track names — is carried through untouched.
+- **flow-id remapping**: flow events (``ph`` s/t/f) are keyed by
+  ``(otherData.flow_id_scope, id)`` — files written by the same process
+  share one id space (their stitched request trees survive the merge),
+  while files from different processes are remapped onto disjoint ids so
+  unrelated requests never collide into one accidental flow.  Files
+  missing the scope stamp get a per-file scope (safe, but cross-file
+  stitching is then impossible for them).
 
 Usage:
 
@@ -58,6 +65,10 @@ def merge_traces(traces: List[dict],
     t0 = min(known) if known else None
     unaligned: List[str] = []
     events: List[dict] = []
+    # (flow_id_scope, original id) -> merged id.  Same-scope inputs map
+    # identical ids to the SAME merged id (stitching survives); distinct
+    # scopes can never share a merged id (no collisions).
+    flow_ids: dict = {}
     for pid, (trace, label, epoch) in enumerate(
             zip(traces, labels, epochs)):
         if epoch is None:
@@ -65,6 +76,8 @@ def merge_traces(traces: List[dict],
             unaligned.append(label)
         else:
             offset_us = (epoch - t0) * 1e6
+        scope = trace.get("otherData", {}).get("flow_id_scope") \
+            or f"__file{pid}"
         proc_name = label
         for ev in trace["traceEvents"]:
             if ev.get("ph") == "M":
@@ -76,6 +89,11 @@ def merge_traces(traces: List[dict],
                 events.append(ev)
                 continue
             ev = dict(ev, pid=pid)
+            if ev.get("ph") in ("s", "t", "f") and "id" in ev:
+                key = (scope, ev["id"])
+                if key not in flow_ids:
+                    flow_ids[key] = len(flow_ids) + 1
+                ev["id"] = flow_ids[key]
             if offset_us and "ts" in ev:
                 ev["ts"] = round(float(ev["ts"]) + offset_us, 3)
             events.append(ev)

@@ -626,6 +626,42 @@ class TestMergeTraces:
                 if e.get("name") == "b"][0]
         assert b_ev["ts"] == 2.0
 
+    def test_flow_id_remap_stitches_within_scope_only(self):
+        """Flow events are remapped per ``(flow_id_scope, id)``: files
+        written by the SAME process keep their stitched request trees,
+        while a foreign scope (or a legacy file with no stamp) using the
+        numerically identical id lands on a disjoint merged id — two
+        unrelated requests can never collide into one accidental flow."""
+        from deepspeed_tpu.telemetry.tracer import (SpanTracer,
+                                                    TraceEmitter)
+        mt = _scripts_import("merge_traces")
+
+        def flow_trace(ph, fid, scope=...):
+            tr = SpanTracer(enabled=True, pid=0)
+            tr.epoch_unix_time = 1000.0
+            tr.record("dispatch", 10.0, 5.0)
+            tr.flow(ph, fid, 12.0)
+            d = TraceEmitter().to_dict(tr)
+            if scope is None:
+                del d["otherData"]["flow_id_scope"]
+            elif scope is not ...:
+                d["otherData"]["flow_id_scope"] = scope
+            return d
+
+        merged = mt.merge_traces(
+            [flow_trace("s", 7),                     # router start
+             flow_trace("t", 7),                     # replica, same proc
+             flow_trace("s", 7, scope="other-host"),
+             flow_trace("s", 7, scope=None)],        # pre-stamp legacy
+            ["r0", "r1", "alien", "legacy"])
+        flows = {e["pid"]: e for e in merged["traceEvents"]
+                 if e.get("ph") in ("s", "t", "f")}
+        assert len(flows) == 4
+        # same scope + same id -> SAME merged id: the tree survives
+        assert flows[0]["id"] == flows[1]["id"]
+        # foreign/legacy files get ids disjoint from everyone else's
+        assert len({e["id"] for e in flows.values()}) == 3
+
     def test_cli(self, tmp_path):
         t = self._trace(0, 5.0, [("a", 1.0, 1.0, 0)])
         p = tmp_path / "t.json"

@@ -1,0 +1,395 @@
+"""Distributed request tracing + SLO burn-rate signals (ISSUE 19).
+
+Hand-computed ground truth where the ISSUE asks for it: the critical-path
+decomposition is checked for the EXACT-sum property (terms sum to the
+measured e2e by construction) and the zero-handoff/zero-decode-wait
+invariant on unified requests; trace ids are checked STABLE across
+retries, migrations, and the prefill->decode handoff while every attempt
+mints a fresh child span; burn rates are checked against hand-computed
+values (5 bad of 10 under a 90% objective burns exactly 5.0x) including
+the multi-window page/warn split and edge-triggered alert counters; the
+tracer's flow events and thread-name map are checked bounded by
+``max_events`` with dropped-event disclosure.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+SCRIPTS = os.path.join(REPO, "scripts")
+
+from deepspeed_tpu.serving import (SLOMonitor, SLOSpec,  # noqa: E402,F401
+                                   burn_rate)
+from deepspeed_tpu.serving.router import (FleetRequest,  # noqa: E402
+                                          Router, RouterConfig)
+from deepspeed_tpu.telemetry import tracecontext  # noqa: E402
+from deepspeed_tpu.telemetry.critical_path import (TERMS,  # noqa: E402
+                                                   TTFT_TERMS, decompose,
+                                                   ttft_budget)
+from deepspeed_tpu.telemetry.registry import MetricRegistry  # noqa: E402
+from deepspeed_tpu.telemetry.timeseries import (  # noqa: E402
+    TimeSeriesStore, histogram_attainment)
+from deepspeed_tpu.telemetry.tracer import (SpanTracer,  # noqa: E402
+                                            TraceEmitter)
+
+
+def _scripts_import(name):
+    sys.path.insert(0, SCRIPTS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ========================================================== trace context
+
+class TestTraceContext:
+    def test_root_child_and_args(self):
+        tracecontext.reset_ids()
+        root = tracecontext.new_trace(phase="prefill")
+        assert root.trace_id == 1
+        assert root.flow_id == root.trace_id     # flow id IS the trace id
+        assert root.parent_id is None
+        assert "parent_span" not in root.args()
+
+        c1 = root.child(attempt=1)
+        assert c1.trace_id == root.trace_id      # stable across attempts
+        assert c1.flow_id == root.flow_id
+        assert c1.span_id != root.span_id        # fresh span per attempt
+        assert c1.parent_id == root.span_id      # linked to its cause
+        assert c1.phase == "prefill"             # inherited
+
+        c2 = c1.child(phase="decode", attempt=2)
+        assert c2.trace_id == root.trace_id
+        assert c2.parent_id == c1.span_id
+        a = c2.args()
+        assert a == {"trace": root.trace_id, "span": c2.span_id,
+                     "attempt": 2, "phase": "decode",
+                     "parent_span": c1.span_id}
+
+    def test_without_flow(self):
+        ctx = tracecontext.new_trace(with_flow=False)
+        assert ctx.flow_id is None
+        assert ctx.child(attempt=1).flow_id is None
+
+    def test_ids_unique_across_traces(self):
+        a = tracecontext.new_trace()
+        b = tracecontext.new_trace()
+        assert a.trace_id != b.trace_id
+        assert a.span_id != b.span_id
+
+
+# ============================================= trace-id stability (router)
+
+class _Replica:
+    def __init__(self, name, role=None):
+        self.name = name
+        self.role = role
+        self.queue = []
+
+    def enqueue(self, req):
+        self.queue.append(req)
+
+
+def _router(**cfg):
+    t = [0.0]
+    r = Router(RouterConfig(**cfg), clock=lambda: t[0],
+               registry=MetricRegistry())
+    return r, t
+
+
+class TestTraceIdStability:
+    def test_submit_allocates_root_with_flow(self):
+        r, _ = _router()
+        req = FleetRequest(index=0, prompt=np.arange(4, dtype=np.int32),
+                           max_new_tokens=4)
+        r.submit(req)
+        assert req.trace is not None
+        assert req.trace.flow_id == req.trace.trace_id
+        assert req.trace.phase == "full"
+        assert req.trace.attempt == 0            # no dispatch yet
+
+    def test_retry_keeps_trace_id_new_attempt_span(self):
+        r, _ = _router()
+        req = FleetRequest(index=0, prompt=np.arange(4, dtype=np.int32),
+                           max_new_tokens=4)
+        r.submit(req)
+        root = req.trace
+        r.dispatch(req, _Replica("r0"), 0.0)
+        a1 = req.trace
+        assert a1.trace_id == root.trace_id
+        assert (a1.attempt, a1.parent_id) == (1, root.span_id)
+
+        r.fail_attempt(req, 0.0, "dispatch_error")
+        assert req.index not in r.failed         # budget not exhausted
+        r.dispatch(req, _Replica("r1"), 1.0)
+        a2 = req.trace
+        assert a2.trace_id == root.trace_id      # ONE causal tree
+        assert a2.flow_id == root.flow_id        # ONE stitched flow
+        assert a2.span_id != a1.span_id
+        assert (a2.attempt, a2.parent_id) == (2, a1.span_id)
+
+    def test_migration_keeps_trace_id(self):
+        r, _ = _router()
+        req = FleetRequest(index=0, prompt=np.arange(4, dtype=np.int32),
+                           max_new_tokens=4)
+        r.submit(req)
+        root = req.trace
+        r.dispatch(req, _Replica("r0"), 0.0)
+        a1 = req.trace
+        # replica death: folded re-entry keeps the ORIGINAL trace id
+        r.migrate(req, 0.5, reason="replica_death",
+                  record={"prompt": np.arange(6, dtype=np.int32),
+                          "generated": [40, 41]})
+        assert req.trace is a1                   # fold does not re-span
+        r.dispatch(req, _Replica("r1"), 0.5)
+        a2 = req.trace
+        assert a2.trace_id == root.trace_id
+        assert (a2.attempt, a2.parent_id) == (2, a1.span_id)
+
+    def test_handoff_same_trace_decode_phase_child(self):
+        r, _ = _router(disaggregated=True)
+        req = FleetRequest(index=0, prompt=np.arange(4, dtype=np.int32),
+                           max_new_tokens=4, phase="prefill")
+        r.submit(req)
+        root = req.trace
+        assert root.phase == "prefill"
+        r.dispatch(req, _Replica("p0", role="prefill"), 0.0)
+        a1 = req.trace
+        out = r.handoff(0, req.epoch,
+                        np.array([42], dtype=np.int32), 1.0)
+        assert out is req and req.phase == "decode"
+        assert req.trace is a1                   # handoff keeps the span;
+        #                                          the next dispatch mints
+        r.dispatch(req, _Replica("d0", role="decode"), 1.0)
+        a2 = req.trace
+        assert a2.trace_id == root.trace_id      # prefill + decode legs
+        assert a2.flow_id == root.flow_id        # are one stitched tree
+        assert (a2.phase, a2.attempt) == ("decode", 2)
+        assert a2.parent_id == a1.span_id
+
+
+# ======================================================== critical path
+
+class TestCriticalPath:
+    @pytest.fixture()
+    def rows(self):
+        fixture = _scripts_import("trace_report").canned_fixture()
+        return decompose(fixture)
+
+    def test_terms_sum_exactly_to_e2e(self, rows):
+        assert len(rows) == 2
+        for r in rows:
+            assert sum(r[t] for t in TERMS) == pytest.approx(
+                r["e2e_ms"], abs=1e-9)
+
+    def test_disagg_hand_computed_terms(self, rows):
+        dis = {r["trace"]: r for r in rows}[1]
+        assert dis["mode"] == "disagg"
+        assert dis["queue_wait_ms"] == pytest.approx(1.0)
+        assert dis["prefill_ms"] == pytest.approx(3.0)
+        assert dis["handoff_ms"] == pytest.approx(1.0)
+        assert dis["decode_wait_ms"] == pytest.approx(1.0)
+        assert dis["decode_ms"] == pytest.approx(4.0)
+        assert dis["e2e_ms"] == pytest.approx(10.0)
+        # TTFT path = everything before the first decoded token
+        assert dis["ttft_path_ms"] == pytest.approx(6.0)
+
+    def test_unified_handoff_and_decode_wait_zero(self, rows):
+        uni = {r["trace"]: r for r in rows}[2]
+        assert uni["mode"] == "unified"
+        assert uni["handoff_ms"] == 0.0
+        assert uni["decode_wait_ms"] == 0.0
+        assert uni["e2e_ms"] == pytest.approx(6.0)
+
+    def test_budget_dominant_is_a_ttft_term(self, rows):
+        budget = ttft_budget(rows, q=0.99)
+        assert budget["n_requests"] == 2
+        assert budget["dominant"] in TTFT_TERMS
+        assert set(budget["terms"]) == set(TERMS)
+        # aggregate p-terms keep the per-request exact-sum flavor: each
+        # term's p99 comes from real rows, so none exceeds the e2e p99
+        for name in TERMS:
+            assert budget["terms"][name]["p"] <= budget["e2e_ms"]
+
+    def test_empty_trace_no_rows(self):
+        assert decompose({"traceEvents": []}) == []
+        assert ttft_budget([], q=0.99)["n_requests"] == 0
+
+
+# ====================================================== burn-rate math
+
+def _monitor(reg, windows, clock, **cfg):
+    return SLOMonitor(dict(enabled=True, sample_interval_s=1.0,
+                           windows_s=windows,
+                           slos=[{"name": "ttft",
+                                  "metric": "serving_ttft_ms",
+                                  "threshold_ms": 500.0,
+                                  "objective": 0.9}], **cfg),
+                      registry=reg, clock=clock)
+
+
+class TestBurnRate:
+    def test_pure_math_hand_computed(self):
+        # 5 bad of 10 under a 90% objective: bad fraction 0.5 over the
+        # 0.1 allowed -> burns the budget at exactly 5x sustainable
+        assert burn_rate(5, 10, 0.9) == pytest.approx(5.0)
+        assert burn_rate(10, 10, 0.9) == 0.0          # all good
+        assert burn_rate(0, 10, 0.9) == pytest.approx(10.0)
+        assert burn_rate(0, 0, 0.9) == 0.0            # no traffic, no burn
+        assert burn_rate(9, 10, 0.999) == pytest.approx(100.0)
+        assert burn_rate(5, 10, 1.0) == float("inf")  # zero budget
+
+    def test_window_burn_and_page_alert_hand_computed(self):
+        reg = MetricRegistry()
+        hist = reg.histogram("serving_ttft_ms",
+                             buckets=(100.0, 500.0, 1000.0))
+        t = [0.0]
+        mon = _monitor(reg, [4.0, 8.0], lambda: t[0])
+        assert mon.tick(0.0) == 0.0               # baseline: no traffic
+
+        for _ in range(5):
+            hist.observe(100.0)                   # good (<= 500 ms)
+        for _ in range(5):
+            hist.observe(2000.0)                  # bad
+        assert mon.tick(1.0) == pytest.approx(5.0)
+        for w in (4.0, 8.0):
+            assert mon.last_burn["ttft"][w] == pytest.approx(5.0)
+            assert reg.gauge("slo_burn_rate").value(
+                slo="ttft", window=f"{w:g}s") == pytest.approx(5.0)
+        # every window past threshold 1.0 -> page, edge-triggered once
+        alerts = reg.counter("slo_alerts_total")
+        assert alerts.value(slo="ttft", severity="page") == 1
+        assert alerts.value(slo="ttft", severity="warn") == 0
+        mon.tick(2.0)                             # still burning: no re-fire
+        assert alerts.value(slo="ttft", severity="page") == 1
+
+        # recovery: the bad burst slides out of both windows
+        mon.tick(10.0)
+        assert mon.tick(20.0) == 0.0
+        assert mon.last_burn["ttft"][4.0] == 0.0
+
+        # a SECOND burst re-fires the edge-triggered counter
+        for _ in range(10):
+            hist.observe(2000.0)
+        assert mon.tick(21.0) == pytest.approx(10.0)   # all bad
+        assert alerts.value(slo="ttft", severity="page") == 2
+
+    def test_short_window_only_warns_not_pages(self):
+        reg = MetricRegistry()
+        hist = reg.histogram("serving_ttft_ms",
+                             buckets=(100.0, 500.0, 1000.0))
+        t = [0.0]
+        mon = _monitor(reg, [2.0, 100.0], lambda: t[0])
+        mon.tick(0.0)
+        for _ in range(100):
+            hist.observe(100.0)                   # a good hour of traffic
+        mon.tick(1.0)
+        hist.observe(2000.0)
+        hist.observe(2000.0)                      # 2 bad blips
+        mon.tick(3.0)
+        # short window sees only the blips (burn 10); the long window
+        # dilutes them into history: bad = 2/102 -> burn ~0.196
+        assert mon.last_burn["ttft"][2.0] == pytest.approx(10.0)
+        assert mon.last_burn["ttft"][100.0] == pytest.approx(
+            (2.0 / 102.0) / 0.1)
+        alerts = reg.counter("slo_alerts_total")
+        assert alerts.value(slo="ttft", severity="warn") == 1
+        assert alerts.value(slo="ttft", severity="page") == 0
+        # the control-loop signal is the PAGE condition: one noisy short
+        # window must not trip the autoscaler
+        assert mon.max_burn() < 1.0
+
+    def test_non_histogram_metric_rejected(self):
+        reg = MetricRegistry()
+        reg.counter("ttft_total", "not a histogram")
+        with pytest.raises(ValueError, match="need a.*histogram"):
+            SLOMonitor(dict(enabled=True,
+                            slos=[{"name": "x", "metric": "ttft_total"}]),
+                       registry=reg, clock=lambda: 0.0)
+
+
+# ============================================= attainment + time series
+
+class TestAttainment:
+    def test_boundary_exact_and_interpolated(self):
+        reg = MetricRegistry()
+        hist = reg.histogram("h_ms", buckets=(100.0, 200.0))
+        hist.observe(50.0)          # bucket <=100
+        hist.observe(150.0)         # bucket (100, 200]
+        hist.observe(999.0)         # +Inf bucket
+        # threshold ON a bucket boundary: exact
+        assert histogram_attainment(hist, 100.0) == (1.0, 3.0)
+        assert histogram_attainment(hist, 200.0) == (2.0, 3.0)
+        # threshold inside the (100, 200] bucket: linear interpolation
+        # credits half of that bucket's single observation
+        good, total = histogram_attainment(hist, 150.0)
+        assert good == pytest.approx(1.5) and total == 3.0
+
+    def test_label_subset_filter(self):
+        reg = MetricRegistry()
+        hist = reg.histogram("h_ms", buckets=(100.0,))
+        hist.observe(50.0, replica="r0")
+        hist.observe(50.0, replica="r1")
+        assert histogram_attainment(hist, 100.0) == (2.0, 2.0)
+        assert histogram_attainment(
+            hist, 100.0, {"replica": "r0"}) == (1.0, 1.0)
+
+    def test_window_delta_clamps_to_history(self):
+        ts = TimeSeriesStore(interval_s=1.0, clock=lambda: 0.0)
+        v = [0.0]
+        ts.track("x", lambda: v[0])
+        for now, val in ((0.0, 0.0), (1.0, 10.0), (2.0, 30.0)):
+            v[0] = val
+            assert ts.maybe_sample(now)
+        assert not ts.maybe_sample(2.5)           # cadence-gated
+        assert ts.window_delta("x", 1.0, 2.0) == pytest.approx(20.0)
+        # window older than history: clamp to the oldest sample
+        assert ts.window_delta("x", 100.0, 2.0) == pytest.approx(30.0)
+        assert ts.rate("x", 2.0, 2.0) == pytest.approx(15.0)
+
+
+# ======================================================== tracer bounds
+
+class TestTracerBounds:
+    def test_flow_events_share_the_bounded_buffer(self):
+        tr = SpanTracer(enabled=True, pid=0, max_events=4)
+        for i in range(3):
+            tr.record(f"s{i}", i * 10.0, 1.0)
+        tr.flow("s", 7, 1.0)
+        assert len(tr.events) == 4 and tr.dropped_events == 0
+        tr.flow("t", 7, 2.0)                      # 5th event: oldest drops
+        assert len(tr.events) == 4 and tr.dropped_events == 1
+        assert tr.events[0]["name"] == "s1"       # s0 fell off
+
+    def test_flow_event_shape(self):
+        tr = SpanTracer(enabled=True, pid=3)
+        tr.flow("s", 11, 1.0, tid=5)
+        tr.flow("f", 11, 9.0, tid=5)
+        s, f = tr.events
+        assert (s["ph"], s["id"], s["tid"], s["pid"]) == ("s", 11, 5, 3)
+        assert "bp" not in s
+        assert f["ph"] == "f" and f["bp"] == "e"  # bind to ENCLOSING slice
+
+    def test_disabled_tracer_flow_is_noop(self):
+        tr = SpanTracer(enabled=False)
+        tr.flow("s", 1, 0.0)
+        assert not tr.events and tr.total_recorded == 0
+
+    def test_thread_names_bounded_by_max_events(self):
+        tr = SpanTracer(enabled=True, max_events=2)
+        tr.set_thread_name(1, "req 1")
+        tr.set_thread_name(2, "req 2")
+        tr.set_thread_name(3, "req 3")            # over the cap: dropped
+        assert 3 not in tr.thread_names
+        assert tr.dropped_events == 1
+        tr.set_thread_name(1, "req 1 retry")      # renames still land
+        assert tr.thread_names[1] == "req 1 retry"
+
+    def test_emitter_stamps_flow_scope(self):
+        tr = SpanTracer(enabled=True)
+        d = TraceEmitter().to_dict(tr)
+        assert d["otherData"]["flow_id_scope"] == tracecontext.FLOW_SCOPE
