@@ -61,7 +61,7 @@ def pad_batch(chunk, length=None, rows=None):
 def make_v2(cfg, params, block_size=64, kv_quant=None, quant_weights=False,
             quant_bits=8, telemetry=True, stream_sync=False, spec=None,
             prefix_cache=False, prefill_chunk_tokens=None, token_budget=None,
-            **eng_kwargs):
+            adapters=None, **eng_kwargs):
     """One construction point for every v2 leg so the config shape (and the
     telemetry block) stays consistent across them."""
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
@@ -85,6 +85,8 @@ def make_v2(cfg, params, block_size=64, kv_quant=None, quant_weights=False,
                       "stream_sync": bool(stream_sync)}}
     if spec:
         config["speculative"] = spec
+    if adapters:
+        config["adapters"] = adapters
     return InferenceEngineV2(cfg, config, params=params, **eng_kwargs)
 
 
@@ -243,6 +245,82 @@ def run_shared_prefix(cfg, params, block_size=64, smoke=False, seed=5):
                                          3),
         "prefix_hit_rate": round(hit_rate, 3),
         "shared_prefix_len": shared_len,
+    }
+
+
+def run_adapters(cfg, params, n_adapters, rate, block_size=64, smoke=False,
+                 seed=13):
+    """Multi-tenant LoRA serving leg ([S-LoRA]/[Punica] analog): N distinct
+    adapters registered on ONE engine, tenant traffic Zipf-skewed (a few
+    hot tenants, a long cold tail — the thousand-tenant shape) and served
+    open-loop at the bench arrival rate.  The pool is deliberately sized
+    SMALLER than the tenant set so the leg exercises hot-load + LRU
+    eviction against the shared KV allocator, not a fully-resident cache.
+
+    Two passes over the same arrival trace: every request on one adapter
+    (single-tenant baseline — pays the LoRA matmul but never a reload)
+    vs the Zipf tenant mix.  ``multi_adapter_throughput_ratio`` =
+    mixed/single tokens/s (acceptance >= 0.8: multi-tenancy must cost
+    paging, not throughput collapse); ``adapter_hit_rate`` and
+    ``adapter_evictions_total`` read the pool's timed-pass deltas.  One
+    request per distinct adapter is re-served solo after the timed pass
+    and must be byte-equal to its mixed-batch output (the batched-gather
+    kernel's correctness invariant, spot-checked under bench shapes)."""
+    rng = np.random.default_rng(seed)
+    nreq = 4 * SLOTS      # enough draws that the Zipf tail overflows the
+    #                       tenant slots even at smoke scale (evictions)
+    budget = 4 if smoke else 16
+    lo, hi = (16, 49) if smoke else (64, 257)
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(lo, hi))).astype(np.int32)
+               for _ in range(nreq)]
+    budgets = [budget] * nreq
+    ranks = np.arange(1, n_adapters + 1)
+    pz = 1.0 / ranks ** 1.2
+    ids = [int(a) for a in rng.choice(ranks, size=nreq, p=pz / pz.sum())]
+    slots = max(4, n_adapters // 2 + 1)    # tenant slots < tenants: evict
+    tps, hit_rate, evictions = {}, 0.0, 0.0
+    for label, leg_ids in (("single", [1] * nreq), ("mixed", ids)):
+        eng = make_v2(cfg, params, block_size=block_size,
+                      adapters={"enabled": True, "rank": 8, "alpha": 16.0,
+                                "slots": slots})
+        for a in range(1, n_adapters + 1):
+            eng.register_adapter(a)       # deterministic per-id weights
+        eng.generate(prompts, max_new_tokens=budgets,
+                     adapter_ids=leg_ids)            # warm the compile set
+        reset_telemetry(eng)
+        s0 = eng.adapters.stats()
+        outs, dt, _ = _open_loop_run(
+            lambda p, b, arr: eng.generate(p, max_new_tokens=b,
+                                           arrival_times=arr,
+                                           adapter_ids=leg_ids),
+            prompts, budgets, rate, seed=seed)
+        tps[label] = sum(len(o) for o in outs) / dt
+        if label != "mixed":
+            continue
+        s1 = eng.adapters.stats()
+        hits = s1["hits"] - s0["hits"]
+        misses = s1["misses"] - s0["misses"]
+        hit_rate = hits / max(1, hits + misses)
+        evictions = s1["evictions"] - s0["evictions"]
+        checked = set()
+        for p, b, a, o in zip(prompts, budgets, leg_ids, outs):
+            if a in checked:
+                continue
+            checked.add(a)
+            solo = eng.generate([p], max_new_tokens=[b],
+                                adapter_ids=[a])[0]
+            assert np.array_equal(np.asarray(o), np.asarray(solo)), \
+                (f"adapter {a}: mixed-batch output diverged from its "
+                 f"solo run (batched-gather LoRA must be exact)")
+    return {
+        "multi_adapter_tokens_per_sec": round(tps["mixed"], 1),
+        "single_adapter_tokens_per_sec": round(tps["single"], 1),
+        "multi_adapter_throughput_ratio": round(
+            tps["mixed"] / max(tps["single"], 1e-9), 3),
+        "adapter_hit_rate": round(hit_rate, 3),
+        "adapter_evictions_total": float(evictions),
+        "adapters_served": int(n_adapters),
     }
 
 
@@ -920,6 +998,9 @@ def parse_args(argv=None):
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet size for the multi-replica chaos leg "
                          "(0/1 skips the leg)")
+    ap.add_argument("--adapters", type=int, default=8,
+                    help="distinct LoRA adapters for the multi-tenant "
+                         "serving leg (0 skips the leg)")
     ap.add_argument("--kill-replica-at", type=float, default=None,
                     help="seconds into the fleet leg's open-loop run to "
                          "kill one replica via runtime/faults.py "
@@ -1019,6 +1100,12 @@ def main(argv=None):
     # SplitFuse chunked-prefill leg: long prompts must not blow p99 TPOT
     chunk_leg = leg("chunked_prefill", lambda: run_chunked_tpot(
         cfg, params, smoke=smoke)) or {}
+    # multi-tenant LoRA leg: Zipf tenant mix vs single-adapter baseline,
+    # pool paging + batched-gather correctness spot-check inside
+    adapter_leg = {}
+    if args.adapters:
+        adapter_leg = leg("adapters", lambda: run_adapters(
+            cfg, params, args.adapters, rate, smoke=smoke)) or {}
     # multi-replica chaos leg: same open-loop workload through the fleet
     # router, one replica killed mid-load (no respawn) — goodput must
     # degrade toward (N-1)/N, not cliff, with zero lost/duplicated requests
@@ -1055,6 +1142,7 @@ def main(argv=None):
     extra.update(sweep)
     extra.update(prefix_leg)
     extra.update(chunk_leg)
+    extra.update(adapter_leg)
     extra.update(fleet_leg)
     extra.update(disagg_leg)
     try:

@@ -156,6 +156,21 @@ class V2QuantConfig(DeepSpeedConfigModel):
     group_size: int = 128       # scale granularity along each weight's dim 0
 
 
+class AdapterLoRAConfig(DeepSpeedConfigModel):
+    """Multi-tenant LoRA adapter serving (``adapters`` block): per-request
+    adapter selection through ONE fused ragged dispatch (ops/lora_matmul.py
+    batched gather), adapter A/B pages paged as refcounted residents of the
+    KV block allocator (serving/adapters.py AdapterPool — the S-LoRA
+    unified-pool design).  ``slots`` counts device-table lanes INCLUDING
+    the reserved base-model identity slot 0; ``alpha``/``rank`` set the
+    standard LoRA scale s = alpha / rank."""
+
+    enabled: bool = False
+    rank: int = 8
+    alpha: float = 16.0
+    slots: int = 8
+
+
 class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig."""
 
@@ -167,6 +182,7 @@ class RaggedInferenceEngineConfig(DeepSpeedConfigModel):
     generation: GenerationConfig = Field(default_factory=GenerationConfig)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     quant: V2QuantConfig = Field(default_factory=V2QuantConfig)
+    adapters: AdapterLoRAConfig = Field(default_factory=AdapterLoRAConfig)
     telemetry: ServingTelemetryConfig = Field(
         default_factory=ServingTelemetryConfig)
 
@@ -226,6 +242,9 @@ class _Request:
     sla: str = "default"
     priority: int = 0
     ttft_slo_ms: float = 0.0
+    # LoRA adapter id serving this request (0 = base model identity);
+    # validated at generate() entry, made resident + pinned at admission
+    adapter: int = 0
     t_arrival: Optional[float] = None
     t_admit: Optional[float] = None
     t_prefill_end: Optional[float] = None
@@ -477,11 +496,18 @@ class InferenceEngineV2:
         # configured engines handed the same cache get disjoint sub-caches
         # instead of silently dispatching each other's programs.
         if steps_cache is not None:
+            ac_fp = self.config.adapters
             fp = repr((model_cfg, eff_bs, self.config.dtype,
                        self.draft_config,
                        tuple(sorted(self.mesh.shape.items()))
                        if self.mesh is not None else None,
-                       qc.enabled, qc.bits, qc.group_size))
+                       qc.enabled, qc.bits, qc.group_size,
+                       # adapter-enabled programs take extra batch operands
+                       # (lora tables + per-slot selection) and bake the
+                       # rank/scale geometry into their traced shapes — two
+                       # engines differing in ANY of these must not share
+                       # compiled steps (PR 7 fingerprint rule)
+                       ac_fp.enabled, ac_fp.rank, ac_fp.alpha, ac_fp.slots))
             self._steps: Dict[Any, Any] = steps_cache.setdefault(fp, {})
         else:
             self._steps = {}
@@ -502,6 +528,32 @@ class InferenceEngineV2:
         self._serve_ctx: Optional[Dict[str, Any]] = None
         self.heartbeat_fn = None
         self._block_size = eff_bs
+        # ---- multi-tenant LoRA adapter pool (serving/adapters.py): A/B
+        # pages live as block-granular refcounted residents of the SAME
+        # allocator as the KV blocks, so adapters and KV contend under one
+        # supply-accounting + LRU-eviction policy (the S-LoRA unified pool).
+        # _adapter_slot maps sequence slot -> device-table slot and rides
+        # every dispatch when the pool exists (slot 0 = identity).
+        ac = self.config.adapters
+        self.adapters = None
+        self._adapter_slot = np.zeros(sm.max_tracked_sequences, np.int32)
+        if ac.enabled:
+            if self.draft_params is not None:
+                raise NotImplementedError(
+                    "speculative decoding with LoRA adapters: the draft has "
+                    "no adapter pages to verify against; drop the draft or "
+                    "the adapters config")
+            from deepspeed_tpu.serving.adapters import AdapterPool
+            self.adapters = AdapterPool(
+                self.state.allocator, slots=ac.slots, rank=ac.rank,
+                hidden=model_cfg.hidden_size,
+                num_layers=model_cfg.num_layers,
+                q_dim=model_cfg.num_heads * model_cfg.head_dim,
+                v_dim=model_cfg.kv_heads * model_cfg.head_dim,
+                block_bytes=self.kv_block_bytes(),
+                scale=ac.alpha / ac.rank, dtype=self.config.dtype,
+                telemetry=self.telemetry)
+            self.state.adapters = self.adapters
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree_util.tree_leaves(self.params))
         log_dist(f"v2 ragged engine ready: params={n_params/1e6:.1f}M "
@@ -580,6 +632,9 @@ class InferenceEngineV2:
             seq = self.state.get(uid)
             if seq is None:
                 seq = self.state.create(uid)
+                # put() serves the base model: clear any previous tenant's
+                # adapter selection left on this recycled slot
+                self._adapter_slot[seq.slot] = 0
                 if self.state.radix is not None:
                     seq.host_tokens = toks
                     # reuse the validation walk: nothing mutated the trie
@@ -631,6 +686,18 @@ class InferenceEngineV2:
                  rb.tokens.shape[0])
         return mb, nb
 
+    def _with_lora(self, batch):
+        """Thread the adapter selection + packed pages into a dispatch batch.
+        The model gates on ``"lora" in batch`` at TRACE time, and an
+        adapter-less engine adds NO keys at all — so its traced programs
+        (and shared steps_cache entries) stay byte-identical to before the
+        adapter subsystem existed, the zero-overhead base-model guarantee."""
+        if self.adapters is None:
+            return batch
+        batch["adapter_slot"] = jnp.asarray(self._adapter_slot)
+        batch["lora"] = self.adapters.tables()
+        return batch
+
     def _run(self, rb: RaggedBatch) -> "jax.Array":
         # small set of compiled programs: a decode-only step (Q=1, Pallas
         # paged attention — the steady-state hot path, ragged_decode_forward)
@@ -656,7 +723,7 @@ class InferenceEngineV2:
                  "token_pos": rb.token_pos[:nb],
                  "token_dense_idx": rb.token_dense_idx[:nb],
                  "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len}
-        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        batch = self._with_lora(jax.tree_util.tree_map(jnp.asarray, batch))
         self.telemetry.dispatch("mixed")
         self.telemetry.padding_waste(rb.total_tokens, nb)
         with self.telemetry.span("mixed_dispatch", tokens=rb.total_tokens,
@@ -683,9 +750,9 @@ class InferenceEngineV2:
                                   block_size=self._block_size,
                                   mesh=self.mesh),
                 donate_argnums=(1,))
-        batch = jax.tree_util.tree_map(jnp.asarray, {
+        batch = self._with_lora(jax.tree_util.tree_map(jnp.asarray, {
             "tokens": tokens, "active": active, "token_pos": token_pos,
-            "block_table": rb.block_table})
+            "block_table": rb.block_table}))
         self.telemetry.dispatch("decode")
         with self.telemetry.span("decode_dispatch", seqs=rb.total_tokens):
             logits, self.cache = self._steps[key](self.params, self.cache,
@@ -893,9 +960,9 @@ class InferenceEngineV2:
                                   sample_fn=self._sample_fn(gen),
                                   mesh=self.mesh),
                 donate_argnums=(1,))
-        batch = jax.tree_util.tree_map(jnp.asarray, {
+        batch = self._with_lora(jax.tree_util.tree_map(jnp.asarray, {
             "tokens0": tokens0, "from_device": from_device, "active": active,
-            "pos0": pos0, "block_table": block_table})
+            "pos0": pos0, "block_table": block_table}))
         self.telemetry.dispatch("burst")
         with self.telemetry.span("burst_dispatch", steps=steps,
                                  seqs=len(reqs)):
@@ -919,7 +986,10 @@ class InferenceEngineV2:
         S = self.state.max_tracked_sequences
         schedule = []
         for uid, toks in zip(uids, toks_np):
-            seq = self.state.get(uid) or self.state.create(uid)
+            seq = self.state.get(uid)
+            if seq is None:
+                seq = self.state.create(uid)
+                self._adapter_slot[seq.slot] = 0
             self.state.ensure_blocks(seq, len(toks))
             schedule.append((seq, toks))
         served = np.zeros(S, bool)
@@ -940,10 +1010,10 @@ class InferenceEngineV2:
                 token_pos[sl] = seq.seen_tokens
                 bl = np.asarray(seq.blocks, np.int32)
                 block_table[sl, :len(bl)] = bl
-            batch = jax.tree_util.tree_map(jnp.asarray, {
+            batch = self._with_lora(jax.tree_util.tree_map(jnp.asarray, {
                 "tokens": tokens, "active": active, "token_pos": token_pos,
                 "block_table": block_table, "from_device": fdev,
-                "served": served})
+                "served": served}))
             if self._spec_active(gen):
                 # lockstep draft ingestion (see mixed_sd)
                 key = ("decode_sd", gen.do_sample, gen.top_k)
@@ -987,12 +1057,12 @@ class InferenceEngineV2:
                 i += len(toks)
             mb, nb = self._buckets(rb)
             self.telemetry.padding_waste(rb.total_tokens, nb)
-            batch = jax.tree_util.tree_map(jnp.asarray, {
+            batch = self._with_lora(jax.tree_util.tree_map(jnp.asarray, {
                 "tokens": rb.tokens[:nb], "token_slot": rb.token_slot[:nb],
                 "token_pos": rb.token_pos[:nb],
                 "token_dense_idx": rb.token_dense_idx[:nb],
                 "block_table": rb.block_table[:, :mb], "kv_len": rb.kv_len,
-                "from_device": fdev[:nb], "served": served})
+                "from_device": fdev[:nb], "served": served}))
             if self._spec_active(gen):
                 # dual prefill: the draft ingests every prompt chunk in
                 # lockstep so speculative acceptance has something to work
@@ -1122,6 +1192,28 @@ class InferenceEngineV2:
             return [], 0
         return radix.peek_blocks(np.asarray(prompt, np.int32).reshape(-1))
 
+    def register_adapter(self, adapter_id: int, weights=None) -> None:
+        """Make a LoRA adapter id loadable on this engine (host-side only;
+        pool blocks and device traffic happen lazily when a request first
+        selects the id).  ``weights=None`` generates deterministic per-id
+        weights (bench/test tenants)."""
+        if self.adapters is None:
+            raise ValueError(
+                "this engine has no adapter pool; enable config.adapters")
+        self.adapters.register(adapter_id, weights)
+
+    def adapter_resident(self, adapter_ids) -> int:
+        """How many of ``adapter_ids`` have their pages resident on THIS
+        engine right now (0 with adapters off; id 0 never counts).
+        Read-only and a pure host dict peek — no LRU stamps freshened, no
+        references taken — so the fleet router may probe it cross-thread
+        as the adapter-affinity signal (``prefix_affinity``), exactly like
+        :meth:`prefix_cached_tokens`: a concurrent load/evict can only
+        make the answer stale, never corrupt the walk."""
+        if self.adapters is None:
+            return 0
+        return self.adapters.resident_count(adapter_ids)
+
     def kv_block_bytes(self) -> int:
         """Device bytes one KV pool block holds (K + V across layers at
         the serving dtype) — the unit the fleet's stubbed multi-host
@@ -1227,6 +1319,7 @@ class InferenceEngineV2:
                  arrival_times: Optional[Sequence[float]] = None,
                  now_fn=None, stream: Optional[bool] = None,
                  sla: Optional[Sequence[str]] = None,
+                 adapter_ids: Optional[Sequence[int]] = None,
                  trace_ctx: Optional[Sequence[Any]] = None,
                  **gen_overrides) -> List[np.ndarray]:
         """Serve a set of prompts to completion with continuous batching.
@@ -1273,6 +1366,17 @@ class InferenceEngineV2:
         ``scheduler.preempt_margin`` of its ``ttft_slo_ms`` and still
         cannot be admitted preempts the most recently admitted
         lower-priority running request (token-exact recompute fold-back).
+
+        adapter_ids: one LoRA adapter id per prompt (0 / omitted = base
+        model).  Adapters must be :meth:`register_adapter`-ed; pages are
+        hot-loaded into the shared paged pool at admission and the
+        per-request selection rides the SAME fused ragged dispatch as the
+        base model (ops/lora_matmul.py batched gather) — a mixed-adapter
+        batch is token-exact vs serving each request alone on its own
+        adapter.  An id whose pages can NEVER fit (unknown, or larger than
+        the whole pool) fails THIS call with ``ValueError`` at dispatch —
+        the PR 7 poison-request rule: a client input error must fail the
+        request, never book a replica death.
         """
         gen = self.config.generation.model_copy(update=gen_overrides)
         self._serve_ctx = None   # never expose a PREVIOUS call's requests
@@ -1301,10 +1405,19 @@ class InferenceEngineV2:
                                  f"of {sorted(classes)}")
         if trace_ctx is not None and len(trace_ctx) != len(prompts):
             raise ValueError("trace_ctx list must match prompts")
+        if adapter_ids is not None:
+            if len(adapter_ids) != len(prompts):
+                raise ValueError("adapter_ids list must match prompts")
+            if self.adapters is None and any(int(a) for a in adapter_ids):
+                raise ValueError(
+                    "adapter_ids passed but this engine has no adapter "
+                    "pool; enable config.adapters")
         t_start = now_fn()
         waiting = [
             _Request(uid=-(i + 1), prompt=np.asarray(p, np.int32).reshape(-1),
                      max_new_tokens=m,
+                     adapter=(int(adapter_ids[i])
+                              if adapter_ids is not None else 0),
                      sla=(sla[i] if sla is not None else "default"),
                      priority=classes[sla[i] if sla is not None
                                       else "default"].priority,
@@ -1337,6 +1450,21 @@ class InferenceEngineV2:
                     f"request needs {need} KV blocks for its full context but "
                     f"the pool holds {pool_blocks}; raise num_kv_blocks "
                     f"(recompute-preemption cannot make a single sequence fit)")
+            if r.adapter and self.adapters is not None:
+                # a permanently unservable adapter id is a CLIENT error —
+                # reject at dispatch (the fleet maps this to a typed
+                # invalid_request failure), never loop in admission
+                bad = self.adapters.unfittable_reason(r.adapter)
+                if bad:
+                    raise ValueError(f"prompt {i}: {bad}")
+                if need + self.adapters.blocks_per_adapter > pool_blocks:
+                    # the request's own pinned adapter pages shrink the pool
+                    # its KV must fit in — unservable at any load
+                    raise ValueError(
+                        f"prompt {i}: {need} KV blocks + "
+                        f"{self.adapters.blocks_per_adapter} adapter-page "
+                        f"blocks exceed the {pool_blocks}-block pool; raise "
+                        f"num_kv_blocks")
         running: List[_Request] = []
         results: Dict[int, _Request] = {r.uid: r for r in waiting}
         # open loop: requests enter the waiting queue at their arrival time
@@ -1757,6 +1885,24 @@ class InferenceEngineV2:
                 seq = self.state.create(r.uid)
                 seq.host_tokens = r.prompt
                 matched = self.state.match_prefix(seq, r.prompt)
+                if self.adapters is not None:
+                    # adapter residency BEFORE sizing: the load may consume
+                    # free blocks (spilling cold adapters, then radix
+                    # leaves), and the block check below must see the pool
+                    # as it will be when the chunk dispatches.  A load the
+                    # pool cannot fit RIGHT NOW (every page pinned by
+                    # in-flight work) rolls back like a block shortfall and
+                    # retries when a retirement releases pins.
+                    try:
+                        self.state.ensure_adapters([r.adapter])
+                    except RuntimeError:
+                        stel.alloc_failure("adapter_load")
+                        self.state.flush(r.uid)
+                        waiting.insert(0, r)
+                        break
+                    self.state.bind_adapter(seq, r.adapter)
+                    self._adapter_slot[seq.slot] = \
+                        self.adapters.slot_of(r.adapter)
                 chunk = min(len(r.prompt) - matched, sm.max_q_per_seq,
                             budget, prefill_budget)
                 need = seq.kv_blocks_needed(chunk, self.state.block_size)

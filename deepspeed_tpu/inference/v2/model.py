@@ -286,6 +286,28 @@ def _proj3(x, p, dtype, mesh, wspec):
     return y.reshape(lead + w.shape[1:])
 
 
+def _lora_qv(q, v, h, lora, row_ids, li):
+    """Per-row LoRA deltas on the q and v projections for layer ``li`` —
+    the multi-tenant batched-gather path (ops/lora_matmul.py): every row
+    carries its own adapter id and the whole mixed-adapter batch rides ONE
+    op call.  ``lora`` holds the pool's packed tables (``a_q``/``b_q``/
+    ``a_v``/``b_v`` [slots, L, …] + per-slot ``scale``); slot 0 is the
+    base-model identity (zero pages, scale 0), so base rows pay a zero
+    delta instead of a branch.  Applied pre-rope (rotation acts on the
+    adapted projection), matching delta-on-the-projection LoRA
+    semantics."""
+    from deepspeed_tpu import ops
+    lead = h.shape[:-1]
+    h2 = h.reshape(-1, h.shape[-1])
+    ids = row_ids.reshape(-1)
+    scale = lora["scale"]
+    dq = ops.lora_matmul(h2, lora["a_q"][:, li], lora["b_q"][:, li],
+                         ids, scale)
+    dv = ops.lora_matmul(h2, lora["a_v"][:, li], lora["b_v"][:, li],
+                         ids, scale)
+    return q + dq.reshape(q.shape), v + dv.reshape(v.shape)
+
+
 def _qkv(ap, h, cfg, mesh=None):
     """q/k/v projections with optional biases (qwen2/gpt2 checkpoints).
     TP layout: the heads dim shards (column-parallel), so quantized stores
@@ -384,11 +406,23 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
     flat_k_all, flat_v_all, flat_ks, flat_vs = _flat_cache_views(cache)
     quant = cache.quantized
 
+    # multi-tenant LoRA (static trace-time branch — adapter-less engines
+    # send no "lora" key and trace the identical program): per-TOKEN
+    # adapter slot via each token's sequence slot; pad rows map to the
+    # identity slot 0 (zero delta)
+    lora = batch.get("lora")
+    if lora is not None:
+        lora_ids = jnp.where(valid,
+                             batch["adapter_slot"][jnp.clip(token_slot, 0)],
+                             0)
+
     for li in range(cfg.num_layers):
         blk = bb[f"block_{li}"]
         ap, np_ = blk["Attention_0"], blk["Norm_0"]
         h = _norm(np_, x, cfg)
         q, k, v = _qkv(ap, h, cfg, mesh=mesh)
+        if lora is not None:
+            q, v = _lora_qv(q, v, h, lora, lora_ids, li)
         if cfg.use_rope:
             # rope() takes [B, T, n, d] + positions [B, T]
             q, k = rope(q[None], k[None], token_pos[None], cfg.head_dim,
@@ -467,7 +501,7 @@ def ragged_forward(params, cache: PagedKVCache, batch, cfg: GPTConfig, *,
 
 def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
                  block_table, cfg: GPTConfig, block_size: int, mesh=None,
-                 flat_ks=None, flat_vs=None):
+                 flat_ks=None, flat_vs=None, lora=None, adapter_slot=None):
     """One decode micro-step: writes each active slot's kv into its page and
     attends over exactly that slot's pages via the paged-attention op
     (ops/paged_attention.py — Pallas kernel on TPU, masked-gather XLA
@@ -499,12 +533,18 @@ def _decode_core(params, flat_k_all, flat_v_all, tokens, active, token_pos,
     page = block_table[jnp.arange(S), token_pos // block_size]  # [S]
     off = token_pos % block_size                                # [S]
     kv_len = jnp.where(active, token_pos + 1, 0)                # [S]
+    if lora is not None:
+        # decode rows ARE slots: mask inactive lanes to the identity slot
+        # so a recycled lane's stale selection never computes a delta
+        lora_ids = jnp.where(active, adapter_slot, 0)
 
     for li in range(cfg.num_layers):
         blk = bb[f"block_{li}"]
         ap = blk["Attention_0"]
         h = _norm(blk["Norm_0"], x, cfg)
         q, k, v = _qkv(ap, h, cfg, mesh=mesh)
+        if lora is not None:
+            q, v = _lora_qv(q, v, h, lora, lora_ids, li)
         if cfg.use_rope:
             q, k = rope(q[:, None], k[:, None], token_pos[:, None], hd,
                         base=cfg.rope_theta, rope_pct=cfg.rope_pct,
@@ -596,13 +636,16 @@ def ragged_decode_burst(params, cache: PagedKVCache, batch, prev_tokens, rng,
     flat_k, flat_v, flat_ks, flat_vs = _flat_cache_views(cache)
     bt = batch["block_table"]
     active = batch["active"]
+    lora = batch.get("lora")
+    adapter_slot = batch.get("adapter_slot")
     tokens0 = jnp.where(batch["from_device"], prev_tokens, batch["tokens0"])
 
     def step(carry, _):
         flat_k, flat_v, flat_ks, flat_vs, tokens, pos, rng = carry
         logits, flat_k, flat_v, flat_ks, flat_vs = _decode_core(
             params, flat_k, flat_v, tokens, active, pos, bt, cfg, block_size,
-            mesh=mesh, flat_ks=flat_ks, flat_vs=flat_vs)
+            mesh=mesh, flat_ks=flat_ks, flat_vs=flat_vs, lora=lora,
+            adapter_slot=adapter_slot)
         rng, sub = jax.random.split(rng)
         nxt = sample_fn(logits, sub, temperature=temperature, top_p=top_p)
         nxt = nxt.astype(jnp.int32)
@@ -1105,5 +1148,6 @@ def ragged_decode_forward(params, cache: PagedKVCache, batch,
     logits, flat_k, flat_v, flat_ks, flat_vs = _decode_core(
         params, flat_k, flat_v, batch["tokens"], batch["active"],
         batch["token_pos"], batch["block_table"], cfg, block_size, mesh=mesh,
-        flat_ks=flat_ks, flat_vs=flat_vs)
+        flat_ks=flat_ks, flat_vs=flat_vs, lora=batch.get("lora"),
+        adapter_slot=batch.get("adapter_slot"))
     return logits, _rebuild_cache(cache, flat_k, flat_v, flat_ks, flat_vs)

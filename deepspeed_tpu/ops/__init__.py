@@ -135,6 +135,19 @@ from deepspeed_tpu.ops import collective_matmul  # noqa: E402
 from deepspeed_tpu.ops.collective_matmul import (  # noqa: E402
     all_gather_matmul, matmul_reduce_scatter, row_parallel_matmul)
 
+from deepspeed_tpu.ops import lora_matmul as _lora  # noqa: E402
+
+register_op("lora_matmul", xla=_lora.xla_lora_matmul,
+            pallas=_lora.pallas_lora_matmul, supported=_lora.lora_supported)
+
+
+def lora_matmul(x, a_pages, b_pages, adapter_ids, scales, *,
+                impl: Optional[str] = None):
+    """Batched-gather LoRA delta: ``y[i] = (x[i] @ A[id_i]) @ B[id_i] ·
+    s[id_i]`` over packed per-slot adapter tables (ops/lora_matmul.py)."""
+    return dispatch("lora_matmul", x, a_pages, b_pages, adapter_ids, scales,
+                    impl=impl)
+
 
 def causal_attention(q, k, v, *, causal: bool = True,
                      scale: Optional[float] = None,
@@ -154,7 +167,7 @@ def causal_attention(q, k, v, *, causal: bool = True,
 
 
 __all__ = ["causal_attention", "flash_attention", "configure_flash_blocks",
-           "paged_attention",
+           "paged_attention", "lora_matmul",
            "ragged_prefill_attention", "evoformer_attention",
            "all_gather_matmul", "matmul_reduce_scatter",
            "row_parallel_matmul", "collective_matmul",

@@ -163,6 +163,9 @@ class _Dispatch:
     remaining: int
     prefix: Tuple[int, ...]
     gen: int
+    # LoRA adapter id serving this request (0 = base model); threaded
+    # into engine.generate(adapter_ids=...) when the engine accepts it
+    adapter: int = 0
     # TraceContext of the dispatch attempt (already the per-attempt
     # child span — Router.dispatch minted it); threaded into the
     # engine's generate so replica trace files carry the fleet ids
@@ -214,6 +217,7 @@ class Replica:
                       remaining=remaining,
                       prefix=tuple(req.generated),
                       gen=self.fleet._serve_gen,
+                      adapter=int(req.adapter),
                       trace=req.trace)
         with self.cond:
             self.queue.append(d)
@@ -330,6 +334,10 @@ class ServingFleet:
             self._autoscaler = PoolAutoscaler(
                 self.config.autoscale, registry=self.registry,
                 clock=self.clock)
+        # fleet-wide LoRA adapter registry: {id -> host weights or None},
+        # replayed onto every fresh incarnation in _spawn so a respawned
+        # replica can serve a migrated adapter request token-exact
+        self._adapter_registry: Dict[int, Any] = {}
         self.replicas: Dict[str, Replica] = {}
         for i in range(int(self.config.num_replicas)):
             rep = Replica(f"r{i}", self)
@@ -397,6 +405,12 @@ class ServingFleet:
             return False
         if hasattr(engine, "clear_drain"):
             engine.clear_drain()
+        if self._adapter_registry and hasattr(engine, "register_adapter"):
+            # replay the fleet's adapter set onto the fresh pool (host
+            # dicts only — pages hot-load on first use); identical
+            # weights per id on every replica keeps migration token-exact
+            for aid, w in self._adapter_registry.items():
+                engine.register_adapter(aid, w)
         rep.engine = engine
         with rep.cond:
             rep.incarnation += 1
@@ -490,12 +504,14 @@ class ServingFleet:
     def _worker(self, rep: Replica, engine, incarnation: int) -> None:
         from deepspeed_tpu.inference.v2.engine_v2 import EngineDrained
         # probed once per incarnation: fake/minimal engines in tests need
-        # not accept the trace_ctx keyword
+        # not accept the trace_ctx / adapter_ids keywords
         try:
-            accepts_trace = "trace_ctx" in inspect.signature(
-                engine.generate).parameters
+            gen_params = inspect.signature(engine.generate).parameters
+            accepts_trace = "trace_ctx" in gen_params
+            accepts_adapters = "adapter_ids" in gen_params
         except (TypeError, ValueError):
             accepts_trace = False
+            accepts_adapters = False
         while True:
             with rep.cond:
                 while not rep.queue:
@@ -516,6 +532,10 @@ class ServingFleet:
                 gen_kwargs = {}
                 if accepts_trace:
                     gen_kwargs["trace_ctx"] = [d.trace for d in batch]
+                # base-model-only batches skip the keyword entirely so an
+                # adapter-less fleet's generate calls stay byte-identical
+                if accepts_adapters and any(d.adapter for d in batch):
+                    gen_kwargs["adapter_ids"] = [d.adapter for d in batch]
                 outs = engine.generate(
                     [d.prompt for d in batch],
                     max_new_tokens=[d.remaining for d in batch],
@@ -588,13 +608,18 @@ class ServingFleet:
 
     # ------------------------------------------------------------- serving
     def serve(self, prompts, max_new_tokens=32, arrival_times=None,
-              raise_on_failure: bool = True,
+              adapter_ids=None, raise_on_failure: bool = True,
               max_wall_s: Optional[float] = None) -> List[np.ndarray]:
         """Serve ``prompts`` to completion across the fleet and return one
         output array per prompt (order preserved).  ``arrival_times`` are
         open-loop offsets in seconds from call start (requests dispatch
-        only once arrived).  Failed requests (retry budget exhausted,
-        admission bound, no replicas left) surface as a typed
+        only once arrived).  ``adapter_ids`` optionally pins each request
+        to a LoRA adapter registered on the replicas (0/None = base
+        model); the id sticks to the request through retries, migrations,
+        and the prefill->decode handoff, and an adapter the target replica
+        can never fit fails the REQUEST typed (``invalid_request``), not
+        the replica.  Failed requests (retry budget exhausted, admission
+        bound, no replicas left) surface as a typed
         :class:`RequestFailed` — raised after everything else settled, or
         returned as ``None`` entries with ``raise_on_failure=False``
         (details in ``self.last_failures``).  ``max_wall_s`` is a hard
@@ -607,6 +632,8 @@ class ServingFleet:
                 raise ValueError("max_new_tokens list must match prompts")
         if arrival_times is not None and len(arrival_times) != len(prompts):
             raise ValueError("arrival_times must match prompts")
+        if adapter_ids is not None and len(adapter_ids) != len(prompts):
+            raise ValueError("adapter_ids list must match prompts")
         self._serve_gen += 1
         self.request_log = []
         self.last_failures = {}   # never leak a previous serve's failures
@@ -631,6 +658,8 @@ class ServingFleet:
             self.router.submit(FleetRequest(
                 index=i, prompt=np.asarray(p, np.int32).reshape(-1),
                 max_new_tokens=m, phase=phase,
+                adapter=(int(adapter_ids[i])
+                         if adapter_ids is not None else 0),
                 t_arrival=t0 + (float(arrival_times[i])
                                 if arrival_times is not None else 0.0)))
         while not self.router.settled():
@@ -990,12 +1019,34 @@ class ServingFleet:
             return (f"prompt {len(req.prompt)} + {req.remaining} new "
                     f"tokens exceeds max_seq_len {mc.max_seq_len}")
         state = getattr(eng, "state", None)
+        need = None
         if state is not None:
             need = -(-(len(req.prompt) + req.remaining)
                      // state.block_size)
             if need > state.allocator.num_blocks:
                 return (f"request needs {need} KV blocks but the pool "
                         f"holds {state.allocator.num_blocks}")
+        # adapter gate (only when the engine exposes the pool attribute —
+        # real engines always do, even disabled; fakes without it also
+        # never receive adapter_ids, so there is nothing to mirror): an
+        # unknown / never-fits adapter, a base-only replica, or a request
+        # whose KV blocks + adapter pages exceed the pool even empty
+        # would all ValueError inside generate — on the worker thread
+        # that books a replica DEATH, so the gate fails the request here
+        if req.adapter and hasattr(eng, "adapters"):
+            pool = eng.adapters
+            if pool is None:
+                return (f"request pins adapter {req.adapter} but the "
+                        f"replica serves the base model only "
+                        f"(config.adapters disabled)")
+            bad = pool.unfittable_reason(req.adapter)
+            if bad is not None:
+                return bad
+            if need is not None and need + pool.blocks_per_adapter \
+                    > state.allocator.num_blocks:
+                return (f"request needs {need} KV blocks + "
+                        f"{pool.blocks_per_adapter} adapter page(s) but "
+                        f"the pool holds {state.allocator.num_blocks}")
         return None
 
     # ---------------------------------------------------------- supervision
@@ -1054,6 +1105,20 @@ class ServingFleet:
             self.h_recovery.observe((self.clock() - t_detect) * 1e3)
 
     # ------------------------------------------------------------- control
+    def register_adapter(self, adapter_id: int, weights=None) -> None:
+        """Register a LoRA adapter fleet-wide: on every live engine now
+        and (via the registry replay in ``_spawn``) on every future
+        incarnation.  ``weights=None`` derives deterministic per-id
+        weights, identical on every replica — the fleet's token-exactness
+        invariant extends to adapter requests, so a migrated or
+        handed-off adapter request completes byte-identical wherever it
+        lands."""
+        self._adapter_registry[int(adapter_id)] = weights
+        for rep in self.replicas.values():
+            if rep.engine is not None and hasattr(rep.engine,
+                                                  "register_adapter"):
+                rep.engine.register_adapter(adapter_id, weights)
+
     def drain_replica(self, name: str) -> None:
         """Graceful drain of one replica: stop admission to it, let it
         finish or migrate in-flight requests (``EngineDrained`` export),

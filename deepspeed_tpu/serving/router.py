@@ -128,6 +128,12 @@ class FleetRequest:
     next_eligible: float = 0.0              # arrival / backoff / retry-after
     deadline: float = float("inf")          # per-attempt timeout
     assigned: Optional[str] = None          # replica name while inflight
+    # LoRA adapter serving this request (0 = base model).  Routing treats
+    # it as a residency signal (prefix_affinity prefers replicas whose
+    # pool already holds the adapter's pages); replicas where the adapter
+    # cannot EVER fit fail the request typed at dispatch (fleet
+    # ``_invalid_reason``), never a replica death
+    adapter: int = 0
     # disaggregated lifecycle: "full" (unified fleet — prefill and decode
     # on one replica), "prefill" (serve the prompt + FIRST token only),
     # "decode" (prefill done and folded; serve the remaining budget).
@@ -185,9 +191,19 @@ def prefix_affinity(req: FleetRequest, healthy: list, router: "Router",
     routing cost.  The cache invalidates per replica on dispatch
     (residency there is about to grow) and on death/migration
     (``Router.invalidate_residency``), so a stale entry can only
-    UNDER-state residency for one pick, never mis-route."""
+    UNDER-state residency for one pick, never mis-route.
+
+    Multi-tenant LoRA adds a SECOND residency signal: among replicas with
+    equal prefix residency, prefer one whose adapter pool already holds
+    the request's adapter pages (``Router.adapter_residency``, probing
+    ``engine.adapter_resident`` — the same cached host-dict peek shape as
+    the prefix probe).  Landing on an adapter-warm replica skips a
+    host->device page upload AND spares a cold eviction there; like the
+    prefix signal it is an optimization only — an adapter-cold replica
+    just hot-loads the pages on admission."""
     return min(healthy,
                key=lambda rep: (-router.residency(rep, req),
+                                -router.adapter_residency(rep, req),
                                 router.outstanding_tokens(rep.name),
                                 rep.name))
 
@@ -225,6 +241,10 @@ class Router:
         # {prompt bytes -> resident token count}} — see residency()
         self._residency: Dict[str, Dict[bytes, int]] = {}
         self._residency_cap = 4096      # entries per replica before reset
+        # per-replica adapter-residency probe cache: {replica name ->
+        # {adapter id -> 0/1 resident}} — see adapter_residency(); shares
+        # the invalidation sites (and cap) with the prefix cache above
+        self._adapter_residency: Dict[str, Dict[int, int]] = {}
         self.c_retries = registry.counter(
             "router_retries_total", "request re-dispatches taken by the "
             "fleet router, per reason (dispatch_error / timeout / "
@@ -321,9 +341,11 @@ class Router:
                         else float("inf"))
         self.inflight[req.index] = req
         # this replica's radix residency is about to change (the dispatch
-        # will insert the request's blocks): drop its probe cache so the
-        # next pick re-probes it — everyone else's entries stay warm
+        # will insert the request's blocks, and its adapter pool may load
+        # or evict pages): drop its probe caches so the next pick
+        # re-probes it — everyone else's entries stay warm
         self._residency.pop(replica.name, None)
+        self._adapter_residency.pop(replica.name, None)
         replica.enqueue(req)
 
     def fail_attempt(self, req: FleetRequest, now: float, reason: str,
@@ -356,6 +378,7 @@ class Router:
         the re-served request completes token-exact vs. a no-failure run."""
         if req.assigned is not None:
             self._residency.pop(req.assigned, None)
+            self._adapter_residency.pop(req.assigned, None)
         self.inflight.pop(req.index, None)
         req.assigned = None
         req.epoch += 1
@@ -472,13 +495,47 @@ class Router:
             cache[key] = hit
         return hit
 
+    def adapter_residency(self, rep, req: FleetRequest) -> int:
+        """Cached adapter-residency probe for ``prefix_affinity``: 1 when
+        ``rep``'s adapter pool already holds ``req.adapter``'s pages, else
+        0.  Base-model requests (adapter 0) and replicas without a probe
+        (fakes, adapters off) report 0 — the signal vanishes and routing
+        degrades to exactly the prefix/least-outstanding order.  The probe
+        (``engine.adapter_resident``) is a read-only host dict peek, safe
+        from the dispatcher thread; results cache per (replica, adapter)
+        and invalidate wherever the prefix cache does (dispatch,
+        migration, death), since a dispatch can load OR evict adapter
+        pages.  A stale entry only ever UNDER-states residency — one
+        suboptimal pick and a hot-load, never a correctness issue — and a
+        failing probe (dying replica) reports 0 without poisoning the
+        cache."""
+        if not req.adapter:
+            return 0
+        probe = getattr(getattr(rep, "engine", None),
+                        "adapter_resident", None)
+        if probe is None:
+            return 0
+        cache = self._adapter_residency.setdefault(rep.name, {})
+        hit = cache.get(req.adapter)
+        if hit is None:
+            try:
+                hit = int(probe([req.adapter]))
+            except Exception:  # noqa: BLE001 — a dying replica's probe
+                return 0       # must never take the dispatcher down
+            if len(cache) >= self._residency_cap:
+                cache.clear()
+            cache[req.adapter] = hit
+        return hit
+
     def invalidate_residency(self, name: Optional[str] = None) -> None:
         """Drop the residency probe cache for one replica (death, drain,
         role flip) or for the whole fleet (``name=None``)."""
         if name is None:
             self._residency.clear()
+            self._adapter_residency.clear()
         else:
             self._residency.pop(name, None)
+            self._adapter_residency.pop(name, None)
 
     # -------------------------------------------------------------- status
     def outstanding_tokens(self, replica_name: str) -> int:

@@ -193,6 +193,26 @@ class ServingTelemetry:
         self.g_spec_ratio = reg.gauge(
             "spec_accept_ratio", "cumulative draft-token acceptance: "
             "accepted / proposed")
+        # ---- multi-tenant LoRA adapters (PR 20): the paged adapter pool
+        # sharing the KV allocator (serving/adapters.py)
+        self.c_adapter_loads = reg.counter(
+            "adapter_loads_total", "LoRA adapter residency resolutions at "
+            "request admission, per outcome (hit = pages already resident "
+            "/ miss = first host load / reload = re-load after eviction / "
+            "failed = pool could not fit the pages)")
+        self.c_adapter_evict = reg.counter(
+            "adapter_evictions_total", "cold LoRA adapters evicted from "
+            "the shared paged pool to reclaim blocks (LRU, never a pinned "
+            "adapter)")
+        self.g_adapter_hit = reg.gauge(
+            "adapter_hit_rate", "cumulative fraction of adapter "
+            "activations served from resident pages without a host "
+            "reload: hits / (hits + misses)")
+        self.g_adapter_blocks = reg.gauge(
+            "adapter_pool_blocks", "pool blocks holding LoRA adapter "
+            "pages, per state (resident = all loaded adapters / pinned = "
+            "held by in-flight requests / evictable = reclaimable by LRU "
+            "eviction right now)")
 
     # ------------------------------------------------------------- clocks
 
@@ -371,6 +391,29 @@ class ServingTelemetry:
                                      **self.labels)
             self.g_shared_blocks.set(st["evictable"], state="evictable",
                                      **self.labels)
+        pool = getattr(state, "adapters", None)
+        if pool is not None:
+            st = pool.stats()
+            self.g_adapter_blocks.set(st["resident_blocks"],
+                                      state="resident", **self.labels)
+            self.g_adapter_blocks.set(st["pinned_blocks"], state="pinned",
+                                      **self.labels)
+            self.g_adapter_blocks.set(st["evictable_blocks"],
+                                      state="evictable", **self.labels)
+
+    # ------------------------------------------------- multi-tenant adapters
+
+    def adapter_load(self, outcome: str, hit_rate: float) -> None:
+        """One adapter residency resolution (AdapterPool.ensure); the pool
+        passes its cumulative hit rate so the gauge tracks the counter
+        without a registry read-back."""
+        if self.enabled:
+            self.c_adapter_loads.inc(1, outcome=outcome, **self.labels)
+            self.g_adapter_hit.set(hit_rate, **self.labels)
+
+    def adapter_eviction(self, n: int = 1) -> None:
+        if self.enabled:
+            self.c_adapter_evict.inc(n, **self.labels)
 
     # -------------------------------------------------------- speculative
 

@@ -53,6 +53,12 @@ Scan targets (each file gets the pattern matching its hazard class):
   behind one device, the worst possible place to serialize.  Replica
   worker bodies (``_worker`` and friends) are the sanctioned blocking
   site (each blocks only its own replica) and are not scanned.
+- ``deepspeed_tpu/serving/adapters.py`` LoRA adapter pool (load / evict
+  / residency peeks) — transfers in either direction: ``ensure`` runs in
+  the engine admission loop and the residency peeks serve the router's
+  dispatcher-thread probe, so everything is host bookkeeping except the
+  hot-load's disclosed host→device page upload (``# sync-ok`` in
+  ``_load_locked``).
 - ``deepspeed_tpu/runtime/guardian.py`` control loop + watchdog
   (``run``/assessment/remediation/escalation + the monitor thread) —
   the ROLLBACK path's fences (prefetcher join, ``load_universal_
@@ -138,7 +144,9 @@ SERVING_FUNCS = {
     "_stream_fence",
     "_finish_request",
     "_put_device",
+    "_with_lora",
     "prefix_cached_tokens",
+    "adapter_resident",
 }
 
 # the radix prefix cache + state manager: every method the decode
@@ -163,6 +171,8 @@ RAGGED_FUNCS = {
     "_walk",
     "cache_insert",
     "ensure_blocks",
+    "ensure_adapters",
+    "bind_adapter",
     "available_blocks",
     "allocate",
     "acquire",
@@ -190,6 +200,7 @@ ROUTER_FUNCS = {
     "complete",
     "handoff",
     "residency",
+    "adapter_residency",
     "invalidate_residency",
     "assigned_count",
     "check_timeouts",
@@ -217,10 +228,35 @@ FLEET_FUNCS = {
     "_retire_replica",
     "drain_replica",
     "drain_all",
+    "register_adapter",
     # request-tracing hooks ride the same tick: deque appends only
     "_trace_us",
     "_trace_dispatch",
     "_trace_request",
+}
+
+# the LoRA adapter pool: ensure/evict run INSIDE the engine admission
+# loop (per request) and the residency peeks serve the router's probe
+# from the dispatcher thread — all host dict/list bookkeeping.  The ONE
+# sanctioned transfer is the hot-load's host→device page upload in
+# _load_locked (disclosed `# sync-ok`): an adapter miss pays its upload
+# once, by design, and everything else must stay async.
+ADAPTERS_PATH = os.path.join(REPO, "deepspeed_tpu", "serving",
+                             "adapters.py")
+ADAPTERS_FUNCS = {
+    "ensure",
+    "_load_locked",
+    "evict_cold",
+    "_evictable_ids",
+    "evictable_blocks",
+    "is_resident",
+    "resident_count",
+    "slot_of",
+    "unfittable_reason",
+    "acquire",
+    "release",
+    "tables",
+    "stats",
 }
 
 # the pool autoscaler: evaluate/decide run inside the dispatcher tick and
@@ -334,6 +370,10 @@ GUARDIAN_PATTERN = re.compile(
 # '# sync-ok' comment discloses a reviewed, intentional sync
 ENGINE_ALLOW = re.compile(r"device_get|#\s*sync-ok")
 ALLOW_PATTERN = re.compile(r"#\s*sync-ok")
+# adapter pool: transfers in EITHER direction are the hazard (the load
+# path's device_put upload is the one disclosed site); host np.asarray
+# staging of registered weights is not a sync and is not matched
+ADAPTERS_PATTERN = re.compile(r"device_put|device_get|block_until_ready")
 # trace-context minting + the timeseries/SLO sampler: the generic
 # transfer class plus the two blocking shapes that could sneak into a
 # sampler (a sleep, an undisclosed lock acquisition — the disclosed
@@ -353,6 +393,7 @@ SCAN_TARGETS = [
      RESILIENCE_PATTERN, ALLOW_PATTERN),
     (ROUTER_PATH, ROUTER_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (FLEET_PATH, FLEET_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
+    (ADAPTERS_PATH, ADAPTERS_FUNCS, ADAPTERS_PATTERN, ALLOW_PATTERN),
     (AUTOSCALE_PATH, AUTOSCALE_FUNCS, TRANSFER_PATTERN, ALLOW_PATTERN),
     (GUARDIAN_PATH, GUARDIAN_FUNCS, GUARDIAN_PATTERN, ALLOW_PATTERN),
     # MoE route bodies are jit-traced — any blocking host op would sync the
